@@ -1,0 +1,52 @@
+"""mxnet_tpu: a TPU-native deep-learning framework with MXNet's
+capability surface (reference: ykim362/mxnet; see SURVEY.md).
+
+Import convention mirrors the reference: ``import mxnet_tpu as mx``.
+"""
+from .base import MXNetError, __version__  # noqa: F401
+from .context import (Context, cpu, cpu_pinned, gpu, xla, num_gpus,  # noqa: F401
+                      current_context)
+from . import engine  # noqa: F401
+from . import ndarray  # noqa: F401
+from . import ndarray as nd  # noqa: F401
+from . import autograd  # noqa: F401
+from . import random  # noqa: F401
+
+# subsystems imported lazily to keep `import mxnet_tpu` light
+_LAZY = {
+    "gluon": ".gluon",
+    "sym": ".symbol",
+    "symbol": ".symbol",
+    "mod": ".module",
+    "module": ".module",
+    "optimizer": ".optimizer",
+    "metric": ".metric",
+    "initializer": ".initializer",
+    "init": ".initializer",
+    "lr_scheduler": ".lr_scheduler",
+    "callback": ".callback",
+    "kvstore": ".kvstore",
+    "kv": ".kvstore",
+    "io": ".io",
+    "image": ".image",
+    "recordio": ".io.recordio",
+    "profiler": ".profiler",
+    "test_utils": ".test_utils",
+    "parallel": ".parallel",
+    "models": ".models",
+    "amp": ".amp",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(_LAZY[name], __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'mxnet_tpu' has no attribute {name!r}")
+
+
+def waitall():
+    engine.waitall()

@@ -1,0 +1,123 @@
+"""Imperative op invocation: the eager runtime.
+
+Ref: src/imperative/imperative.cc (Imperative::Invoke → SetShapeType →
+PushFCompute → Engine::PushAsync) and src/c_api/c_api_ndarray.cc
+(MXImperativeInvokeEx).
+
+TPU-native design: an eager op call becomes a *compiled-executable cache
+lookup + async PjRt execute* (SURVEY §3.1).  Each registered op is a pure
+JAX function of its input buffers with static attributes; we memoize
+``jax.jit`` of (fn, attrs) — jax keys the executable further by input
+shapes/dtypes, giving exactly the per-(op, attrs, shapes, dtypes)
+executable cache the survey prescribes.  Shape/dtype inference
+(ref: FInferShape/FInferType) falls out of ``jax.eval_shape`` instead of
+per-op C++ inference functions.
+
+The autograd tape hook lives here (ref: Imperative::RecordOp).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+
+from . import engine
+from .base import MXNetError
+
+# (fn, attrs_key) -> jitted callable.  jax.jit internally re-keys by input
+# shape/dtype/sharding, so this two-level scheme is the full cache.
+_jit_cache = {}
+# (fn, attrs_key) -> jitted vjp-apply callable used by autograd.backward.
+_vjp_cache = {}
+
+
+def _attrs_key(kwargs):
+    try:
+        return tuple(sorted(kwargs.items()))
+    except TypeError as e:
+        raise MXNetError(
+            f"op attributes must be hashable, got {kwargs!r}") from e
+
+
+def get_jitted(fn, kwargs):
+    key = (fn, _attrs_key(kwargs))
+    jitted = _jit_cache.get(key)
+    if jitted is None:
+        if kwargs:
+            jitted = jax.jit(functools.partial(fn, **dict(kwargs)))
+        else:
+            jitted = jax.jit(fn)
+        _jit_cache[key] = jitted
+    return jitted
+
+
+def get_vjp(fn, kwargs):
+    """Jitted (primals, cotangents) -> input cotangents for one op."""
+    key = (fn, _attrs_key(kwargs))
+    applier = _vjp_cache.get(key)
+    if applier is None:
+        closed = functools.partial(fn, **dict(kwargs)) if kwargs else fn
+
+        def _apply(primals, cotangents):
+            _, vjp_fn = jax.vjp(closed, *primals)
+            return vjp_fn(cotangents)
+
+        applier = jax.jit(_apply)
+        _vjp_cache[key] = applier
+    return applier
+
+
+def _raw(x):
+    """Unwrap NDArray / accept numpy & python scalars."""
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(x, NDArray):
+        return x._data
+    return x
+
+
+def invoke(fn, *args, jit_compile=True, nondiff=False, **kwargs):
+    """Invoke a registered op on NDArrays; returns NDArray or tuple.
+
+    The async boundary of ref §3.1 is implicit: the returned NDArray wraps
+    a not-yet-computed buffer (PjRt future).
+    """
+    from . import autograd
+    from .ndarray.ndarray import NDArray, _wrap
+
+    raws = [_raw(a) for a in args]
+    if jit_compile:
+        out = get_jitted(fn, kwargs)(*raws)
+    else:
+        out = fn(*raws, **kwargs)
+
+    multi = isinstance(out, (tuple, list))
+    outs = list(out) if multi else [out]
+    out_nds = [_wrap(engine.track(o)) for o in outs]
+
+    if autograd.is_recording() and not nondiff:
+        in_nds = [a for a in args if isinstance(a, NDArray)]
+        if any(a._in_graph or a._grad is not None for a in in_nds):
+            autograd._record(fn, kwargs, args, raws, out_nds)
+
+    return tuple(out_nds) if multi else out_nds[0]
+
+
+def eval_shape(fn, arg_shapes_dtypes, **kwargs):
+    """Infer output shapes/dtypes without running (ref: FInferShape/Type)."""
+    specs = [jax.ShapeDtypeStruct(s, d) for s, d in arg_shapes_dtypes]
+    closed = functools.partial(fn, **kwargs) if kwargs else fn
+    out = jax.eval_shape(closed, *specs)
+    return out
+
+
+def clear_caches():
+    _jit_cache.clear()
+    _vjp_cache.clear()
+
+
+def to_numpy_dtype(dtype):
+    if dtype is None:
+        return np.float32
+    return np.dtype(dtype)

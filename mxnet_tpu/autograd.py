@@ -1,0 +1,297 @@
+"""Imperative autograd: tape recording + reverse pass.
+
+Ref: python/mxnet/autograd.py (record/pause/backward/grad/Function) and
+src/imperative/imperative.cc (RecordOp / Backward building the grad
+graph).
+
+TPU-native design: the tape records (pure-fn, attrs, input buffers,
+output NDArrays) per op.  ``backward`` walks the tape in reverse and, for
+each node, applies a *cached jitted VJP executable* (jax.vjp of the op's
+pure function) — so eager backward is itself a sequence of compiled XLA
+executions, and hybridized blocks appear as a single tape node whose VJP
+is one whole-graph XLA computation (the CachedOp::Backward equivalent,
+ref: src/imperative/cached_op.cc).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+from . import _imperative, engine
+from .base import MXNetError
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+        _state.tape = []
+    return _state
+
+
+class _Node:
+    __slots__ = ("fn", "kwargs", "in_nds", "in_raws", "out_nds", "custom_vjp")
+
+    def __init__(self, fn, kwargs, in_nds, in_raws, out_nds, custom_vjp=None):
+        self.fn = fn
+        self.kwargs = kwargs
+        self.in_nds = in_nds      # NDArray inputs (graph edges)
+        self.in_raws = in_raws    # raw buffers at record time (version pin)
+        self.out_nds = out_nds
+        self.custom_vjp = custom_vjp
+
+
+def _record(fn, kwargs, args, raws, out_nds, custom_vjp=None):
+    """Record one op.  in_nds is aligned 1:1 with the op's positional args
+    (None placeholder for non-NDArray args) so the VJP applier can be
+    called with the exact arg list the forward saw."""
+    from .ndarray.ndarray import NDArray
+
+    in_nds = [a if isinstance(a, NDArray) else None for a in args]
+    in_raws = list(raws)
+    for o in out_nds:
+        o._in_graph = True
+    _st().tape.append(_Node(fn, kwargs, in_nds, in_raws, out_nds, custom_vjp))
+
+
+# ---------------------------------------------------------------------------
+# Scopes (ref: python/mxnet/autograd.py record/pause/train_mode/predict_mode)
+
+
+class _RecordingScope:
+    def __init__(self, recording, training):
+        self._rec, self._train = recording, training
+
+    def __enter__(self):
+        st = _st()
+        self._old = (st.recording, st.training)
+        st.recording, st.training = self._rec, self._train
+        return self
+
+    def __exit__(self, *a):
+        st = _st()
+        st.recording, st.training = self._old
+
+
+def record(train_mode=True):
+    return _RecordingScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingScope(_st().recording, True)
+
+
+def predict_mode():
+    return _RecordingScope(_st().recording, False)
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def set_recording(is_rec):
+    st = _st()
+    prev, st.recording = st.recording, is_rec
+    return prev
+
+
+def set_training(train):
+    st = _st()
+    prev, st.training = st.training, train
+    return prev
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Ref: autograd.mark_variables — associate grad buffers with vars."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._grad_req = req
+        v._in_graph = True
+
+
+# ---------------------------------------------------------------------------
+# Backward
+
+
+def _zeros_like_raw(raw):
+    return jax.numpy.zeros(raw.shape, raw.dtype)
+
+
+def _is_float0(ct):
+    return ct is None or (hasattr(ct, "dtype") and ct.dtype == jax.dtypes.float0)
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Run the reverse pass from ``heads`` (ref: MXAutogradBackwardEx →
+    Imperative::Backward).  Accumulated gradients land in ``x.grad`` for
+    every array that called ``attach_grad()``."""
+    from .ndarray.ndarray import NDArray, _wrap
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+
+    tape = _st().tape
+    # cotangent accumulator keyed by NDArray identity
+    cts = {}
+    for i, h in enumerate(heads):
+        if head_grads is None or head_grads[i] is None:
+            seed = jax.numpy.ones(h.shape, h.dtype)
+        else:
+            hg = head_grads[i]
+            seed = hg._data if isinstance(hg, NDArray) else jax.numpy.asarray(hg)
+        cts[id(h)] = seed
+
+    grads_out = {}
+
+    for node in reversed(tape):
+        out_cts = []
+        any_needed = False
+        for o in node.out_nds:
+            c = cts.get(id(o))
+            if c is None:
+                c = _zeros_like_raw(o._data)
+            else:
+                any_needed = True
+            out_cts.append(c)
+        if not any_needed:
+            continue
+        if node.custom_vjp is not None:
+            in_cts = node.custom_vjp(node.in_raws, out_cts)
+        else:
+            multi = len(node.out_nds) > 1
+            applier = _imperative.get_vjp(node.fn, node.kwargs)
+            in_cts = applier(
+                tuple(node.in_raws),
+                tuple(out_cts) if multi else out_cts[0],
+            )
+        for nd_in, ct in zip(node.in_nds, in_cts):
+            if nd_in is None or _is_float0(ct):
+                continue
+            prev = cts.get(id(nd_in))
+            cts[id(nd_in)] = ct if prev is None else prev + ct
+
+    # write/accumulate into .grad for leaves with attached grads
+    for node in tape:
+        for nd_in in node.in_nds:
+            if nd_in is not None:
+                _deposit(nd_in, cts, grads_out)
+    for h in heads:
+        _deposit(h, cts, grads_out)
+
+    if not retain_graph:
+        _st().tape = []
+    return
+
+
+def _deposit(nd, cts, done):
+    if nd._grad is None or id(nd) in done:
+        return
+    ct = cts.get(id(nd))
+    if ct is None:
+        return
+    from .ndarray.ndarray import _wrap
+
+    if nd._grad_req == "add":
+        nd._grad = _wrap(engine.track(nd._grad._data + ct))
+    else:  # 'write'
+        nd._grad = _wrap(engine.track(jax.numpy.asarray(ct, nd._data.dtype)))
+    done[id(nd)] = True
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Ref: autograd.grad — return grads of heads w.r.t. variables without
+    touching .grad buffers."""
+    from .ndarray.ndarray import NDArray
+
+    if create_graph:
+        raise MXNetError("create_graph=True (higher-order eager grad) is not "
+                         "supported; use hybridize + symbolic grad instead")
+    if isinstance(variables, NDArray):
+        variables = [variables]
+    saved = [(v._grad, v._grad_req) for v in variables]
+    for v in variables:
+        v._grad = _zeros_ndarray_like(v)
+        v._grad_req = "write"
+    try:
+        backward(heads, head_grads,
+                 retain_graph=bool(retain_graph), train_mode=train_mode)
+        outs = [v.grad for v in variables]
+    finally:
+        for v, (g, r) in zip(variables, saved):
+            v._grad, v._grad_req = g, r
+    return outs
+
+
+def _zeros_ndarray_like(v):
+    from .ndarray.ndarray import _wrap
+
+    return _wrap(jax.numpy.zeros(v.shape, v.dtype))
+
+
+def get_symbol(x):  # pragma: no cover - legacy API stub
+    raise MXNetError("autograd.get_symbol is not supported on the TPU build; "
+                     "use HybridBlock.hybridize/export")
+
+
+# ---------------------------------------------------------------------------
+# Custom differentiable functions (ref: autograd.Function)
+
+
+class Function:
+    """User-defined op with custom forward/backward.
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)`` using NDArrays (eager, host side).
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *arrays):
+        self._saved = arrays
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray, _wrap
+
+        with pause():
+            outs = self.forward(*inputs)
+        multi = isinstance(outs, (tuple, list))
+        out_list = list(outs) if multi else [outs]
+
+        if is_recording():
+            fun = self
+
+            def custom_vjp(in_raws, out_cts):
+                with pause():
+                    gs = fun.backward(*[_wrap(c) for c in out_cts])
+                if isinstance(gs, NDArray):
+                    gs = [gs]
+                return [g._data if isinstance(g, NDArray) else g for g in gs]
+
+            in_nds = [a for a in inputs if isinstance(a, NDArray)]
+            _record(None, {}, in_nds, [a._data for a in in_nds], out_list,
+                    custom_vjp=custom_vjp)
+        return outs
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError

@@ -1,0 +1,87 @@
+"""Base utilities: errors, registries, env-var config.
+
+TPU-native equivalents of the reference's dmlc-core foundations
+(ref: 3rdparty/dmlc-core — logging, Registry, GetEnv).  Instead of a C++
+``dmlc::Registry`` we keep light Python registries; the operator
+parameter-struct tier (``dmlc::Parameter``) maps to keyword arguments
+validated at the op boundary.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+__version__ = "0.1.0"
+
+# ---------------------------------------------------------------------------
+# Errors
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (ref: include/mxnet/base.h MXGetLastError)."""
+
+
+def check_call(ok, msg=""):
+    if not ok:
+        raise MXNetError(msg)
+
+
+# ---------------------------------------------------------------------------
+# Env-var config tier (ref: docs/faq/env_var.md — MXNET_* read via dmlc::GetEnv).
+# We accept both MXTPU_* and MXNET_* spellings, MXTPU_* winning.
+
+
+def getenv(name: str, default=None, dtype=str):
+    for prefix in ("MXTPU_", "MXNET_"):
+        v = os.environ.get(prefix + name)
+        if v is not None:
+            if dtype is bool:
+                return v not in ("0", "false", "False", "")
+            return dtype(v)
+    return default
+
+
+# ---------------------------------------------------------------------------
+# Generic string-keyed registry (ref: dmlc Registry pattern used by ops,
+# iterators, optimizers, initializers, metrics).
+
+
+class Registry:
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._entries = {}
+        self._lock = threading.Lock()
+
+    def register(self, name=None, override=False):
+        def _reg(obj):
+            key = (name or getattr(obj, "__name__", None) or str(obj)).lower()
+            with self._lock:
+                if key in self._entries and not override:
+                    raise MXNetError(
+                        f"{self.kind} '{key}' already registered")
+                self._entries[key] = obj
+            return obj
+
+        return _reg
+
+    def get(self, name):
+        key = str(name).lower()
+        if key not in self._entries:
+            raise MXNetError(
+                f"unknown {self.kind} '{name}'; known: {sorted(self._entries)}")
+        return self._entries[key]
+
+    def __contains__(self, name):
+        return str(name).lower() in self._entries
+
+    def list(self):
+        return sorted(self._entries)
+
+
+# string-name helpers
+
+
+def numeric_types():
+    import numpy as _np
+
+    return (int, float, _np.generic)

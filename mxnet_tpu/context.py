@@ -1,0 +1,124 @@
+"""Device contexts.
+
+Ref: python/mxnet/context.py (``Context``, ``mx.cpu()``, ``mx.gpu(i)``).
+The TPU build adds ``mx.xla(i)`` (the BASELINE north-star device) backed
+by a JAX device.  ``mx.gpu(i)`` is kept as a compatibility alias for the
+i-th accelerator so unmodified reference scripts run.
+
+A Context maps to a concrete ``jax.Device``; computation follows data
+(XLA dispatch places an op on the device holding its inputs), so the
+reference's per-device stream/worker machinery is not needed.
+"""
+from __future__ import annotations
+
+import threading
+
+from .base import MXNetError
+
+
+class Context:
+    """A device context (cpu / xla accelerator)."""
+
+    devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 4: "xla"}
+    devstr2type = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "xla": 4}
+    _default_ctx = threading.local()
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Context):
+            self.device_typeid = device_type.device_typeid
+            self.device_id = device_type.device_id
+        else:
+            if device_type not in self.devstr2type:
+                raise MXNetError(f"unknown device type {device_type!r}")
+            self.device_typeid = self.devstr2type[device_type]
+            self.device_id = device_id
+
+    @property
+    def device_type(self):
+        return self.devtype2str[self.device_typeid]
+
+    def __hash__(self):
+        return hash((self.device_typeid, self.device_id))
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Context)
+            and self.device_typeid == other.device_typeid
+            and self.device_id == other.device_id
+        )
+
+    def __repr__(self):
+        return f"{self.device_type}({self.device_id})"
+
+    __str__ = __repr__
+
+    # -- JAX mapping --------------------------------------------------------
+
+    def jax_device(self):
+        """Resolve to a concrete jax.Device."""
+        import jax
+
+        if self.device_type in ("cpu", "cpu_pinned"):
+            try:
+                return jax.devices("cpu")[self.device_id]
+            except RuntimeError:
+                # no cpu backend registered: fall back to default backend
+                return jax.devices()[0]
+        # xla / gpu(compat alias): i-th device of the default (accelerator)
+        # backend; on a CPU-only host this is the i-th virtual CPU device.
+        devs = jax.devices()
+        if self.device_id >= len(devs):
+            raise MXNetError(
+                f"device id {self.device_id} out of range; "
+                f"{len(devs)} device(s) visible")
+        return devs[self.device_id]
+
+    def __enter__(self):
+        if not hasattr(Context._default_ctx, "stack"):
+            Context._default_ctx.stack = []
+        Context._default_ctx.stack.append(self)
+        return self
+
+    def __exit__(self, *args):
+        Context._default_ctx.stack.pop()
+
+    @classmethod
+    def default_ctx(cls):
+        stack = getattr(cls._default_ctx, "stack", None)
+        if stack:
+            return stack[-1]
+        return cpu()
+
+
+def cpu(device_id=0):
+    """Return a CPU context (ref: mx.cpu())."""
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id=0):
+    return Context("cpu_pinned", device_id)
+
+
+def gpu(device_id=0):
+    """Compatibility alias: i-th accelerator device (ref: mx.gpu())."""
+    return Context("gpu", device_id)
+
+
+def xla(device_id=0):
+    """The TPU-native device context (north star: NDArray gains xla())."""
+    return Context("xla", device_id)
+
+
+def num_gpus():
+    """Number of accelerator devices visible (ref: mx.context.num_gpus)."""
+    import jax
+
+    try:
+        devs = jax.devices()
+    except RuntimeError:
+        return 0
+    return sum(1 for d in devs if d.platform != "cpu") or len(devs)
+
+
+def current_context():
+    return Context.default_ctx()

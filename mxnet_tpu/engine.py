@@ -1,0 +1,105 @@
+"""Async execution engine facade.
+
+Ref: src/engine/threaded_engine.{h,cc}, naive_engine.cc, and
+include/mxnet/engine.h (Engine::PushAsync / WaitForVar / WaitForAll).
+
+TPU-native design: XLA/PjRt dispatch is already asynchronous — every
+``jax.Array`` is a future and data dependencies between ops are enforced
+by construction (an op consuming a buffer waits on that buffer's
+producer).  That is exactly the guarantee the reference's ThreadedVar
+RAW/WAR/WAW state machine provides, so the 5k-line C++ scheduler shrinks
+to: (a) a *naive/sync* mode toggle for debugging (ref: NaiveEngine via
+MXNET_ENGINE_TYPE), (b) ``waitall``/``wait_to_read`` barriers over live
+buffers, and (c) a host-side thread pool used by the IO prefetcher.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import weakref
+
+from .base import getenv
+
+# live arrays tracked for waitall(); weakrefs so we never extend lifetime
+_live = weakref.WeakSet()
+
+# 'ThreadedEngine' (async, default) or 'NaiveEngine' (every op synchronous)
+_engine_type = getenv("ENGINE_TYPE", "ThreadedEngine")
+
+
+def engine_type():
+    return _engine_type
+
+
+def set_engine_type(name):
+    """Switch between async ('ThreadedEngine') and sync ('NaiveEngine')."""
+    global _engine_type
+    assert name in ("ThreadedEngine", "NaiveEngine"), name
+    _engine_type = name
+
+
+def is_naive():
+    return _engine_type == "NaiveEngine"
+
+
+def track(jarr):
+    """Register a device buffer so waitall() can block on it."""
+    try:
+        _live.add(jarr)
+    except TypeError:
+        pass
+    if is_naive():
+        try:
+            jarr.block_until_ready()
+        except AttributeError:
+            pass
+    return jarr
+
+
+def waitall():
+    """Block until all outstanding device work completes.
+
+    Ref: Engine::WaitForAll / mx.nd.waitall() — this is the barrier that
+    surfaces async execution errors, so real failures must propagate;
+    only already-freed buffers (deleted/donated) are skipped.
+    """
+    for arr in list(_live):
+        try:
+            arr.block_until_ready()
+        except RuntimeError as e:
+            msg = str(e).lower()
+            if "deleted" in msg or "donated" in msg:
+                continue
+            raise
+
+
+def wait_for_var(jarr):
+    """Ref: Engine::WaitForVar — block on one buffer."""
+    jarr.block_until_ready()
+
+
+# ---------------------------------------------------------------------------
+# Host-side worker pool: the surviving role of the threaded engine — overlap
+# host work (decode, checkpoint, H2D staging) with device steps.
+
+_pool = None
+
+
+def host_pool():
+    global _pool
+    if _pool is None:
+        n = getenv("CPU_WORKER_NTHREADS", 4, int)
+        _pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=n, thread_name_prefix="mxtpu-host-worker")
+    return _pool
+
+
+def push_host(fn, *args, **kwargs):
+    """Run host-side work async (ref: Engine::PushAsync with CPU ctx)."""
+    if is_naive():
+        f = concurrent.futures.Future()
+        try:
+            f.set_result(fn(*args, **kwargs))
+        except BaseException as e:  # noqa: BLE001 - mirror future semantics
+            f.set_exception(e)
+        return f
+    return host_pool().submit(fn, *args, **kwargs)

@@ -1,0 +1,16 @@
+"""mx.nd — the imperative NDArray API (ref: python/mxnet/ndarray/)."""
+from .ndarray import (NDArray, array, zeros, ones, full, arange, empty,  # noqa: F401
+                      zeros_like, ones_like, eye, linspace, concatenate,
+                      waitall, save, load, from_jax, moveaxis)
+from .ops import *  # noqa: F401,F403  (generated op namespace)
+from . import ops as _gen_ops
+from .. import random  # noqa: F401  (mx.nd.random.* sampling namespace)
+
+# creation helpers must win over same-named registered ops: the helper
+# versions preserve the source array's device context
+from .ndarray import zeros_like, ones_like  # noqa: F401,E402
+
+
+def __getattr__(name):
+    # fall through to generated ops for aliases added later
+    return getattr(_gen_ops, name)

@@ -1,0 +1,115 @@
+"""Eager op namespace: mx.nd.* generated from the op registry.
+
+Ref: python/mxnet/ndarray/register.py — MXNet generates its nd functions
+at import from MXListAllOpNames; we generate from ops.registry the same
+way so nd.* and sym.* share one source of truth.
+"""
+from __future__ import annotations
+
+import ast
+import sys
+
+import numpy as np
+
+from .. import autograd
+from .. import random as _random
+from .._imperative import invoke
+from ..ops import nn as _nn_ops  # noqa: F401  (registration side effect)
+from ..ops import registry as _registry
+from ..ops import rnn as _rnn_ops  # noqa: F401
+from ..ops import tensor as _tensor_ops  # noqa: F401
+from .ndarray import NDArray, array
+
+__all__ = []
+
+
+def _norm_attr(v):
+    if isinstance(v, str):
+        s = v.strip()
+        if s and (s[0] in "([-0123456789" or s in ("True", "False", "None")):
+            try:
+                v = ast.literal_eval(s)
+            except (ValueError, SyntaxError):
+                return v
+    if isinstance(v, (list, tuple)):
+        return tuple(_norm_attr(x) for x in v)
+    if isinstance(v, np.dtype):
+        return str(v)
+    if isinstance(v, type):  # e.g. dtype=np.float32
+        return str(np.dtype(v))
+    if isinstance(v, np.generic):
+        return v.item()
+    return v
+
+
+def _coerce_input(a, like=None):
+    if isinstance(a, NDArray) or a is None:
+        return a
+    if isinstance(a, (np.ndarray, list, tuple)):
+        return array(a)
+    if isinstance(a, (int, float)):
+        dt = like.dtype if like is not None else np.float32
+        return array(np.asarray(a, dtype=dt))
+    return a
+
+
+def make_op_wrapper(entry):
+    def wrapper(*args, **kwargs):
+        out_arr = kwargs.pop("out", None)
+        kwargs.pop("name", None)
+        attrs = {}
+        arrays = list(args)
+        # split array-kwargs (named inputs) from attribute kwargs
+        for k in list(kwargs):
+            if k in entry.arg_names:
+                idx = entry.arg_names.index(k)
+                while len(arrays) <= idx:
+                    arrays.append(None)
+                arrays[idx] = kwargs.pop(k)
+            elif isinstance(kwargs[k], NDArray):
+                arrays.append(kwargs.pop(k))
+        first = next((a for a in arrays if isinstance(a, NDArray)), None)
+        arrays = [_coerce_input(a, first) for a in arrays]
+        while arrays and arrays[-1] is None:
+            arrays.pop()
+        for k, v in kwargs.items():
+            attrs[k] = _norm_attr(v)
+        if entry.train_aware:
+            attrs.setdefault("_train", autograd.is_training())
+        if entry.validator is not None:
+            entry.validator(arrays, attrs)
+        if entry.needs_rng:
+            # key goes in the slot right after the named array inputs; pad
+            # omitted optional inputs (e.g. GRU's state_cell) with None
+            from .ndarray import _wrap
+
+            while len(arrays) < len(entry.arg_names):
+                arrays.append(None)
+            arrays.append(_wrap(_random.next_key()))
+        res = invoke(entry.fn, *arrays, jit_compile=entry.jit_compile,
+                     nondiff=entry.nondiff, **attrs)
+        if entry.mutate_aux and isinstance(res, tuple):
+            for in_idx, out_idx in entry.mutate_aux:
+                if in_idx < len(arrays) and isinstance(arrays[in_idx], NDArray):
+                    arrays[in_idx]._data = res[out_idx]._data
+            res = res[0]
+        if out_arr is not None:
+            first_res = res[0] if isinstance(res, tuple) else res
+            out_arr._data = first_res._data
+            return out_arr
+        if isinstance(res, tuple) and len(res) == 1:
+            return res[0]
+        return res
+
+    wrapper.__name__ = entry.name
+    wrapper.__qualname__ = entry.name
+    wrapper.__doc__ = entry.doc
+    return wrapper
+
+
+_this = sys.modules[__name__]
+for _name, _entry in _registry.canonical_items():
+    _w = _entry.wrapper or make_op_wrapper(_entry)
+    for _n in (_name,) + _entry.aliases:
+        setattr(_this, _n, _w)
+        __all__.append(_n)

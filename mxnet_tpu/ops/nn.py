@@ -1,0 +1,499 @@
+"""NN operator family: conv, pooling, norms, FC, activations, dropout.
+
+Ref: src/operator/nn/ (convolution.*, fully_connected.*, batch_norm.*,
+layer_norm.*, pooling.*, activation.*, dropout.*, softmax.*, lrn.*,
+cudnn/*) — re-emitted as XLA HLO.  Convs lower to
+``lax.conv_general_dilated`` (MXU systolic-array path — the cuDNN
+equivalent is the XLA:TPU conv emitter), FC to ``dot``, norms to fused
+elementwise chains XLA folds into neighbouring matmuls.
+
+Layout note: MXNet is NCHW/OIHW.  We keep NCHW at the API boundary for
+parity; XLA:TPU internally relayouts to its preferred tiling, so this
+costs nothing at steady state.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+# ---------------------------------------------------------------------------
+# FullyConnected (ref: src/operator/nn/fully_connected.cc)
+
+
+def _k_fully_connected(data, weight, bias=None, *, num_hidden,
+                       no_bias=False, flatten=True):
+    x = data.reshape(data.shape[0], -1) if flatten and data.ndim > 2 else data
+    out = jnp.dot(x, weight.T)
+    if not no_bias and bias is not None:
+        out = out + bias
+    return out
+
+register("FullyConnected", _k_fully_connected,
+         arg_names=("data", "weight", "bias"), aliases=("fully_connected",))
+
+# ---------------------------------------------------------------------------
+# Convolution (ref: src/operator/nn/convolution.cc + cudnn_convolution)
+
+
+_CONV_DIMS = {1: ("NCW", "OIW", "NCW"),
+              2: ("NCHW", "OIHW", "NCHW"),
+              3: ("NCDHW", "OIDHW", "NCDHW")}
+
+
+def _k_convolution(data, weight, bias=None, *, kernel, stride=(), dilate=(),
+                   pad=(), num_filter=0, num_group=1, no_bias=False,
+                   layout=None, cudnn_tune=None, cudnn_off=False,
+                   workspace=1024):
+    nd = len(kernel)
+    stride = stride or (1,) * nd
+    dilate = dilate or (1,) * nd
+    pad = pad or (0,) * nd
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_DIMS[nd])
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=num_group,
+        preferred_element_type=None)
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+register("Convolution", _k_convolution,
+         arg_names=("data", "weight", "bias"), aliases=("convolution",))
+
+
+def _k_deconvolution(data, weight, bias=None, *, kernel, stride=(),
+                     dilate=(), pad=(), adj=(), num_filter=0, num_group=1,
+                     no_bias=True, target_shape=(), layout=None,
+                     cudnn_tune=None, cudnn_off=False, workspace=1024):
+    nd = len(kernel)
+    stride = stride or (1,) * nd
+    dilate = dilate or (1,) * nd
+    pad = pad or (0,) * nd
+    adj = adj or (0,) * nd
+    # Transposed conv = gradient of conv w.r.t. input.  weight layout is
+    # (in_c, out_c/groups, *k) in MXNet deconv; lax.conv_transpose wants IO
+    # swapped relative to conv.
+    pads = [(k + (k - 1) * (d - 1) - 1 - p,
+             k + (k - 1) * (d - 1) - 1 - p + a)
+            for k, d, p, a in zip(kernel, dilate, pad, adj)]
+    if num_group > 1:
+        xs = jnp.split(data, num_group, axis=1)
+        ws = jnp.split(weight, num_group, axis=0)
+        outs = [_deconv1(x, w, stride, pads, dilate) for x, w in zip(xs, ws)]
+        out = jnp.concatenate(outs, axis=1)
+    else:
+        out = _deconv1(data, weight, stride, pads, dilate)
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+def _deconv1(x, w, stride, pads, dilate):
+    nd = w.ndim - 2
+    dn = lax.conv_dimension_numbers(
+        x.shape, (w.shape[1], w.shape[0]) + w.shape[2:], _CONV_DIMS[nd])
+    # flip spatial dims and swap i/o channels: transpose conv as dilated conv
+    wt = jnp.swapaxes(w, 0, 1)
+    wt = jnp.flip(wt, axis=tuple(range(2, 2 + nd)))
+    return lax.conv_general_dilated(
+        x, wt, window_strides=(1,) * nd, padding=pads,
+        lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn)
+
+register("Deconvolution", _k_deconvolution,
+         arg_names=("data", "weight", "bias"), aliases=("deconvolution",))
+
+# ---------------------------------------------------------------------------
+# Pooling (ref: src/operator/nn/pooling.cc)
+
+
+def _pool_out_pad(in_size, k, s, p, convention):
+    import math
+
+    if convention == "full":
+        out = int(math.ceil((in_size + 2 * p - k) / s)) + 1
+        needed = (out - 1) * s + k - in_size - p
+        return p, max(needed, p)
+    return p, p
+
+
+def _k_pooling(data, *, kernel=(), pool_type="max", stride=(), pad=(),
+               global_pool=False, pooling_convention="valid",
+               count_include_pad=True, cudnn_off=False, p_value=2,
+               layout=None):
+    nd = data.ndim - 2
+    if global_pool:
+        axes = tuple(range(2, 2 + nd))
+        if pool_type == "max":
+            return jnp.max(data, axis=axes, keepdims=True)
+        if pool_type == "sum":
+            return jnp.sum(data, axis=axes, keepdims=True)
+        return jnp.mean(data, axis=axes, keepdims=True)
+    kernel = tuple(kernel)
+    stride = tuple(stride) or (1,) * nd
+    pad = tuple(pad) or (0,) * nd
+    pads = [(0, 0), (0, 0)] + [
+        _pool_out_pad(data.shape[2 + i], kernel[i], stride[i], pad[i],
+                      pooling_convention)
+        for i in range(nd)
+    ]
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, window, strides, pads)
+    if pool_type in ("avg", "sum"):
+        total = lax.reduce_window(data, 0.0, lax.add, window, strides, pads)
+        if pool_type == "sum":
+            return total
+        if count_include_pad:
+            denom = 1.0
+            for k in kernel:
+                denom *= k
+            return total / denom
+        ones = jnp.ones_like(data)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+        return total / counts
+    if pool_type == "lp":
+        powed = jnp.abs(data) ** p_value
+        total = lax.reduce_window(powed, 0.0, lax.add, window, strides, pads)
+        return total ** (1.0 / p_value)
+    raise ValueError(pool_type)
+
+register("Pooling", _k_pooling, aliases=("pooling",))
+
+# ---------------------------------------------------------------------------
+# Normalization (ref: batch_norm.cc, layer_norm.cc, instance_norm.cc,
+# l2_normalization.cc, lrn.cc)
+
+
+def _k_batch_norm(data, gamma, beta, moving_mean, moving_var, *,
+                  eps=1e-3, momentum=0.9, fix_gamma=True,
+                  use_global_stats=False, output_mean_var=False, axis=1,
+                  cudnn_off=False, _train=False):
+    """Returns (out, new_moving_mean, new_moving_var).
+
+    Functional form of the reference's stateful BatchNorm: the caller (nd
+    wrapper or gluon layer) commits the updated moving stats.  Cross-
+    replica sync-BN is handled at the parallel layer via psum of
+    (sum, sqsum) — see parallel/.
+    """
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    red = tuple(i for i in range(data.ndim) if i != axis)
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+
+    if _train and not use_global_stats:
+        mean = jnp.mean(data, axis=red)
+        var = jnp.var(data, axis=red)
+        new_mm = moving_mean * momentum + mean * (1 - momentum)
+        new_mv = moving_var * momentum + var * (1 - momentum)
+    else:
+        mean, var = moving_mean, moving_var
+        new_mm, new_mv = moving_mean, moving_var
+    mean_r = mean.reshape(shape)
+    var_r = var.reshape(shape)
+    out = (data - mean_r) * lax.rsqrt(var_r + eps) * g.reshape(shape) \
+        + beta.reshape(shape)
+    return out, lax.stop_gradient(new_mm), lax.stop_gradient(new_mv)
+
+
+register("BatchNorm", _k_batch_norm,
+         arg_names=("data", "gamma", "beta", "moving_mean", "moving_var"),
+         aliases=("batch_norm",), train_aware=True, num_outputs=3,
+         mutate_aux=((3, 1), (4, 2)))
+
+
+def _k_layer_norm(data, gamma, beta, *, axis=-1, eps=1e-5,
+                  output_mean_var=False):
+    mean = jnp.mean(data, axis=axis, keepdims=True)
+    var = jnp.var(data, axis=axis, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+register("LayerNorm", _k_layer_norm, arg_names=("data", "gamma", "beta"),
+         aliases=("layer_norm",))
+
+
+def _k_instance_norm(data, gamma, beta, *, eps=1e-3):
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return ((data - mean) * lax.rsqrt(var + eps)) * gamma.reshape(shape) \
+        + beta.reshape(shape)
+
+register("InstanceNorm", _k_instance_norm,
+         arg_names=("data", "gamma", "beta"), aliases=("instance_norm",))
+
+
+def _k_group_norm(data, gamma, beta, *, num_groups=1, eps=1e-5):
+    n, c = data.shape[:2]
+    x = data.reshape((n, num_groups, c // num_groups) + data.shape[2:])
+    red = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=red, keepdims=True)
+    var = jnp.var(x, axis=red, keepdims=True)
+    x = (x - mean) * lax.rsqrt(var + eps)
+    x = x.reshape(data.shape)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return x * gamma.reshape(shape) + beta.reshape(shape)
+
+register("GroupNorm", _k_group_norm, arg_names=("data", "gamma", "beta"))
+
+
+def _k_l2_normalization(data, *, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        red = tuple(range(1, data.ndim))
+        keep = True
+    elif mode == "channel":
+        red, keep = (1,), True
+    else:  # spatial
+        red = tuple(range(2, data.ndim))
+        keep = True
+    norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=keep) + eps)
+    return data / norm
+
+register("L2Normalization", _k_l2_normalization)
+
+
+def _k_lrn(data, *, nsize, alpha=1e-4, beta=0.75, knorm=2.0):
+    sq = jnp.square(data)
+    half = nsize // 2
+    padded = jnp.pad(sq, [(0, 0), (half, half), (0, 0), (0, 0)])
+    acc = sum(padded[:, i:i + data.shape[1]] for i in range(nsize))
+    return data / jnp.power(knorm + alpha * acc / nsize, beta)
+
+register("LRN", _k_lrn)
+
+# ---------------------------------------------------------------------------
+# Activations (ref: activation.cc, leaky_relu.cc)
+
+
+def _k_activation(data, *, act_type):
+    if act_type == "relu":
+        return jax.nn.relu(data)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act_type == "tanh":
+        return jnp.tanh(data)
+    if act_type == "softrelu":
+        return jax.nn.softplus(data)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(data)
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    raise ValueError(act_type)
+
+register("Activation", _k_activation, aliases=("activation",))
+
+
+def _k_leaky_relu(data, gamma=None, *, act_type="leaky", slope=0.25,
+                  lower_bound=0.125, upper_bound=0.334, _train=False):
+    if act_type == "leaky":
+        return jnp.where(data > 0, data, slope * data)
+    if act_type == "elu":
+        return jnp.where(data > 0, data, slope * jnp.expm1(data))
+    if act_type == "selu":
+        a, s = 1.6732632423543772, 1.0507009873554805
+        return s * jnp.where(data > 0, data, a * jnp.expm1(data))
+    if act_type == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (data.ndim - 2)) \
+            if gamma.ndim == 1 and data.ndim > 2 else gamma
+        return jnp.where(data > 0, data, g * data)
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=True)
+    if act_type == "rrelu":
+        mid = (lower_bound + upper_bound) / 2
+        return jnp.where(data > 0, data, mid * data)
+    raise ValueError(act_type)
+
+register("LeakyReLU", _k_leaky_relu, arg_names=("data", "gamma"),
+         train_aware=True)
+
+# ---------------------------------------------------------------------------
+# Softmax family (ref: softmax.cc, softmax_output.cc)
+
+
+def _k_softmax(data, *, axis=-1, temperature=None, length=None):
+    x = data / temperature if temperature else data
+    return jax.nn.softmax(x, axis=axis)
+
+register("softmax", _k_softmax, aliases=("SoftmaxActivation",))
+
+
+def _k_log_softmax(data, *, axis=-1, temperature=None):
+    x = data / temperature if temperature else data
+    return jax.nn.log_softmax(x, axis=axis)
+
+register("log_softmax", _k_log_softmax)
+
+
+def _k_softmin(data, *, axis=-1):
+    return jax.nn.softmax(-data, axis=axis)
+
+register("softmin", _k_softmin)
+
+
+@jax.custom_vjp
+def _softmax_output_core(data, label):
+    return jax.nn.softmax(data, axis=1)
+
+
+def _smo_fwd(data, label):
+    p = jax.nn.softmax(data, axis=1)
+    return p, (p, label)
+
+
+def _smo_bwd(res, g):
+    p, label = res
+    # MXNet loss-op semantics: grad w.r.t. data is (p - onehot(label)),
+    # independent of the incoming cotangent (ref: softmax_output.cc).
+    if label.ndim == p.ndim - 1:
+        oh = jax.nn.one_hot(label.astype(jnp.int32), p.shape[1], axis=1,
+                            dtype=p.dtype)
+    else:
+        oh = label
+    scale = 1.0 / p.shape[0]
+    return ((p - oh) * scale, jnp.zeros_like(label))
+
+
+_softmax_output_core.defvjp(_smo_fwd, _smo_bwd)
+
+
+def _k_softmax_output(data, label, *, grad_scale=1.0, ignore_label=-1.0,
+                      multi_output=False, use_ignore=False,
+                      preserve_shape=False, normalization="null",
+                      out_grad=False, smooth_alpha=0.0):
+    return _softmax_output_core(data, label)
+
+register("SoftmaxOutput", _k_softmax_output, arg_names=("data", "label"),
+         aliases=("softmax_output",))
+
+
+def _k_linear_regression_output(data, label, *, grad_scale=1.0):
+    return _linreg_core(data, label)
+
+
+@jax.custom_vjp
+def _linreg_core(data, label):
+    return data
+
+
+def _linreg_fwd(data, label):
+    return data, (data, label)
+
+
+def _linreg_bwd(res, g):
+    data, label = res
+    scale = 1.0 / data.shape[0]
+    return ((data - label.reshape(data.shape)) * scale, jnp.zeros_like(label))
+
+
+_linreg_core.defvjp(_linreg_fwd, _linreg_bwd)
+
+register("LinearRegressionOutput", _k_linear_regression_output,
+         arg_names=("data", "label"))
+
+
+@jax.custom_vjp
+def _logreg_core(data, label):
+    return jax.nn.sigmoid(data)
+
+
+def _logreg_fwd(data, label):
+    p = jax.nn.sigmoid(data)
+    return p, (p, label)
+
+
+def _logreg_bwd(res, g):
+    p, label = res
+    scale = 1.0 / p.shape[0]
+    return ((p - label.reshape(p.shape)) * scale, jnp.zeros_like(label))
+
+
+_logreg_core.defvjp(_logreg_fwd, _logreg_bwd)
+
+
+def _k_logistic_regression_output(data, label, *, grad_scale=1.0):
+    return _logreg_core(data, label)
+
+register("LogisticRegressionOutput", _k_logistic_regression_output,
+         arg_names=("data", "label"))
+
+
+def _k_mae_regression_output(data, label, *, grad_scale=1.0):
+    return _mae_core(data, label)
+
+
+@jax.custom_vjp
+def _mae_core(data, label):
+    return data
+
+
+def _mae_fwd(data, label):
+    return data, (data, label)
+
+
+def _mae_bwd(res, g):
+    data, label = res
+    scale = 1.0 / data.shape[0]
+    return (jnp.sign(data - label.reshape(data.shape)) * scale,
+            jnp.zeros_like(label))
+
+
+_mae_core.defvjp(_mae_fwd, _mae_bwd)
+
+register("MAERegressionOutput", _k_mae_regression_output,
+         arg_names=("data", "label"))
+
+# ---------------------------------------------------------------------------
+# Dropout (ref: dropout.cc) — needs_rng: wrapper passes a PRNG key.
+
+
+def _k_dropout(data, key=None, *, p=0.5, mode="training", axes=(),
+               _train=False, cudnn_off=False):
+    # ref dropout.cc: mode='always' applies dropout regardless of
+    # train/predict mode (MC-dropout); 'training' only under autograd.
+    if not (_train or mode == "always"):
+        return data
+    if p <= 0 or key is None:
+        return data
+    shape = list(data.shape)
+    for ax in axes:
+        shape[ax] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, tuple(shape)).astype(data.dtype)
+    return data * mask / keep
+
+register("Dropout", _k_dropout, arg_names=("data",), needs_rng=True,
+         train_aware=True, aliases=("dropout",))
+
+# ---------------------------------------------------------------------------
+# Upsampling / resize (ref: upsampling.cc, bilinear_resize)
+
+
+def _k_upsampling(data, *, scale, sample_type="nearest", num_args=1,
+                  workspace=512):
+    n, c, h, w = data.shape
+    if sample_type == "nearest":
+        return jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
+    return jax.image.resize(data, (n, c, h * scale, w * scale), "bilinear")
+
+register("UpSampling", _k_upsampling, variadic=True)
+
+
+def _k_bilinear_resize(data, *, height=0, width=0, scale_height=None,
+                       scale_width=None, mode="size"):
+    n, c, h, w = data.shape
+    th = height or int(h * scale_height)
+    tw = width or int(w * scale_width)
+    return jax.image.resize(data, (n, c, th, tw), "bilinear")
+
+register("_contrib_BilinearResize2D", _k_bilinear_resize,
+         aliases=("bilinear_resize_2d",))

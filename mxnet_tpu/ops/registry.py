@@ -1,0 +1,87 @@
+"""Operator registry.
+
+Ref: the nnvm op registry (NNVM_REGISTER_OP in src/operator/*; attrs
+FCompute/FInferShape/FInferType, dmlc parameter structs) and the
+frontend codegen that builds ``mx.nd.*`` / ``mx.sym.*`` from
+MXListAllOpNames (python/mxnet/ndarray/register.py).
+
+TPU-native design: one entry per op holding a *pure JAX function*
+(positional array inputs, keyword-only static attrs).  ``FCompute``
+becomes "jit the fn" (see _imperative), ``FInferShape/Type`` become
+``jax.eval_shape`` of the same fn, and ``FGradient`` becomes
+``jax.vjp``.  The same entry powers the eager namespace (mx.nd), the
+symbolic namespace (mx.sym), and hybrid tracing — so the three fronts
+can never drift apart.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+_ops = {}
+
+
+class OpEntry:
+    __slots__ = ("name", "fn", "arg_names", "aliases", "needs_rng",
+                 "train_aware", "nondiff", "variadic", "num_outputs",
+                 "jit_compile", "wrapper", "mutate_aux", "validator", "doc")
+
+    def __init__(self, name, fn, arg_names=("data",), aliases=(),
+                 needs_rng=False, train_aware=False, nondiff=False,
+                 variadic=False, num_outputs=1, jit_compile=True,
+                 wrapper=None, mutate_aux=None, validator=None, doc=None):
+        self.name = name
+        self.fn = fn
+        self.arg_names = tuple(arg_names)
+        self.aliases = tuple(aliases)
+        self.needs_rng = needs_rng
+        self.train_aware = train_aware
+        self.nondiff = nondiff
+        self.variadic = variadic
+        self.num_outputs = num_outputs
+        self.jit_compile = jit_compile
+        self.wrapper = wrapper  # fully custom python-level wrapper
+        self.mutate_aux = mutate_aux  # (aux_arg_indices, out_indices) pairs
+        self.validator = validator  # host-side (arrays, attrs) precheck
+        self.doc = doc or (fn.__doc__ if fn else None)
+
+
+def register(name, fn=None, **kwargs):
+    """Register an op (decorator or direct)."""
+
+    def _do(f):
+        if name in _ops:
+            raise MXNetError(f"op '{name}' already registered")
+        entry = OpEntry(name, f, **kwargs)
+        _ops[name] = entry
+        for a in entry.aliases:
+            if a in _ops:
+                raise MXNetError(f"op alias '{a}' already registered")
+            _ops[a] = entry
+        return f
+
+    if fn is not None:
+        return _do(fn)
+    return _do
+
+
+def get(name):
+    if name not in _ops:
+        raise MXNetError(f"unknown operator '{name}'")
+    return _ops[name]
+
+
+def exists(name):
+    return name in _ops
+
+
+def list_ops():
+    return sorted(_ops)
+
+
+def canonical_items():
+    """(name, entry) pairs excluding alias duplicates."""
+    seen = set()
+    for k, v in _ops.items():
+        if id(v) not in seen:
+            seen.add(id(v))
+            yield v.name, v

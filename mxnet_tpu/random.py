@@ -1,0 +1,135 @@
+"""Random number handling.
+
+Ref: python/mxnet/random.py + src/resource.cc (kRandom resources) and
+MXNET_TEST_SEED conventions.
+
+TPU-native design: a global counter-based PRNG built on JAX's splittable
+threefry keys.  ``seed(s)`` resets the base key; every random op draws
+``fold_in(base, counter++)`` so results are deterministic given the seed
+yet independent per call — the functional analogue of MXNet's per-device
+mshadow RandomStream.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+from . import engine
+from .base import getenv
+
+_lock = threading.Lock()
+_base_key = None
+_counter = 0
+
+
+def seed(seed_state=None, ctx="all"):
+    """Seed the global generators (ref: mx.random.seed)."""
+    global _base_key, _counter
+    if seed_state is None:
+        seed_state = np.random.randint(0, 2**31 - 1)
+    with _lock:
+        _base_key = jax.random.PRNGKey(int(seed_state))
+        _counter = 0
+
+
+def next_key():
+    """Draw a fresh PRNG key (traced arg to random ops)."""
+    global _base_key, _counter
+    with _lock:
+        if _base_key is None:
+            s = getenv("TEST_SEED", None, int)
+            _base_key = jax.random.PRNGKey(
+                int(s) if s is not None else np.random.randint(0, 2**31 - 1))
+        k = jax.random.fold_in(_base_key, _counter)
+        _counter += 1
+    return k
+
+
+# --- eager sampling namespace (mx.random / mx.nd.random) -------------------
+
+
+def _sample(fn_name, shape, dtype, ctx, **kw):
+    from .context import current_context
+    from .ndarray.ndarray import NDArray
+
+    shape = (shape,) if isinstance(shape, int) else tuple(shape or ())
+    fn = getattr(jax.random, fn_name)
+    arr = fn(next_key(), shape=shape, **kw)
+    if dtype is not None:
+        arr = arr.astype(dtype)
+    elif arr.dtype == jax.numpy.float64:
+        arr = arr.astype(jax.numpy.float32)
+    return NDArray(engine.track(arr), ctx=ctx or current_context())
+
+
+def uniform(low=0.0, high=1.0, shape=(1,), dtype=None, ctx=None, **kw):
+    out = _sample("uniform", shape, dtype, ctx,
+                  minval=float(low), maxval=float(high))
+    return out
+
+
+def normal(loc=0.0, scale=1.0, shape=(1,), dtype=None, ctx=None, **kw):
+    out = _sample("normal", shape, dtype, ctx)
+    return out * scale + loc
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype=None, ctx=None):
+    return normal(loc, scale, shape or (1,), dtype=dtype, ctx=ctx)
+
+
+def randint(low, high=None, shape=(1,), dtype="int32", ctx=None, **kw):
+    if high is None:
+        low, high = 0, low
+    return _sample("randint", shape, dtype, ctx,
+                   minval=int(low), maxval=int(high))
+
+
+def poisson(lam=1.0, shape=(1,), dtype=None, ctx=None, **kw):
+    return _sample("poisson", shape, dtype or "float32", ctx, lam=float(lam))
+
+
+def exponential(scale=1.0, shape=(1,), dtype=None, ctx=None, **kw):
+    return _sample("exponential", shape, dtype, ctx) * scale
+
+
+def gamma(alpha=1.0, beta=1.0, shape=(1,), dtype=None, ctx=None, **kw):
+    return _sample("gamma", shape, dtype, ctx, a=float(alpha)) * beta
+
+
+def bernoulli(p=0.5, shape=(1,), dtype=None, ctx=None):
+    return _sample("bernoulli", shape, dtype or "float32", ctx, p=float(p))
+
+
+def multinomial(data, shape=(), get_prob=False, dtype="int32", **kw):
+    """Sample from categorical distributions (ref: mx.nd.random.multinomial)."""
+    import jax.numpy as jnp
+
+    from .ndarray.ndarray import NDArray, _wrap
+
+    logits = jnp.log(jnp.clip(data._data, 1e-30, None))
+    n = int(np.prod(shape)) if shape else 1
+    keys = jax.random.split(next_key(), n) if n > 1 else [next_key()]
+    samples = jnp.stack([jax.random.categorical(k, logits, axis=-1)
+                         for k in keys], axis=-1)
+    if not shape:
+        samples = samples[..., 0]
+    out = _wrap(engine.track(samples.astype(dtype)))
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1),
+            samples[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        return out, _wrap(engine.track(lp))
+    return out
+
+
+def shuffle(data, **kw):
+    perm = jax.random.permutation(next_key(), data.shape[0])
+    return data.take(_nd().array(perm, dtype="int32"), axis=0)
+
+
+def _nd():
+    from . import ndarray as nd
+
+    return nd

@@ -1,0 +1,2 @@
+"""Utility subsystems: serialization, download, misc helpers."""
+from . import serialization  # noqa: F401

@@ -1,0 +1,81 @@
+"""NDArray (de)serialization.
+
+Ref: src/ndarray/ndarray.cc NDArray::Save/Load over dmlc::Stream — a
+binary container holding either a list of arrays or a name->array dict
+(the .params file format used by save_parameters/export/do_checkpoint).
+
+Format: little-endian; magic ``MXTPU1\\n`` then a JSON manifest
+(names, shapes, dtypes, byte offsets) followed by raw buffers.  The
+user-facing API (``nd.save/nd.load``, name dicts with ``arg:``/``aux:``
+prefixes) matches the reference exactly even though the container bytes
+differ (the reference's dmlc binary layout was not observable — see
+SURVEY.md provenance note).
+"""
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as np
+
+from ..base import MXNetError
+
+_MAGIC = b"MXTPU1\n"
+
+
+def _to_numpy(arr):
+    from ..ndarray.ndarray import NDArray
+
+    if isinstance(arr, NDArray):
+        return arr.asnumpy()
+    return np.asarray(arr)
+
+
+def save_ndarrays(fname, data):
+    """data: list of NDArray or dict str->NDArray (ref: mx.nd.save)."""
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [_to_numpy(v) for v in data.values()]
+    elif isinstance(data, (list, tuple)):
+        names = None
+        arrays = [_to_numpy(v) for v in data]
+    else:
+        from ..ndarray.ndarray import NDArray
+
+        if isinstance(data, NDArray):
+            names, arrays = None, [_to_numpy(data)]
+        else:
+            raise MXNetError(f"cannot save {type(data)}")
+
+    manifest = {"names": names,
+                "tensors": [{"shape": list(a.shape), "dtype": str(a.dtype)}
+                            for a in arrays]}
+    mbytes = json.dumps(manifest).encode()
+    with open(fname, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<Q", len(mbytes)))
+        f.write(mbytes)
+        for a in arrays:
+            f.write(np.ascontiguousarray(a).tobytes())
+
+
+def load_ndarrays(fname):
+    from ..ndarray.ndarray import array
+
+    with open(fname, "rb") as f:
+        magic = f.read(len(_MAGIC))
+        if magic != _MAGIC:
+            raise MXNetError(f"{fname}: not an NDArray file (bad magic)")
+        (mlen,) = struct.unpack("<Q", f.read(8))
+        manifest = json.loads(f.read(mlen).decode())
+        arrays = []
+        for t in manifest["tensors"]:
+            dt = np.dtype(t["dtype"])
+            n = int(np.prod(t["shape"])) if t["shape"] else 1
+            buf = f.read(n * dt.itemsize)
+            arrays.append(
+                array(np.frombuffer(buf, dtype=dt).reshape(t["shape"]),
+                      dtype=dt))
+    if manifest["names"] is None:
+        return arrays
+    return dict(zip(manifest["names"], arrays))

@@ -1,0 +1,26 @@
+"""Test config: force an 8-device virtual CPU mesh BEFORE jax imports.
+
+Ref test strategy (SURVEY.md §4): the reference fakes a cluster with the
+dmlc 'local' launcher and uses CPU as the oracle device; the modern
+analogue is xla_force_host_platform_device_count=8 on the CPU backend,
+giving every test a multi-device SPMD environment without TPU hardware.
+"""
+import os
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+# NOTE: this box's sitecustomize pins JAX_PLATFORMS=axon (real TPU tunnel);
+# tests must run on the virtual 8-device CPU mesh, so override via jax.config
+# (env alone is not enough — the axon plugin re-registers itself).
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("MXTPU_TEST_SEED", "17")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,171 @@
+"""Autograd tests (ref: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [2, 4, 6])
+
+
+def test_chain_grad():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = (y * x).sum()  # z = 2x^2, dz/dx = 4x
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), 4 * np.array([[1, 2], [3, 4]]))
+
+
+def test_head_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([10.0, 100.0]))
+    assert np.allclose(x.grad.asnumpy(), [30, 300])
+
+
+def test_multi_path_accumulation():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        a = x * 3
+        b = x * 5
+        c = a + b
+    c.backward()
+    assert np.allclose(x.grad.asnumpy(), [8.0])
+
+
+def test_matmul_grad():
+    a_np = np.random.rand(3, 4).astype(np.float32)
+    b_np = np.random.rand(4, 5).astype(np.float32)
+    a, b = nd.array(a_np), nd.array(b_np)
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = nd.dot(a, b).sum()
+    c.backward()
+    assert np.allclose(a.grad.asnumpy(), b_np.sum(axis=1)[None, :].repeat(3, 0),
+                       atol=1e-5)
+    assert np.allclose(b.grad.asnumpy(), a_np.sum(axis=0)[:, None].repeat(5, 1),
+                       atol=1e-5)
+
+
+def test_grad_not_recording_outside():
+    x = nd.array([1.0])
+    x.attach_grad()
+    y = x * 2  # outside record: no tape
+    with autograd.record():
+        z = x * 3
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), [3.0])
+
+
+def test_pause():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            w = x * 100  # not recorded
+        z = y + w
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), [2.0])
+
+
+def test_is_training_modes():
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_training()
+        assert autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+
+
+def test_detach():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = y.detach() * x  # grad only flows through the second factor
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), [2.0])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = x * 2
+        y.backward()
+    assert np.allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_autograd_grad_api():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+    (g,) = autograd.grad(y, x)
+    assert np.allclose(g.asnumpy(), [12.0])
+
+
+def test_softmax_output_bwd():
+    x = nd.array(np.random.rand(4, 3).astype(np.float32))
+    label = nd.array([0, 1, 2, 1])
+    x.attach_grad()
+    with autograd.record():
+        p = nd.SoftmaxOutput(x, label)
+    p.backward()
+    p_np = np.exp(x.asnumpy()) / np.exp(x.asnumpy()).sum(1, keepdims=True)
+    oh = np.eye(3, dtype=np.float32)[label.asnumpy().astype(int)]
+    assert np.allclose(x.grad.asnumpy(), (p_np - oh) / 4, atol=1e-5)
+
+
+def test_custom_function():
+    class MulConst(autograd.Function):
+        def forward(self, x):
+            return x * 7
+
+        def backward(self, dy):
+            return dy * 7
+
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    f = MulConst()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    assert np.allclose(y.asnumpy(), [7, 14])
+    assert np.allclose(x.grad.asnumpy(), [7, 7])
+
+
+def test_mark_variables():
+    x = nd.array([1.0])
+    g = nd.zeros((1,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = x * 4
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [4.0])
+
+
+def test_dropout_train_vs_predict():
+    x = nd.ones((100, 100))
+    with autograd.record():
+        y = nd.Dropout(x, p=0.5)
+    frac_zero = (y.asnumpy() == 0).mean()
+    assert 0.3 < frac_zero < 0.7
+    y2 = nd.Dropout(x, p=0.5)  # not training: identity
+    assert np.allclose(y2.asnumpy(), 1.0)

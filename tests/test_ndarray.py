@@ -1,0 +1,193 @@
+"""NDArray core tests (ref: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_creation_basic():
+    a = nd.zeros((2, 3))
+    assert a.shape == (2, 3)
+    assert a.dtype == np.float32
+    assert np.allclose(a.asnumpy(), 0)
+    b = nd.ones((4,), dtype="int32")
+    assert b.dtype == np.int32
+    c = nd.full((2, 2), 7.5)
+    assert np.allclose(c.asnumpy(), 7.5)
+    d = nd.array([[1, 2], [3, 4]])
+    assert d.shape == (2, 2)
+    e = nd.arange(0, 10, 2)
+    assert np.allclose(e.asnumpy(), [0, 2, 4, 6, 8])
+
+
+def test_float64_np_input_downcast():
+    a = nd.array(np.random.rand(3, 3))  # float64 numpy in
+    assert a.dtype == np.float32
+
+
+def test_arith():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    y = nd.array([[10.0, 20.0], [30.0, 40.0]])
+    assert np.allclose((x + y).asnumpy(), [[11, 22], [33, 44]])
+    assert np.allclose((y - x).asnumpy(), [[9, 18], [27, 36]])
+    assert np.allclose((x * y).asnumpy(), [[10, 40], [90, 160]])
+    assert np.allclose((y / x).asnumpy(), [[10, 10], [10, 10]])
+    assert np.allclose((x + 1).asnumpy(), [[2, 3], [4, 5]])
+    assert np.allclose((1 + x).asnumpy(), [[2, 3], [4, 5]])
+    assert np.allclose((2 - x).asnumpy(), [[1, 0], [-1, -2]])
+    assert np.allclose((x ** 2).asnumpy(), [[1, 4], [9, 16]])
+    assert np.allclose((-x).asnumpy(), [[-1, -2], [-3, -4]])
+    assert np.allclose(abs(nd.array([-1.0, 2.0])).asnumpy(), [1, 2])
+
+
+def test_inplace_arith():
+    x = nd.ones((2, 2))
+    x += 1
+    assert np.allclose(x.asnumpy(), 2)
+    x *= 3
+    assert np.allclose(x.asnumpy(), 6)
+
+
+def test_comparison():
+    x = nd.array([1.0, 2.0, 3.0])
+    y = nd.array([2.0, 2.0, 2.0])
+    assert np.allclose((x > y).asnumpy(), [0, 0, 1])
+    assert np.allclose((x == 2).asnumpy(), [0, 1, 0])
+
+
+def test_matmul_dot():
+    a = nd.array(np.arange(6).reshape(2, 3))
+    b = nd.array(np.arange(12).reshape(3, 4))
+    c = nd.dot(a, b)
+    assert c.shape == (2, 4)
+    assert np.allclose(c.asnumpy(),
+                       np.arange(6).reshape(2, 3) @ np.arange(12).reshape(3, 4))
+
+
+def test_reshape_transpose():
+    x = nd.arange(0, 24).reshape(2, 3, 4)
+    assert x.reshape(6, 4).shape == (6, 4)
+    assert x.reshape((-1,)).shape == (24,)
+    assert x.reshape(0, -1).shape == (2, 12)  # MXNet 0 = copy dim
+    assert x.transpose().shape == (4, 3, 2)
+    assert x.transpose(0, 2, 1).shape == (2, 4, 3)
+    assert x.T.shape == (4, 3, 2)
+    assert x.flatten().shape == (2, 12)
+
+
+def test_reductions():
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    assert np.isclose(x.sum().asscalar(), 66)
+    assert np.allclose(x.sum(axis=0).asnumpy(), [12, 15, 18, 21])
+    assert np.allclose(x.mean(axis=1).asnumpy(), [1.5, 5.5, 9.5])
+    assert x.sum(axis=1, keepdims=True).shape == (3, 1)
+    assert np.isclose(x.max().asscalar(), 11)
+    assert np.isclose(x.min().asscalar(), 0)
+    assert np.isclose(x.norm().asscalar(), np.sqrt((np.arange(12) ** 2).sum()))
+    assert np.allclose(x.argmax(axis=1).asnumpy(), [3, 3, 3])
+
+
+def test_indexing():
+    x = nd.array(np.arange(24, dtype=np.float32).reshape(4, 6))
+    assert np.allclose(x[1].asnumpy(), np.arange(6) + 6)
+    assert np.allclose(x[1:3].asnumpy(),
+                       np.arange(24).reshape(4, 6)[1:3])
+    assert np.isclose(x[2, 3].asscalar(), 15)
+    assert np.allclose(x[:, 2].asnumpy(), [2, 8, 14, 20])
+    # advanced indexing with array
+    idx = nd.array([0, 2], dtype="int32")
+    assert np.allclose(x[idx].asnumpy(), np.arange(24).reshape(4, 6)[[0, 2]])
+
+
+def test_setitem():
+    x = nd.zeros((3, 3))
+    x[1] = 5.0
+    assert np.allclose(x.asnumpy()[1], 5)
+    x[0, 2] = 1.0
+    assert np.isclose(x.asnumpy()[0, 2], 1)
+    x[:, 0] = nd.array([7.0, 8.0, 9.0])
+    assert np.allclose(x.asnumpy()[:, 0], [7, 8, 9])
+
+
+def test_astype_copy():
+    x = nd.array([1.5, 2.5])
+    y = x.astype("int32")
+    assert y.dtype == np.int32
+    z = x.copy()
+    z += 1
+    assert np.allclose(x.asnumpy(), [1.5, 2.5])
+
+
+def test_context():
+    x = nd.zeros((2, 2), ctx=mx.cpu())
+    assert x.context.device_type in ("cpu", "xla")
+    y = x.as_in_context(mx.xla(0))
+    assert y.shape == (2, 2)
+    y2 = x.copyto(mx.xla(1))
+    assert y2.context.device_id == 1
+
+
+def test_wait_async():
+    x = nd.ones((100, 100))
+    y = nd.dot(x, x)
+    y.wait_to_read()
+    nd.waitall()
+    assert np.isclose(y.asnumpy()[0, 0], 100)
+
+
+def test_save_load_list_dict(tmp_path):
+    f = str(tmp_path / "t.params")
+    a, b = nd.ones((2, 2)), nd.arange(0, 4)
+    nd.save(f, [a, b])
+    la, lb = nd.load(f)
+    assert np.allclose(la.asnumpy(), 1) and np.allclose(lb.asnumpy(), [0, 1, 2, 3])
+    nd.save(f, {"arg:w": a, "aux:m": b})
+    d = nd.load(f)
+    assert set(d) == {"arg:w", "aux:m"}
+
+
+def test_concat_stack_split():
+    a, b = nd.ones((2, 3)), nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+    parts = nd.split(nd.arange(0, 12).reshape(2, 6), num_outputs=3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 2)
+
+
+def test_broadcast_ops():
+    x = nd.ones((2, 1, 3))
+    y = nd.ones((1, 4, 3))
+    assert nd.broadcast_add(x, y).shape == (2, 4, 3)
+    assert nd.broadcast_to(nd.ones((1, 3)), shape=(5, 3)).shape == (5, 3)
+    assert nd.broadcast_axis(nd.ones((1, 3)), axis=0, size=4).shape == (4, 3)
+
+
+def test_take_pick_onehot_where():
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    t = nd.take(x, nd.array([0, 2], dtype="int32"), axis=0)
+    assert t.shape == (2, 4)
+    p = nd.pick(x, nd.array([0, 1, 2]), axis=1)
+    assert np.allclose(p.asnumpy(), [0, 5, 10])
+    oh = nd.one_hot(nd.array([0, 2], dtype="int32"), depth=4)
+    assert np.allclose(oh.asnumpy(), [[1, 0, 0, 0], [0, 0, 1, 0]])
+    w = nd.where(nd.array([1.0, 0.0]), nd.array([1.0, 1.0]), nd.array([2.0, 2.0]))
+    assert np.allclose(w.asnumpy(), [1, 2])
+
+
+def test_engine_naive_mode():
+    mx.engine.set_engine_type("NaiveEngine")
+    try:
+        x = nd.ones((4, 4)) * 3
+        assert np.allclose(x.asnumpy(), 3)
+    finally:
+        mx.engine.set_engine_type("ThreadedEngine")
+
+
+def test_iter_len():
+    x = nd.arange(0, 6).reshape(3, 2)
+    rows = list(x)
+    assert len(x) == 3 and len(rows) == 3
+    assert np.allclose(rows[2].asnumpy(), [4, 5])

@@ -1,0 +1,342 @@
+"""Operator numeric checks against NumPy oracles
+(ref: tests/python/unittest/test_operator.py — numpy reference impls +
+finite-difference gradient checking)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def _rand(*shape):
+    return np.random.RandomState(42).rand(*shape).astype(np.float32)
+
+
+def numeric_grad(f, x, eps=1e-3):
+    """Central finite differences of scalar-output f at x."""
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp, xm = x.copy(), x.copy()
+        xp[idx] += eps
+        xm[idx] -= eps
+        g[idx] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def check_grad(op, x_np, analytic_tol=1e-2):
+    x = nd.array(x_np)
+    x.attach_grad()
+    with autograd.record():
+        y = op(x).sum()
+    y.backward()
+    num = numeric_grad(lambda a: float(op(nd.array(a)).sum().asscalar()), x_np)
+    assert np.allclose(x.grad.asnumpy(), num, atol=analytic_tol,
+                       rtol=analytic_tol), (x.grad.asnumpy(), num)
+
+
+@pytest.mark.parametrize("name,np_fn", [
+    ("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt),
+    ("square", np.square), ("tanh", np.tanh), ("sigmoid",
+                                               lambda x: 1 / (1 + np.exp(-x))),
+    ("relu", lambda x: np.maximum(x, 0)),
+])
+def test_unary_forward(name, np_fn):
+    x_np = _rand(3, 4) + 0.5
+    y = getattr(nd, name)(nd.array(x_np))
+    assert np.allclose(y.asnumpy(), np_fn(x_np), atol=1e-5)
+
+
+@pytest.mark.parametrize("name", ["exp", "log", "sqrt", "square", "tanh",
+                                  "sigmoid"])
+def test_unary_grad(name):
+    check_grad(getattr(nd, name), _rand(2, 3) + 0.5)
+
+
+def test_fully_connected():
+    x, w, b = _rand(4, 10), _rand(5, 10), _rand(5)
+    out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b),
+                            num_hidden=5)
+    assert np.allclose(out.asnumpy(), x @ w.T + b, atol=1e-5)
+    out2 = nd.FullyConnected(nd.array(x), nd.array(w), no_bias=True,
+                             num_hidden=5)
+    assert np.allclose(out2.asnumpy(), x @ w.T, atol=1e-5)
+
+
+def test_fully_connected_flatten():
+    x = _rand(2, 3, 4)
+    w = _rand(6, 12)
+    out = nd.FullyConnected(nd.array(x), nd.array(w), no_bias=True,
+                            num_hidden=6)
+    assert np.allclose(out.asnumpy(), x.reshape(2, 12) @ w.T, atol=1e-5)
+
+
+def test_convolution_vs_naive():
+    x = _rand(2, 3, 8, 8)
+    w = _rand(4, 3, 3, 3)
+    b = _rand(4)
+    out = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                         kernel=(3, 3), num_filter=4)
+    # naive conv oracle
+    ref = np.zeros((2, 4, 6, 6), np.float32)
+    for n in range(2):
+        for f in range(4):
+            for i in range(6):
+                for j in range(6):
+                    ref[n, f, i, j] = (x[n, :, i:i + 3, j:j + 3] * w[f]).sum() + b[f]
+    assert np.allclose(out.asnumpy(), ref, atol=1e-4)
+
+
+def test_convolution_stride_pad_group():
+    x = _rand(1, 4, 8, 8)
+    w = _rand(8, 2, 3, 3)
+    out = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                         num_filter=8, stride=(2, 2), pad=(1, 1),
+                         num_group=2, no_bias=True)
+    assert out.shape == (1, 8, 4, 4)
+
+
+def test_conv_grad():
+    x_np, w_np = _rand(1, 2, 5, 5), _rand(3, 2, 3, 3)
+    w = nd.array(w_np)
+
+    def op(x):
+        return nd.Convolution(x, w, kernel=(3, 3), num_filter=3, no_bias=True)
+
+    check_grad(op, x_np)
+
+
+def test_pooling():
+    x = _rand(1, 1, 4, 4)
+    mx_max = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                        pool_type="max")
+    ref = x.reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5))
+    assert np.allclose(mx_max.asnumpy(), ref)
+    mx_avg = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                        pool_type="avg")
+    ref_avg = x.reshape(1, 1, 2, 2, 2, 2).mean(axis=(3, 5))
+    assert np.allclose(mx_avg.asnumpy(), ref_avg, atol=1e-6)
+    glob = nd.Pooling(nd.array(x), global_pool=True, pool_type="avg")
+    assert glob.shape == (1, 1, 1, 1)
+    assert np.isclose(glob.asscalar(), x.mean(), atol=1e-6)
+
+
+def test_pooling_full_convention():
+    x = _rand(1, 1, 5, 5)
+    out = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                     pool_type="max", pooling_convention="full")
+    assert out.shape == (1, 1, 3, 3)
+
+
+def test_batchnorm_train_eval():
+    x = _rand(4, 3, 2, 2) * 5
+    gamma, beta = np.ones(3, np.float32), np.zeros(3, np.float32)
+    mm, mv = np.zeros(3, np.float32), np.ones(3, np.float32)
+    g, b_, m, v = (nd.array(gamma), nd.array(beta), nd.array(mm), nd.array(mv))
+    with autograd.record():
+        y = nd.BatchNorm(nd.array(x), g, b_, m, v, fix_gamma=False,
+                         momentum=0.9, eps=1e-5)
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    ref = (x - mean[None, :, None, None]) / np.sqrt(var[None, :, None, None]
+                                                    + 1e-5)
+    assert np.allclose(y.asnumpy(), ref, atol=1e-4)
+    # moving stats updated in place
+    assert np.allclose(m.asnumpy(), 0.1 * mean, atol=1e-5)
+    assert np.allclose(v.asnumpy(), 0.9 + 0.1 * var, atol=1e-5)
+    # eval mode uses moving stats
+    y2 = nd.BatchNorm(nd.array(x), g, b_, m, v, fix_gamma=False, eps=1e-5)
+    ref2 = (x - m.asnumpy()[None, :, None, None]) / np.sqrt(
+        v.asnumpy()[None, :, None, None] + 1e-5)
+    assert np.allclose(y2.asnumpy(), ref2, atol=1e-4)
+
+
+def test_layernorm():
+    x = _rand(2, 5)
+    g, b = np.ones(5, np.float32), np.zeros(5, np.float32)
+    y = nd.LayerNorm(nd.array(x), nd.array(g), nd.array(b))
+    ref = (x - x.mean(1, keepdims=True)) / np.sqrt(x.var(1, keepdims=True)
+                                                   + 1e-5)
+    assert np.allclose(y.asnumpy(), ref, atol=1e-5)
+
+
+def test_softmax_ops():
+    x = _rand(3, 4)
+    s = nd.softmax(nd.array(x), axis=-1)
+    e = np.exp(x - x.max(-1, keepdims=True))
+    assert np.allclose(s.asnumpy(), e / e.sum(-1, keepdims=True), atol=1e-6)
+    ls = nd.log_softmax(nd.array(x), axis=-1)
+    assert np.allclose(ls.asnumpy(), np.log(e / e.sum(-1, keepdims=True)),
+                       atol=1e-5)
+
+
+def test_activation_op():
+    x = _rand(2, 3) - 0.5
+    for act, fn in [("relu", lambda v: np.maximum(v, 0)),
+                    ("tanh", np.tanh),
+                    ("sigmoid", lambda v: 1 / (1 + np.exp(-v)))]:
+        y = nd.Activation(nd.array(x), act_type=act)
+        assert np.allclose(y.asnumpy(), fn(x), atol=1e-5)
+
+
+def test_leaky_relu_variants():
+    x = nd.array([-1.0, 1.0])
+    y = nd.LeakyReLU(x, act_type="leaky", slope=0.1)
+    assert np.allclose(y.asnumpy(), [-0.1, 1.0], atol=1e-6)
+    e = nd.LeakyReLU(x, act_type="elu", slope=1.0)
+    assert np.allclose(e.asnumpy(), [np.expm1(-1), 1.0], atol=1e-6)
+
+
+def test_embedding():
+    w = _rand(10, 4)
+    idx = nd.array([1, 3, 1], dtype="int32")
+    out = nd.Embedding(idx, nd.array(w), input_dim=10, output_dim=4)
+    assert np.allclose(out.asnumpy(), w[[1, 3, 1]])
+
+
+def test_batch_dot():
+    a, b = _rand(2, 3, 4), _rand(2, 4, 5)
+    out = nd.batch_dot(nd.array(a), nd.array(b))
+    assert np.allclose(out.asnumpy(), a @ b, atol=1e-5)
+    out_t = nd.batch_dot(nd.array(a), nd.array(_rand(2, 5, 4)),
+                         transpose_b=True)
+    assert out_t.shape == (2, 3, 5)
+
+
+def test_topk_sort():
+    x = nd.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+    idx = nd.topk(x, k=2)
+    assert np.allclose(idx.asnumpy(), [[0, 2], [1, 2]])
+    both = nd.topk(x, k=1, ret_typ="both")
+    assert np.allclose(both[0].asnumpy(), [[3], [5]])
+    s = nd.sort(x, axis=1)
+    assert np.allclose(s.asnumpy(), [[1, 2, 3], [0, 4, 5]])
+
+
+def test_sequence_ops():
+    # (seq, batch, feat)
+    x = nd.array(np.arange(24, dtype=np.float32).reshape(4, 2, 3))
+    slen = nd.array([2, 4])
+    m = nd.SequenceMask(x, slen, use_sequence_length=True, value=-1.0)
+    out = m.asnumpy()
+    assert np.allclose(out[2:, 0], -1)
+    assert np.allclose(out[:, 1], x.asnumpy()[:, 1])
+    last = nd.SequenceLast(x, slen, use_sequence_length=True)
+    assert np.allclose(last.asnumpy()[0], x.asnumpy()[1, 0])
+    assert np.allclose(last.asnumpy()[1], x.asnumpy()[3, 1])
+    rev = nd.SequenceReverse(x, slen, use_sequence_length=True)
+    assert np.allclose(rev.asnumpy()[0, 0], x.asnumpy()[1, 0])
+    assert np.allclose(rev.asnumpy()[3, 0], x.asnumpy()[3, 0])
+
+
+def test_rnn_op_lstm_shapes():
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    T, N, I, H, L = 5, 3, 4, 6, 2
+    psize = rnn_param_size(L, I, H, "lstm")
+    params = nd.random.uniform(-0.1, 0.1, shape=(psize,))
+    h0 = nd.zeros((L, N, H))
+    c0 = nd.zeros((L, N, H))
+    out, hn, cn = nd.RNN(nd.random.uniform(shape=(T, N, I)), params, h0, c0,
+                         state_size=H, num_layers=L, mode="lstm")
+    assert out.shape == (T, N, H)
+    assert hn.shape == (L, N, H) and cn.shape == (L, N, H)
+
+
+def test_rnn_op_gru_bidirectional():
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    T, N, I, H = 4, 2, 3, 5
+    psize = rnn_param_size(1, I, H, "gru", bidirectional=True)
+    params = nd.random.uniform(-0.1, 0.1, shape=(psize,))
+    h0 = nd.zeros((2, N, H))
+    out, hn = nd.RNN(nd.random.uniform(shape=(T, N, I)), params, h0,
+                     state_size=H, num_layers=1, mode="gru",
+                     bidirectional=True)
+    assert out.shape == (T, N, 2 * H)
+    assert hn.shape == (2, N, H)
+
+
+def test_lstm_matches_manual_cell():
+    """Fused RNN vs hand-rolled LSTM steps (oracle test)."""
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    rng = np.random.RandomState(0)
+    T, N, I, H = 3, 2, 4, 5
+    psize = rnn_param_size(1, I, H, "lstm")
+    p = rng.uniform(-0.5, 0.5, psize).astype(np.float32)
+    x = rng.uniform(-1, 1, (T, N, I)).astype(np.float32)
+    out, hn, cn = nd.RNN(nd.array(x), nd.array(p), nd.zeros((1, N, H)),
+                         nd.zeros((1, N, H)), state_size=H, num_layers=1,
+                         mode="lstm")
+    # manual oracle
+    wi = p[: 4 * H * I].reshape(4 * H, I)
+    wh = p[4 * H * I: 4 * H * I + 4 * H * H].reshape(4 * H, H)
+    bi = p[4 * H * (I + H): 4 * H * (I + H) + 4 * H]
+    bh = p[4 * H * (I + H) + 4 * H:]
+    h = np.zeros((N, H), np.float32)
+    c = np.zeros((N, H), np.float32)
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+
+    for t in range(T):
+        gates = x[t] @ wi.T + bi + h @ wh.T + bh
+        i, f, g, o = np.split(gates, 4, axis=1)
+        c = sig(f) * c + sig(i) * np.tanh(g)
+        h = sig(o) * np.tanh(c)
+    assert np.allclose(out.asnumpy()[-1], h, atol=1e-5)
+    assert np.allclose(cn.asnumpy()[0], c, atol=1e-5)
+
+
+def test_clip_where_pad():
+    x = nd.array([[-2.0, 0.5, 3.0]])
+    assert np.allclose(nd.clip(x, a_min=-1, a_max=1).asnumpy(),
+                       [[-1, 0.5, 1]])
+    p = nd.pad(nd.ones((1, 1, 2, 2)), mode="constant",
+               pad_width=(0, 0, 0, 0, 1, 1, 1, 1), constant_value=9)
+    assert p.shape == (1, 1, 4, 4)
+    assert np.isclose(p.asnumpy()[0, 0, 0, 0], 9)
+
+
+def test_gather_scatter_nd():
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    idx = nd.array([[0, 1], [2, 0]], dtype="int32")  # (2 index dims, 2 pts)
+    g = nd.gather_nd(x, idx)
+    assert np.allclose(g.asnumpy(), [2.0, 3.0])
+    s = nd.scatter_nd(nd.array([1.0, 5.0]), idx, shape=(2, 3))
+    ref = np.zeros((2, 3))
+    ref[0, 2], ref[1, 0] = 1, 5
+    assert np.allclose(s.asnumpy(), ref)
+
+
+def test_norm_ops():
+    x = _rand(2, 8, 4, 4)
+    il = nd.InstanceNorm(nd.array(x), nd.ones((8,)), nd.zeros((8,)))
+    assert il.shape == x.shape
+    l2 = nd.L2Normalization(nd.array(x))
+    flat = x.reshape(2, -1)
+    ref = x / np.sqrt((flat ** 2).sum(1) + 1e-10)[:, None, None, None]
+    assert np.allclose(l2.asnumpy(), ref, atol=1e-5)
+
+
+def test_random_ops_determinism():
+    mx.random.seed(7)
+    a = nd.random.uniform(shape=(5,)).asnumpy()
+    mx.random.seed(7)
+    b = nd.random.uniform(shape=(5,)).asnumpy()
+    assert np.allclose(a, b)
+    c = nd.random.normal(loc=2.0, scale=0.001, shape=(1000,)).asnumpy()
+    assert abs(c.mean() - 2.0) < 0.01
+
+
+def test_cast_stop_gradient():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.stop_gradient(x * 2) + x
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [1.0])
+    assert nd.cast(x, dtype="float16").dtype == np.float16
