@@ -99,7 +99,8 @@ def invoke(fn, *args, jit_compile=True, nondiff=False, **kwargs):
     if autograd.is_recording() and not nondiff:
         in_nds = [a for a in args if isinstance(a, NDArray)]
         if any(a._in_graph or a._grad is not None for a in in_nds):
-            autograd._record(fn, kwargs, args, raws, out_nds)
+            autograd._record(fn, kwargs, args, raws, out_nds,
+                             out_is_tuple=multi)
 
     return tuple(out_nds) if multi else out_nds[0]
 
@@ -115,6 +116,14 @@ def eval_shape(fn, arg_shapes_dtypes, **kwargs):
 def clear_caches():
     _jit_cache.clear()
     _vjp_cache.clear()
+
+
+def evict(fn):
+    """Drop all cached executables for one fn (used when a CachedOp is
+    released, so discarded hybridized models don't pin memory forever)."""
+    for cache in (_jit_cache, _vjp_cache):
+        for key in [k for k in cache if k[0] is fn]:
+            del cache[key]
 
 
 def to_numpy_dtype(dtype):

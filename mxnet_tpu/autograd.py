@@ -34,18 +34,22 @@ def _st():
 
 
 class _Node:
-    __slots__ = ("fn", "kwargs", "in_nds", "in_raws", "out_nds", "custom_vjp")
+    __slots__ = ("fn", "kwargs", "in_nds", "in_raws", "out_nds", "custom_vjp",
+                 "out_is_tuple")
 
-    def __init__(self, fn, kwargs, in_nds, in_raws, out_nds, custom_vjp=None):
+    def __init__(self, fn, kwargs, in_nds, in_raws, out_nds, custom_vjp=None,
+                 out_is_tuple=False):
         self.fn = fn
         self.kwargs = kwargs
         self.in_nds = in_nds      # NDArray inputs (graph edges)
         self.in_raws = in_raws    # raw buffers at record time (version pin)
         self.out_nds = out_nds
         self.custom_vjp = custom_vjp
+        self.out_is_tuple = out_is_tuple  # fn returned a tuple (even len 1)
 
 
-def _record(fn, kwargs, args, raws, out_nds, custom_vjp=None):
+def _record(fn, kwargs, args, raws, out_nds, custom_vjp=None,
+            out_is_tuple=False):
     """Record one op.  in_nds is aligned 1:1 with the op's positional args
     (None placeholder for non-NDArray args) so the VJP applier can be
     called with the exact arg list the forward saw."""
@@ -55,7 +59,8 @@ def _record(fn, kwargs, args, raws, out_nds, custom_vjp=None):
     in_raws = list(raws)
     for o in out_nds:
         o._in_graph = True
-    _st().tape.append(_Node(fn, kwargs, in_nds, in_raws, out_nds, custom_vjp))
+    _st().tape.append(_Node(fn, kwargs, in_nds, in_raws, out_nds, custom_vjp,
+                            out_is_tuple))
 
 
 # ---------------------------------------------------------------------------
@@ -174,7 +179,7 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
         if node.custom_vjp is not None:
             in_cts = node.custom_vjp(node.in_raws, out_cts)
         else:
-            multi = len(node.out_nds) > 1
+            multi = node.out_is_tuple or len(node.out_nds) > 1
             applier = _imperative.get_vjp(node.fn, node.kwargs)
             in_cts = applier(
                 tuple(node.in_raws),

@@ -37,15 +37,24 @@ class Context:
     def device_type(self):
         return self.devtype2str[self.device_typeid]
 
+    def _resolved(self):
+        """Identity = the underlying jax.Device (so xla(1) == cpu(1) on a
+        CPU-only host where both name the same physical device)."""
+        dev = getattr(self, "_dev_cache", None)
+        if dev is None:
+            try:
+                dev = self.jax_device()
+            except Exception:
+                dev = (self.device_typeid, self.device_id)
+            self._dev_cache = dev
+        return dev
+
     def __hash__(self):
-        return hash((self.device_typeid, self.device_id))
+        return hash(self._resolved())
 
     def __eq__(self, other):
-        return (
-            isinstance(other, Context)
-            and self.device_typeid == other.device_typeid
-            and self.device_id == other.device_id
-        )
+        return (isinstance(other, Context)
+                and self._resolved() == other._resolved())
 
     def __repr__(self):
         return f"{self.device_type}({self.device_id})"

@@ -1,0 +1,19 @@
+"""Gluon frontend (ref: python/mxnet/gluon/)."""
+from .block import Block, HybridBlock, CachedOp  # noqa: F401
+from .parameter import (Parameter, ParameterDict, Constant,  # noqa: F401
+                        DeferredInitializationError)
+from .trainer import Trainer  # noqa: F401
+from . import nn  # noqa: F401
+from . import loss  # noqa: F401
+from . import utils  # noqa: F401
+
+
+def __getattr__(name):
+    # rnn / data / model_zoo are heavier; load lazily
+    if name in ("rnn", "data", "model_zoo"):
+        import importlib
+
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'mxnet_tpu.gluon' has no attribute {name!r}")

@@ -1,0 +1,426 @@
+"""Gluon Block / HybridBlock / CachedOp-equivalent.
+
+Ref: python/mxnet/gluon/block.py (Block, HybridBlock, SymbolBlock) and
+src/imperative/cached_op.{h,cc} (the hybridization backend).
+
+TPU-native design (the BASELINE north star): ``hybridize()`` does NOT
+build an nnvm graph + per-node engine pushes.  Instead the block's whole
+forward is captured as a *pure JAX function* of (rng_key, params...,
+inputs...) and compiled by XLA into ONE computation — the eager op
+wrappers are themselves jax-traceable, so capture is simply re-running
+the eager path under ``jax.jit`` tracing.  Backward of a hybridized call
+is a single tape node whose VJP is the whole-graph XLA gradient (the
+CachedOp::Backward equivalent).  static_alloc/static_shape/bulking knobs
+are accepted for API parity and ignored: XLA's memory planner subsumes
+them (SURVEY §3.2 "TPU translation").
+
+Mutable aux state (BatchNorm moving stats) rides as extra outputs of the
+compiled graph and is written back to the Parameters after each call.
+"""
+from __future__ import annotations
+
+import re
+import threading
+
+from .. import autograd
+from .. import random as _random
+from .._imperative import invoke
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..ndarray import ndarray as _nd_mod
+from ..ndarray.ndarray import NDArray, _wrap
+from .parameter import (DeferredInitializationError, Parameter,
+                        ParameterDict)
+
+_naming = threading.local()
+
+
+class _BlockScope:
+    """Auto-naming: dense0_, conv1_, ... (ref: _BlockScope in block.py)."""
+
+    _counters = {}
+    _lock = threading.Lock()
+
+    @classmethod
+    def create_prefix(cls, hint):
+        with cls._lock:
+            i = cls._counters.get(hint, 0)
+            cls._counters[hint] = i + 1
+        return f"{hint}{i}_"
+
+
+class Block:
+    """Base container for layers & parameters (ref: gluon.Block)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix = (prefix if prefix is not None
+                        else _BlockScope.create_prefix(
+                            type(self).__name__.lower()))
+        self._params = ParameterDict(self._prefix, shared=params)
+        self._children = {}
+        self._reg_params = {}
+        self._forward_hooks = []
+        self._forward_pre_hooks = []
+
+    # -- attribute registration --------------------------------------------
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = getattr(self, "_children", None)
+            if existing is not None:
+                existing[name] = value
+        elif isinstance(value, Parameter):
+            reg = getattr(self, "_reg_params", None)
+            if reg is not None:
+                reg[name] = value
+        super().__setattr__(name, value)
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._prefix[:-1] if self._prefix.endswith("_") else self._prefix
+
+    @property
+    def params(self):
+        return self._params
+
+    def name_scope(self):
+        class _NS:
+            def __enter__(self_ns):
+                return self_ns
+
+            def __exit__(self_ns, *a):
+                return False
+
+        return _NS()
+
+    # -- params -------------------------------------------------------------
+
+    def collect_params(self, select=None):
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self._params)
+        else:
+            pat = re.compile(select)
+            ret.update({k: v for k, v in self._params.items()
+                        if pat.match(k)})
+        for child in self._children.values():
+            ret.update(child.collect_params(select))
+        return ret
+
+    def _ordered_params(self):
+        """Stable (name, Parameter) order for graph capture."""
+        return list(self.collect_params().items())
+
+    def register_child(self, block, name=None):
+        """Register a child under an explicit structural name."""
+        self._children[name if name is not None else
+                       str(len(self._children))] = block
+        return block
+
+    def _collect_params_with_prefix(self, prefix=""):
+        """Structural name -> Parameter (ref: Block._collect_params_with_
+        prefix — the naming used by save_parameters so an identical
+        architecture loads regardless of auto-prefix counters)."""
+        if prefix:
+            prefix += "."
+        ret = {prefix + k: v for k, v in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        self.collect_params().initialize(init=init, ctx=ctx,
+                                         force_reinit=force_reinit)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for p in self._params.values():
+            p.cast(dtype)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    # -- save / load --------------------------------------------------------
+
+    def save_parameters(self, filename, deduplicate=False):
+        """Ref: Block.save_parameters — structural name->array dict, so an
+        identically-built net loads regardless of auto-prefix counters."""
+        params = self._collect_params_with_prefix()
+        _nd_mod.save(filename, {k: v.data() for k, v in params.items()
+                                if v._data is not None})
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current"):
+        loaded = _nd_mod.load(filename)
+        params = self._collect_params_with_prefix()
+        if loaded and params and not any(k in params for k in loaded):
+            # fall back to full-prefix names (collect_params keys)
+            params = dict(self.collect_params().items())
+        for name, p in params.items():
+            if name in loaded:
+                p.shape = loaded[name].shape
+                if p._data is None:
+                    if p._deferred_init is not None:
+                        p._finish_deferred_init()
+                    else:
+                        p.initialize(ctx=ctx or [current_context()])
+                p.set_data(loaded[name])
+            elif not allow_missing:
+                raise MXNetError(f"missing parameter {name} in {filename}")
+        if not ignore_extra:
+            extra = set(loaded) - set(params)
+            if extra:
+                raise MXNetError(f"extra parameters in {filename}: {extra}")
+
+    # legacy aliases (ref: save_params/load_params pre-1.4 names)
+    save_params = save_parameters
+
+    def load_params(self, filename, ctx=None, **kwargs):
+        self.load_parameters(filename, ctx=ctx, **kwargs)
+
+    # -- hooks --------------------------------------------------------------
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+        return hook
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+        return hook
+
+    # -- call ---------------------------------------------------------------
+
+    def __call__(self, *args):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        lines = [f"{type(self).__name__}("]
+        for name, child in self._children.items():
+            lines.append(f"  ({name}): {type(child).__name__}")
+        lines.append(")")
+        return "\n".join(lines)
+
+    def __repr__(self):
+        mods = "\n".join(f"  ({k}): {type(v).__name__}"
+                         for k, v in self._children.items())
+        return f"{type(self).__name__}(\n{mods}\n)"
+
+
+# ---------------------------------------------------------------------------
+# CachedOp equivalent
+
+
+_tracing = threading.local()
+
+
+def is_tracing():
+    return getattr(_tracing, "active", False)
+
+
+class CachedOp:
+    """Compiles a HybridBlock's forward to one XLA computation.
+
+    Ref: src/imperative/cached_op.cc — but the node-loop + memory planner
+    is replaced by jax.jit of the re-run eager path (SURVEY §3.2).
+    """
+
+    def __init__(self, block):
+        self.block = block
+        self._fns = {}   # train_flag -> pure graph fn
+        self._meta = {}  # train_flag -> (n_outs, aux_param_names, multi)
+
+    def release(self):
+        """Evict this op's compiled executables from the global caches."""
+        from .. import _imperative
+
+        for fn in self._fns.values():
+            _imperative.evict(fn)
+        self._fns.clear()
+
+    def __del__(self):
+        try:
+            self.release()
+        except Exception:
+            pass
+
+    def _build_fn(self, train):
+        block = self.block
+        cached = self
+
+        def _cached_graph_fn(key, *arrays, _n_params):
+            params = [p for _, p in block._ordered_params()]
+            param_raws = arrays[:_n_params]
+            input_raws = arrays[_n_params:]
+            wrappers = [_wrap(r) for r in param_raws]
+            inputs = [_wrap(r) for r in input_raws]
+            old_traced = [p._traced_value for p in params]
+            prev_active = getattr(_tracing, "active", False)
+            _tracing.active = True
+            tok = _random.push_trace_key(key)
+            try:
+                for p, w in zip(params, wrappers):
+                    p._traced_value = w
+                with autograd.pause(train_mode=train):
+                    out = block.forward(*inputs)
+            finally:
+                _random.pop_trace_key(tok)
+                _tracing.active = prev_active
+                for p, old in zip(params, old_traced):
+                    p._traced_value = old
+            multi = isinstance(out, (tuple, list))
+            outs = list(out) if multi else [out]
+            # aux side effects (BatchNorm moving stats): wrapper buffers
+            # replaced in place during forward
+            aux_names, aux_raws = [], []
+            for (name, p), w, r in zip(block._ordered_params(), wrappers,
+                                       param_raws):
+                if w._data is not r:
+                    aux_names.append(name)
+                    aux_raws.append(w._data)
+            cached._meta[train] = (len(outs), aux_names, multi)
+            return tuple(o._data for o in outs) + tuple(aux_raws)
+
+        return _cached_graph_fn
+
+    def __call__(self, *inputs):
+        train = autograd.is_training()
+        fn = self._fns.get(train)
+        if fn is None:
+            fn = self._build_fn(train)
+            self._fns[train] = fn
+        named = self.block._ordered_params()
+        ctx = None
+        for i in inputs:
+            if isinstance(i, NDArray):
+                ctx = i.context
+                break
+        param_nds = []
+        for _, p in named:
+            try:
+                param_nds.append(p.data(ctx))
+            except MXNetError:
+                param_nds.append(p.data())
+        key_nd = _wrap(_random.next_key())
+        res = invoke(fn, key_nd, *param_nds, *inputs,
+                     _n_params=len(param_nds))
+        if not isinstance(res, tuple):
+            res = (res,)
+        n_outs, aux_names, multi = self._meta[train]
+        outs, auxs = res[:n_outs], res[n_outs:]
+        if aux_names:
+            pdict = dict(named)
+            for name, new in zip(aux_names, auxs):
+                p = pdict[name]
+                target = p.data(ctx) if ctx in (p._data or {}) else p.data()
+                target._data = new._data
+        if multi:
+            return list(outs)
+        return outs[0]
+
+
+class HybridBlock(Block):
+    """Block that can be hybridized into one compiled XLA computation
+    (ref: gluon.HybridBlock)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_op = None
+        self._flags = {}
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._flags = kwargs  # static_alloc/static_shape accepted, unused
+        # only the outermost compiled graph matters; children run inside
+        # the parent's trace (ref: inline_limit semantics)
+        self._clear_cache()
+
+    def _clear_cache(self):
+        if self._cached_op is not None:
+            self._cached_op.release()
+        self._cached_op = None
+        for child in self._children.values():
+            if isinstance(child, HybridBlock):
+                child._clear_cache()
+
+    def cast(self, dtype):
+        self._clear_cache()
+        super().cast(dtype)
+
+    def infer_shape(self, *args):
+        """Complete deferred param shapes from example inputs.  Built-in
+        layers override; container blocks recurse via a dry eager run."""
+        self._deferred_infer_shape(*args)
+
+    def _deferred_infer_shape(self, *args):
+        # generic fallback: run children eagerly until shapes resolve
+        raise DeferredInitializationError(
+            f"{type(self).__name__} has deferred-init parameters and no "
+            "infer_shape; initialize with explicit in_units/in_channels")
+
+    def forward(self, x, *args):
+        if not isinstance(x, NDArray):
+            raise MXNetError("HybridBlock.forward expects NDArray inputs")
+        if self._active and not is_tracing():
+            if self._cached_op is None:
+                # finish any deferred init with one eager probe call
+                try:
+                    self._eager_forward(x, *args)
+                except DeferredInitializationError:
+                    self._try_infer_and_init(x, *args)
+                self._cached_op = CachedOp(self)
+            return self._cached_op(x, *args)
+        return self._eager_forward(x, *args)
+
+    def _eager_forward(self, x, *args):
+        from ..ndarray import ops as F  # eager namespace
+
+        ctx = None
+        if not is_tracing():  # tracers have no concrete device
+            ctx = x.context
+        try:
+            params = {k: p.data(ctx) if (ctx is not None and p._data and
+                                         ctx in p._data) else p.data()
+                      for k, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self._try_infer_and_init(x, *args)
+            params = {k: p.data() for k, p in self._reg_params.items()}
+        return self.hybrid_forward(F, x, *args, **params)
+
+    def _try_infer_and_init(self, x, *args):
+        self.infer_shape(x, *args)
+        for p in self.collect_params().values():
+            if p._deferred_init is not None:
+                p._finish_deferred_init()
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    def export(self, path, epoch=0):
+        """Ref: HybridBlock.export → model-symbol.json + .params."""
+        from ..symbol import export as _export
+
+        return _export.export_block(self, path, epoch)
+
+    def optimize_for(self, x, *args, backend=None, **kwargs):
+        self.hybridize(True)
+        return self(x, *args)

@@ -1,0 +1,374 @@
+"""Gluon Parameter / ParameterDict.
+
+Ref: python/mxnet/gluon/parameter.py — Parameter with deferred shape
+init, per-context replicas, grad_req; ParameterDict with prefix
+namespacing, shared params, save/load.
+
+TPU-native notes: a Parameter holds one NDArray per context; the
+single-context case (the common one — SPMD replication happens at the
+pjit/kvstore layer, not by materializing copies) is just a one-entry
+map.  Deferred init completes when a layer fills the 0-dims from its
+first input.  During hybrid tracing ``data()`` returns the traced
+stand-in set by the CachedOp (see gluon/block.py).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from .. import initializer as init_mod
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..ndarray import ndarray as _nd_mod
+from ..ndarray.ndarray import NDArray
+
+_trace_state = threading.local()
+
+
+class DeferredInitializationError(MXNetError):
+    pass
+
+
+class Parameter:
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None,
+                 allow_deferred_init=False, differentiable=True,
+                 stype="default", grad_stype="default"):
+        self.name = name
+        self._grad_req = grad_req if differentiable else "null"
+        if isinstance(shape, int):
+            shape = (shape,)
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
+        self._data = None  # {Context: NDArray}
+        self._grad_map = None  # {Context: NDArray}
+        self._deferred_init = None  # (init, ctx_list, default_init)
+        self._traced_value = None  # set by CachedOp during graph capture
+
+    # -- shape with merge-of-unknowns (MXNet uses 0 for unknown dims) ------
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        assert len(self._shape) == len(new_shape) and all(
+            s == 0 or s == n for s, n in zip(self._shape, new_shape)), (
+            f"cannot update shape {self._shape} -> {new_shape} for {self.name}")
+        self._shape = tuple(new_shape)
+
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        assert req in ("write", "add", "null"), req
+        self._grad_req = req
+        if req == "null":
+            self._grad_map = None
+        elif self._data is not None and self._grad_map is None:
+            self._init_grad()
+
+    # -- init ---------------------------------------------------------------
+
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        default_init = default_init or init_mod.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        ctx = list(ctx)
+        if self._shape is None or any(s <= 0 for s in self._shape):
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise MXNetError(
+                f"cannot initialize Parameter {self.name}: unknown shape "
+                f"{self._shape} and allow_deferred_init=False")
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_init(self, init, ctx, default_init):
+        initializer = init or self.init or default_init
+        if isinstance(initializer, str):
+            initializer = init_mod.create(initializer)
+        data = _nd_mod.zeros(self._shape, dtype=self.dtype, ctx=ctx[0])
+        initializer(self.name, data)
+        self._data = {c: (data if c == ctx[0] else data.copyto(c))
+                      for c in ctx}
+        self._deferred_init = None
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def _finish_deferred_init(self):
+        if self._deferred_init is None:
+            return
+        if self._shape is None or any(s <= 0 for s in self._shape):
+            raise DeferredInitializationError(
+                f"Parameter {self.name} has unknown shape {self._shape}")
+        init, ctx, default_init = self._deferred_init
+        self._finish_init(init, ctx, default_init)
+
+    def _init_grad(self):
+        self._grad_map = {}
+        for c, d in self._data.items():
+            g = _nd_mod.zeros(d.shape, dtype=d.dtype, ctx=c)
+            self._grad_map[c] = g
+            d._grad = g
+            d._grad_req = self._grad_req
+            d._in_graph = True
+
+    def _check_initialized(self, ctx=None):
+        if self._data is None:
+            if self._deferred_init is not None:
+                raise DeferredInitializationError(
+                    f"Parameter {self.name} has not been initialized yet "
+                    "(deferred); run a forward pass first")
+            raise MXNetError(
+                f"Parameter {self.name} has not been initialized. "
+                "Call .initialize() first")
+        if ctx is not None and ctx not in self._data:
+            raise MXNetError(
+                f"Parameter {self.name} not initialized on {ctx}; "
+                f"it lives on {list(self._data)}")
+
+    # -- access -------------------------------------------------------------
+
+    def data(self, ctx=None):
+        if self._traced_value is not None:
+            return self._traced_value
+        self._check_initialized()
+        if ctx is None:
+            return next(iter(self._data.values()))
+        self._check_initialized(ctx)
+        return self._data[ctx]
+
+    def list_data(self):
+        self._check_initialized()
+        return list(self._data.values())
+
+    def grad(self, ctx=None):
+        if self._grad_map is None:
+            raise MXNetError(
+                f"Parameter {self.name} has no gradient (grad_req="
+                f"{self._grad_req!r} or uninitialized)")
+        # grads are re-bound by backward(); refresh from data holders
+        for c, d in self._data.items():
+            self._grad_map[c] = d._grad
+        if ctx is None:
+            return next(iter(self._grad_map.values()))
+        return self._grad_map[ctx]
+
+    def list_grad(self):
+        self._check_initialized()
+        return [self.grad(c) for c in self._data]
+
+    def list_ctx(self):
+        self._check_initialized()
+        return list(self._data)
+
+    def zero_grad(self):
+        if self._grad_map is None:
+            return
+        for c, d in self._data.items():
+            g = _nd_mod.zeros(d.shape, dtype=d.dtype, ctx=c)
+            d._grad = g
+            self._grad_map[c] = g
+
+    def set_data(self, data):
+        self.shape = data.shape
+        if self._data is None:
+            if self._deferred_init is not None:
+                _, ctx, default_init = self._deferred_init
+                self._finish_init(None, ctx, default_init)
+            else:
+                raise MXNetError(
+                    f"Parameter {self.name}: set_data before initialize()")
+        for c in list(self._data):
+            new = data.copyto(c) if isinstance(data, NDArray) else \
+                _nd_mod.array(data, ctx=c)
+            grad_req = self._grad_req
+            self._data[c] = new
+            if grad_req != "null":
+                g = _nd_mod.zeros(new.shape, dtype=new.dtype, ctx=c)
+                new._grad = g
+                new._grad_req = grad_req
+                new._in_graph = True
+                if self._grad_map is not None:
+                    self._grad_map[c] = g
+
+    def reset_ctx(self, ctx):
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        self._check_initialized()
+        cur = next(iter(self._data.values()))
+        self._data = {c: cur.copyto(c) for c in ctx}
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is None:
+            return
+        for c in list(self._data):
+            self._data[c] = self._data[c].astype(dtype)
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def var(self):
+        from ..symbol import symbol as _sym
+
+        return _sym.var(self.name, shape=self.shape, dtype=self.dtype)
+
+    def __repr__(self):
+        return (f"Parameter {self.name} (shape={self._shape}, "
+                f"dtype={self.dtype})")
+
+
+class Constant(Parameter):
+    """Non-differentiable constant parameter (ref: gluon.Constant)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            value = _nd_mod.array(np.asarray(value))
+        self.value = value
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype,
+                         init=init_mod.Constant(0.0))
+        self.init = _ConstInit(value)
+
+
+class _ConstInit(init_mod.Initializer):
+    def __init__(self, value):
+        super().__init__()
+        self.value = value
+
+    def init_array(self, name, arr):
+        arr[:] = self.value
+
+
+class ParameterDict:
+    """Ordered name->Parameter mapping with prefix + sharing
+    (ref: gluon.ParameterDict)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = {}
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def get(self, name, **kwargs):
+        full = self._prefix + name
+        if full in self._params:
+            param = self._params[full]
+            # merge shape hints
+            if kwargs.get("shape") is not None and param.shape is not None:
+                param.shape = tuple(
+                    k if s == 0 else s
+                    for s, k in zip(param.shape, kwargs["shape"]))
+            return param
+        if self._shared is not None and full in self._shared._params:
+            self._params[full] = self._shared._params[full]
+            return self._params[full]
+        param = Parameter(full, **kwargs)
+        self._params[full] = param
+        return param
+
+    def get_constant(self, name, value=None):
+        full = self._prefix + name
+        if full not in self._params:
+            self._params[full] = Constant(full, value)
+        return self._params[full]
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise MXNetError(f"duplicate parameter name {k}")
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        for p in self.values():
+            p.initialize(init=init, ctx=ctx, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for p in self.values():
+            p.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for p in self.values():
+            setattr(p, name, value)
+
+    def save(self, fname, strip_prefix=""):
+        out = {}
+        for name, p in self.items():
+            key = name[len(strip_prefix):] if name.startswith(strip_prefix) \
+                else name
+            out[key] = p.data()
+        _nd_mod.save(fname, out)
+
+    def load(self, fname, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        loaded = _nd_mod.load(fname)
+        loaded = {restore_prefix + k: v for k, v in loaded.items()}
+        for name, p in self.items():
+            if name in loaded:
+                p.shape = loaded[name].shape
+                if p._data is None:
+                    p.initialize(ctx=ctx or [cpu()])
+                    if p._deferred_init is not None:
+                        p._finish_deferred_init()
+                p.set_data(loaded[name])
+            elif not allow_missing:
+                raise MXNetError(f"Parameter {name} missing in file {fname}")
+        if not ignore_extra:
+            extra = set(loaded) - set(self._params)
+            if extra:
+                raise MXNetError(f"extra parameters in {fname}: {extra}")
+
+    # -- mapping protocol ---------------------------------------------------
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def __getitem__(self, k):
+        return self._params[k]
+
+    def __contains__(self, k):
+        return k in self._params
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def __repr__(self):
+        lines = "\n".join(f"  {p}" for p in self.values())
+        return f"ParameterDict '{self._prefix}' (\n{lines}\n)"

@@ -1,0 +1,176 @@
+"""gluon.Trainer (ref: python/mxnet/gluon/trainer.py).
+
+Applies an Optimizer to a set of Parameters with gradient aggregation
+through a KVStore.  API-identical to the reference; the aggregation is
+XLA collectives (see kvstore.py) so the same user loop scales from one
+chip to a pod (the north-star contract: "gluon.Trainer scales across a
+pod unchanged").
+"""
+from __future__ import annotations
+
+from .. import kvstore as _kvstore
+from .. import optimizer as _opt
+from ..base import MXNetError
+from .parameter import Parameter, ParameterDict
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError("params must be a ParameterDict or list")
+        self._all_params = list(params)
+        self._params = [p for p in params if p.grad_req != "null"]
+        self._param2idx = {p.name: i for i, p in enumerate(self._params)}
+        optimizer_params = optimizer_params or {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._optimizer = _opt.create(
+            optimizer, param_dict={i: p for i, p in enumerate(self._params)},
+            **optimizer_params)
+        self._kv_type = kvstore
+        self._kvstore = None
+        self._update_on_kvstore = update_on_kvstore
+        self._compression_params = compression_params
+        self._states = [None] * len(self._params)
+        self._kv_initialized = False
+        self._contexts = None
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def _init_kvstore(self):
+        if self._kv_initialized:
+            return
+        ctxs = self._params[0].list_ctx() if self._params else []
+        self._contexts = ctxs
+        multi_device = len(ctxs) > 1
+        if self._kv_type is None or (not multi_device and
+                                     not str(self._kv_type).startswith("dist")):
+            self._kvstore = None
+        else:
+            self._kvstore = _kvstore.create(self._kv_type)
+            if self._compression_params:
+                self._kvstore.set_gradient_compression(
+                    self._compression_params)
+            if self._update_on_kvstore is None:
+                self._update_on_kvstore = bool(self._kvstore._is_dist())
+            if self._update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
+            for i, p in enumerate(self._params):
+                self._kvstore.init(i, p.list_data()[0:1])
+        self._kv_initialized = True
+
+    # -- stepping -----------------------------------------------------------
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce grads + optimizer update (ref: Trainer.step §3.3)."""
+        self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        self._init_kvstore()
+        if self._update_on_kvstore:
+            raise MXNetError("allreduce_grads() is illegal with "
+                             "update_on_kvstore=True")
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, p in enumerate(self._params):
+            grads = p.list_grad()
+            if self._update_on_kvstore:
+                # push grads; server applies optimizer; pull new weights
+                self._kvstore.push(i, grads)
+                self._kvstore.pull(i, out=p.list_data())
+            else:
+                self._kvstore.pushpull(i, grads, out=grads)
+                # write reduced grad back into each replica's holder
+                for ctx, g in zip(p.list_ctx(), grads):
+                    p._data[ctx]._grad = g
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        self._init_kvstore()
+        if self._update_on_kvstore:
+            raise MXNetError("update() is illegal with "
+                             "update_on_kvstore=True")
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        if self._update_on_kvstore and self._kvstore is not None:
+            return  # already updated during push
+        for i, p in enumerate(self._params):
+            ctxs = p.list_ctx()
+            # grads are identical after allreduce: update ONCE on the first
+            # context and broadcast — keeps optimizer num_update correct
+            # (one tick per step, not per device) and optimizer state
+            # un-replicated, matching the reference's update_on_kvstore
+            # single-update semantics
+            ctx0 = ctxs[0]
+            w = p.data(ctx0)
+            g = p.grad(ctx0)
+            if self._states[i] is None:
+                self._states[i] = {}
+            if ctx0 not in self._states[i]:
+                self._states[i][ctx0] = \
+                    self._optimizer.create_state_multi_precision(i, w)
+            self._optimizer.update_multi_precision(
+                i, w, g, self._states[i][ctx0])
+            for ctx in ctxs[1:]:
+                p.data(ctx)._data = w.as_in_context(ctx)._data
+
+    # -- state io (ref: trainer.save_states/load_states) --------------------
+
+    def save_states(self, fname):
+        self._init_kvstore()
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.save_optimizer_states(fname)
+            return
+        import pickle
+
+        from ..optimizer import _states_to_np
+
+        blob = {i: {str(c): _states_to_np(s) for c, s in (st or {}).items()}
+                for i, st in enumerate(self._states)}
+        with open(fname, "wb") as f:
+            pickle.dump({"states": blob,
+                         "num_update": self._optimizer.num_update,
+                         "index_update_count":
+                             self._optimizer._index_update_count}, f)
+
+    def load_states(self, fname):
+        self._init_kvstore()
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.load_optimizer_states(fname)
+            return
+        import pickle
+
+        from ..optimizer import _states_from_np
+
+        with open(fname, "rb") as f:
+            blob = pickle.load(f)
+        self._optimizer.num_update = blob["num_update"]
+        self._optimizer._index_update_count = blob["index_update_count"]
+        for i, p in enumerate(self._params):
+            saved = blob["states"].get(i, {})
+            if not saved:
+                continue
+            self._states[i] = {}
+            vals = list(saved.values())
+            for j, ctx in enumerate(p.list_ctx()):
+                v = vals[j] if j < len(vals) else vals[0]
+                self._states[i][ctx] = _states_from_np(v)

@@ -1,0 +1,72 @@
+"""gluon.utils (ref: python/mxnet/gluon/utils.py)."""
+from __future__ import annotations
+
+import hashlib
+import math
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from ..ndarray import ndarray as _nd
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split along batch axis into num_slice chunks (ref: split_data)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            f"data size {size} not divisible by {num_slice} slices; "
+            "set even_split=False")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if (i < num_slice - 1 or even_split) else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split batch and place shards on devices (ref: split_and_load —
+    the batch-sharding half of MXNet-style data parallelism)."""
+    if not isinstance(data, NDArray):
+        data = _nd.array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(c) for s, c in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so total L2 norm <= max_norm (ref: clip_global_norm)."""
+    assert len(arrays) > 0
+    total = 0.0
+    norms = [(a.square().sum()) for a in arrays]
+    total = norms[0]
+    for n in norms[1:]:
+        total = total + n
+    total_norm = float(total.sqrt().asscalar())
+    if check_isfinite and not math.isfinite(total_norm):
+        raise MXNetError(f"global norm is not finite: {total_norm}")
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a *= scale
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    raise MXNetError(
+        "download() is unavailable: this environment has no network egress. "
+        "Place files locally and pass a path instead.")

@@ -1,0 +1,202 @@
+"""Weight initializers (ref: python/mxnet/initializer.py)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .base import Registry
+
+_registry = Registry("initializer")
+register = _registry.register
+
+
+class Initializer:
+    """Base initializer (ref: mx.init.Initializer)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, name, arr=None):
+        # legacy call convention: init(name, arr)
+        if arr is None:
+            name, arr = "", name
+        self.init_array(str(name), arr)
+
+    def init_array(self, name, arr):
+        if name.endswith("bias"):
+            self._init_zero(arr)
+        elif name.endswith("gamma"):
+            self._init_one(arr)
+        elif name.endswith("beta"):
+            self._init_zero(arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
+            self._init_zero(arr)
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            self._init_one(arr)
+        else:
+            self._init_weight(name, arr)
+
+    def _init_zero(self, arr):
+        arr[:] = 0.0
+
+    def _init_one(self, arr):
+        arr[:] = 1.0
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def _rand(self, arr):
+        from . import random as _random
+
+        return _random
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+
+def _fill(arr, np_values):
+    from .ndarray.ndarray import NDArray
+
+    vals = np_values.astype(np.dtype(arr.dtype))
+    if isinstance(arr, NDArray):
+        arr[:] = vals
+    else:
+        arr[...] = vals
+
+
+@register("zeros")
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_zero(arr)
+
+
+@register("ones")
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_one(arr)
+
+
+@register()
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        arr[:] = self.value
+
+
+@register()
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        _fill(arr, np.random.uniform(-self.scale, self.scale, arr.shape))
+
+
+@register()
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        _fill(arr, np.random.normal(0, self.sigma, arr.shape))
+
+
+@register()
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:])) if len(arr.shape) > 1 else 1
+        if self.rand_type == "uniform":
+            tmp = np.random.uniform(-1, 1, (nout, nin))
+        else:
+            tmp = np.random.normal(0, 1, (nout, nin))
+        u, _, v = np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        _fill(arr, self.scale * q.reshape(arr.shape))
+
+
+@register()
+class Xavier(Initializer):
+    """Ref: mx.init.Xavier (magnitude/factor_type/rnd_type)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise ValueError(
+                f"Xavier initializer needs >=2D weight, got {shape} for {name}")
+        if len(shape) > 2:
+            hw_scale = np.prod(shape[2:])
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = {"avg": (fan_in + fan_out) / 2.0,
+                  "in": fan_in, "out": fan_out}[self.factor_type]
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            _fill(arr, np.random.uniform(-scale, scale, shape))
+        else:
+            _fill(arr, np.random.normal(0, scale, shape))
+
+
+@register()
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register()
+class Bilinear(Initializer):
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        weight = np.zeros(np.prod(shape), dtype="float32")
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(np.prod(shape)):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        _fill(arr, weight.reshape(shape))
+
+
+@register()
+class LSTMBias(Initializer):
+    """Forget-gate bias = 1 (gate order i,f,g,o — see ops/rnn.py)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        arr[:] = 0.0
+        n = arr.shape[0] // 4
+        arr[n:2 * n] = self.forget_bias
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    return _registry.get(name)(**kwargs)
+
+
+# aliases matching mx.init
+zero = Zero
+one = One

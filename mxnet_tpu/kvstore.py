@@ -1,0 +1,187 @@
+"""KVStore: string-keyed parameter/gradient store.
+
+Ref: src/kvstore/ (kvstore_local.h, comm.h, kvstore_nccl.h,
+kvstore_dist.h) + python/mxnet/kvstore.py.
+
+TPU-native design (BASELINE north star): every type maps to XLA
+collectives instead of device-copy trees / NCCL / ps-lite —
+- 'local'/'device'/'nccl': single-process multi-device aggregation.
+  Eager path reduces across the per-device replicas with XLA add (the
+  CommDevice equivalent); inside a compiled step the same push+pull pair
+  becomes an in-graph psum over the ICI mesh axis (see parallel/).
+- 'dist_sync'/'dist_async'/'dist_device_sync': multi-process path over
+  jax.distributed (DCN collectives); single-process fallback degrades to
+  'device' so the nightly-style local-launcher tests run anywhere.
+Server-side optimizer (`update_on_kvstore`) runs the Updater on the
+reduced gradient once, then broadcasts — semantically identical to the
+reference's KVStoreDistServer sync-mode update.
+"""
+from __future__ import annotations
+
+import jax
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, _wrap
+from .ndarray import ndarray as _nd
+from . import optimizer as _opt
+
+
+class KVStore:
+    """Ref: include/mxnet/kvstore.h KVStore::Create."""
+
+    def __init__(self, kv_type="local"):
+        self._type = kv_type
+        self._store = {}          # key -> canonical NDArray (merged value)
+        self._updater = None
+        self._optimizer = None
+
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        from .parallel import dist
+
+        return dist.rank()
+
+    @property
+    def num_workers(self):
+        from .parallel import dist
+
+        return dist.num_workers()
+
+    # -- init ---------------------------------------------------------------
+
+    def init(self, key, value):
+        keys, values = _normalize(key, value)
+        for k, vlist in zip(keys, values):
+            if k in self._store:
+                raise MXNetError(f"key {k} already initialized")
+            self._store[k] = vlist[0].copy()
+
+    # -- push / pull --------------------------------------------------------
+
+    def push(self, key, value, priority=0):
+        """Aggregate values (sum over devices, ref: CommDevice reduce; and
+        over workers for dist_*)."""
+        keys, values = _normalize(key, value)
+        for k, vlist in zip(keys, values):
+            if k not in self._store:
+                raise MXNetError(f"key {k} has not been initialized")
+            reduced = _reduce_sum(vlist, self._store[k].context)
+            if self._is_dist():
+                reduced = self._dist_allreduce(k, reduced)
+            if self._updater is not None:
+                # server-side optimizer (update_on_kvstore=True)
+                self._updater(_key_index(k), reduced, self._store[k])
+            else:
+                self._store[k]._data = reduced._data
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = _normalize(key, out)
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"key {k} has not been initialized")
+            src = self._store[k]
+            for o in olist:
+                o._data = src.as_in_context(o.context)._data
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        self.pull(key, out if out is not None else value, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        self.pull(key, out=out, priority=priority)
+
+    # -- broadcast (newer API parity) --------------------------------------
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out=out, priority=priority)
+
+    # -- optimizer ----------------------------------------------------------
+
+    def set_optimizer(self, optimizer):
+        """Run the optimizer on the (reduced) push'ed grads —
+        ref: kvstore_dist_server.h set_optimizer."""
+        self._optimizer = optimizer
+        self._updater = _opt.get_updater(optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        # 2-bit compression is a bandwidth optimization for PCIe/ethernet;
+        # ICI all-reduce needs none. Accepted for API parity.
+        self._compression = compression_params
+
+    # -- dist ---------------------------------------------------------------
+
+    def _is_dist(self):
+        return self._type.startswith("dist")
+
+    def _dist_allreduce(self, key, value):
+        from .parallel import dist
+
+        return dist.allreduce(value)
+
+    def barrier(self):
+        if self._is_dist():
+            from .parallel import dist
+
+            dist.barrier()
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+
+def _key_index(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k
+
+
+def _normalize(key, value):
+    if isinstance(key, (list, tuple)):
+        out_v = []
+        for v in value:
+            out_v.append(list(v) if isinstance(v, (list, tuple)) else [v])
+        return list(key), out_v
+    return [key], [list(value) if isinstance(value, (list, tuple))
+                   else [value]]
+
+
+def _reduce_sum(vlist, target_ctx):
+    """Sum NDArrays living on (possibly) different devices.
+
+    Eager CommDevice equivalent: gather to the target device and add —
+    XLA handles the transfers; inside jit this is a psum.
+    """
+    if len(vlist) == 1:
+        return vlist[0].as_in_context(target_ctx)
+    acc = vlist[0].as_in_context(target_ctx)
+    for v in vlist[1:]:
+        acc = acc + v.as_in_context(target_ctx)
+    return acc
+
+
+_VALID = ("local", "device", "nccl", "dist", "dist_sync", "dist_async",
+          "dist_device_sync", "dist_device_async", "horovod", "teststore")
+
+
+def create(name="local"):
+    """Ref: mx.kv.create — all single-process types share the XLA
+    collective path; dist types add the multi-process DCN allreduce."""
+    if isinstance(name, KVStore):
+        return name
+    if name not in _VALID:
+        raise MXNetError(f"unknown kvstore type {name!r}; valid: {_VALID}")
+    return KVStore(name)

@@ -1,0 +1,532 @@
+"""Optimizers (ref: python/mxnet/optimizer/optimizer.py +
+src/operator/optimizer_op.cc).
+
+TPU-native design: each update rule is a pure fused HLO kernel invoked
+through the standard executable cache (lr and step count ride as traced
+scalars so LR schedules never trigger recompilation).  When training is
+hybridized end-to-end the same kernels fuse into the step computation
+(update_on_kvstore → sharded update handled at the kvstore layer).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ._imperative import invoke
+from .base import Registry, MXNetError
+from .ndarray.ndarray import NDArray, _wrap
+from .ndarray import ndarray as _nd
+
+_registry = Registry("optimizer")
+register = _registry.register
+
+
+# ---------------------------------------------------------------------------
+# update kernels (pure; ref: optimizer_op-inl.h)
+
+def _prep(g, w, *, rescale, clip, wd):
+    g = g * rescale
+    if clip is not None:
+        g = jnp.clip(g, -clip, clip)
+    return g + wd * w
+
+
+def _k_sgd(w, g, lr, *, rescale, clip, wd):
+    return w - lr * _prep(g, w, rescale=rescale, clip=clip, wd=wd)
+
+
+def _k_sgd_mom(w, g, mom, lr, *, momentum, rescale, clip, wd):
+    new_mom = momentum * mom - lr * _prep(g, w, rescale=rescale, clip=clip,
+                                          wd=wd)
+    return w + new_mom, new_mom
+
+
+def _k_nag(w, g, mom, lr, *, momentum, rescale, clip, wd):
+    gp = _prep(g, w, rescale=rescale, clip=clip, wd=wd)
+    new_mom = momentum * mom + gp
+    return w - lr * (gp + momentum * new_mom), new_mom
+
+
+def _k_adam(w, g, mean, var, lr, t, *, beta1, beta2, epsilon, rescale,
+            clip, wd, lazy_update=False):
+    gp = _prep(g, w, rescale=rescale, clip=clip, wd=wd)
+    m = beta1 * mean + (1 - beta1) * gp
+    v = beta2 * var + (1 - beta2) * jnp.square(gp)
+    mhat = m / (1 - beta1 ** t)
+    vhat = v / (1 - beta2 ** t)
+    return w - lr * mhat / (jnp.sqrt(vhat) + epsilon), m, v
+
+
+def _k_adamw(w, g, mean, var, lr, t, *, beta1, beta2, epsilon, rescale,
+             clip, wd):
+    gp = g * rescale
+    if clip is not None:
+        gp = jnp.clip(gp, -clip, clip)
+    m = beta1 * mean + (1 - beta1) * gp
+    v = beta2 * var + (1 - beta2) * jnp.square(gp)
+    mhat = m / (1 - beta1 ** t)
+    vhat = v / (1 - beta2 ** t)
+    return w - lr * (mhat / (jnp.sqrt(vhat) + epsilon) + wd * w), m, v
+
+
+def _k_rmsprop(w, g, n, lr, *, gamma1, epsilon, rescale, clip, wd):
+    gp = _prep(g, w, rescale=rescale, clip=clip, wd=wd)
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(gp)
+    return w - lr * gp / (jnp.sqrt(new_n) + epsilon), new_n
+
+
+def _k_rmsprop_alex(w, g, n, gmean, delta, lr, *, gamma1, gamma2, epsilon,
+                    rescale, clip, wd):
+    # centered variant (ref: rmspropalex_update, optimizer_op-inl.h)
+    gp = _prep(g, w, rescale=rescale, clip=clip, wd=wd)
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(gp)
+    new_g = gamma1 * gmean + (1 - gamma1) * gp
+    new_d = gamma2 * delta - lr * gp / (
+        jnp.sqrt(new_n - jnp.square(new_g) + epsilon))
+    return w + new_d, new_n, new_g, new_d
+
+
+def _k_adagrad(w, g, hist, lr, *, epsilon, rescale, clip, wd):
+    gp = _prep(g, w, rescale=rescale, clip=clip, wd=wd)
+    new_h = hist + jnp.square(gp)
+    return w - lr * gp / (jnp.sqrt(new_h) + epsilon), new_h
+
+
+def _k_adadelta(w, g, acc_g, acc_d, *, rho, epsilon, rescale, clip, wd):
+    gp = _prep(g, w, rescale=rescale, clip=clip, wd=wd)
+    new_acc_g = rho * acc_g + (1 - rho) * jnp.square(gp)
+    delta = jnp.sqrt(acc_d + epsilon) / jnp.sqrt(new_acc_g + epsilon) * gp
+    new_acc_d = rho * acc_d + (1 - rho) * jnp.square(delta)
+    return w - delta, new_acc_g, new_acc_d
+
+
+def _k_ftrl(w, g, z, n, lr, *, lamda1, beta, rescale, clip, wd):
+    gp = g * rescale
+    if clip is not None:
+        gp = jnp.clip(gp, -clip, clip)
+    new_n = n + jnp.square(gp)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + gp - sigma * w
+    new_w = jnp.where(
+        jnp.abs(new_z) <= lamda1,
+        jnp.zeros_like(w),
+        -(new_z - jnp.sign(new_z) * lamda1)
+        / ((beta + jnp.sqrt(new_n)) / lr + wd))
+    return new_w, new_z, new_n
+
+
+def _k_signum(w, g, mom, lr, *, momentum, rescale, clip, wd):
+    gp = _prep(g, w, rescale=rescale, clip=clip, wd=wd)
+    new_mom = momentum * mom - (1 - momentum) * gp
+    return w + lr * jnp.sign(new_mom), new_mom
+
+
+def _k_lamb(w, g, mean, var, lr, t, *, beta1, beta2, epsilon, rescale,
+            clip, wd, lower_bound=None, upper_bound=None):
+    gp = g * rescale
+    if clip is not None:
+        gp = jnp.clip(gp, -clip, clip)
+    m = beta1 * mean + (1 - beta1) * gp
+    v = beta2 * var + (1 - beta2) * jnp.square(gp)
+    mhat = m / (1 - beta1 ** t)
+    vhat = v / (1 - beta2 ** t)
+    update = mhat / (jnp.sqrt(vhat) + epsilon) + wd * w
+    wnorm = jnp.linalg.norm(w)
+    unorm = jnp.linalg.norm(update)
+    ratio = jnp.where(jnp.logical_and(wnorm > 0, unorm > 0),
+                      wnorm / unorm, 1.0)
+    if lower_bound is not None:
+        ratio = jnp.maximum(ratio, lower_bound)
+    if upper_bound is not None:
+        ratio = jnp.minimum(ratio, upper_bound)
+    return w - lr * ratio * update, m, v
+
+
+# ---------------------------------------------------------------------------
+
+
+class Optimizer:
+    """Base optimizer (ref: mx.optimizer.Optimizer)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 multi_precision=False, param_dict=None, begin_num_update=0,
+                 **kwargs):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.param_idx2name = param_idx2name or {}
+        self.param_dict = param_dict or {}
+        self.num_update = begin_num_update
+        self.begin_num_update = begin_num_update
+        self._index_update_count = {}
+        self.idx2name = self.param_idx2name
+        self._lr_mult = {}
+        self._wd_mult = {}
+
+    # -- config -------------------------------------------------------------
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("LRScheduler of the optimizer has already been "
+                             "defined; cannot set_learning_rate")
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self._lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self._wd_mult = dict(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self.num_update,
+                              self._index_update_count[index])
+
+    def _get_lr(self, index):
+        lr = (self.lr_scheduler(self.num_update)
+              if self.lr_scheduler is not None else self.lr)
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self._lr_mult:
+            lr *= self._lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self._lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self._wd_mult:
+            wd *= self._wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self._wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    # -- state --------------------------------------------------------------
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype == np.float16:
+            w32 = weight.astype("float32")
+            return (w32, self.create_state(index, w32))
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == np.float16:
+            w32, inner = state
+            self.update(index, w32, grad.astype("float32"), inner)
+            weight._data = w32.astype("float16")._data
+        else:
+            self.update(index, weight, grad, state)
+
+    def _common(self, index):
+        return dict(rescale=self.rescale_grad,
+                    clip=self.clip_gradient,
+                    wd=self._get_wd(index))
+
+    @staticmethod
+    def _scalar(v, like):
+        return _wrap(jnp.asarray(v, dtype=like.dtype))
+
+
+@register("sgd")
+class SGD(Optimizer):
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return _nd.zeros(weight.shape, dtype=weight.dtype,
+                             ctx=weight.context)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._scalar(self._get_lr(index), weight)
+        kw = self._common(index)
+        if self.momentum == 0.0:
+            new_w = invoke(_k_sgd, weight, grad, lr, **kw)
+        else:
+            new_w, new_mom = invoke(_k_sgd_mom, weight, grad, state, lr,
+                                    momentum=self.momentum, **kw)
+            state._data = new_mom._data
+        weight._data = new_w._data
+
+
+@register("nag")
+class NAG(Optimizer):
+    def __init__(self, momentum=0.9, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        return _nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._scalar(self._get_lr(index), weight)
+        new_w, new_mom = invoke(_k_nag, weight, grad, state, lr,
+                                momentum=self.momentum, **self._common(index))
+        state._data = new_mom._data
+        weight._data = new_w._data
+
+
+@register("adam")
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        z = lambda: _nd.zeros(weight.shape, dtype=weight.dtype,
+                              ctx=weight.context)
+        return (z(), z())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._scalar(self._get_lr(index), weight)
+        t_arr = self._scalar(float(t), weight)
+        mean, var = state
+        new_w, m, v = invoke(_k_adam, weight, grad, mean, var, lr, t_arr,
+                             beta1=self.beta1, beta2=self.beta2,
+                             epsilon=self.epsilon, **self._common(index))
+        mean._data, var._data = m._data, v._data
+        weight._data = new_w._data
+
+
+@register("adamw")
+class AdamW(Adam):
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._scalar(self._get_lr(index), weight)
+        t_arr = self._scalar(float(t), weight)
+        mean, var = state
+        new_w, m, v = invoke(_k_adamw, weight, grad, mean, var, lr, t_arr,
+                             beta1=self.beta1, beta2=self.beta2,
+                             epsilon=self.epsilon, **self._common(index))
+        mean._data, var._data = m._data, v._data
+        weight._data = new_w._data
+
+
+@register("rmsprop")
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2, self.epsilon = gamma1, gamma2, epsilon
+        self.centered = centered
+
+    def create_state(self, index, weight):
+        z = lambda: _nd.zeros(weight.shape, dtype=weight.dtype,
+                              ctx=weight.context)
+        if self.centered:
+            return (z(), z(), z())  # n, mean-grad, delta
+        return z()
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._scalar(self._get_lr(index), weight)
+        if self.centered:
+            n, gmean, delta = state
+            new_w, nn, ng, ndl = invoke(
+                _k_rmsprop_alex, weight, grad, n, gmean, delta, lr,
+                gamma1=self.gamma1, gamma2=self.gamma2,
+                epsilon=self.epsilon, **self._common(index))
+            n._data, gmean._data, delta._data = nn._data, ng._data, ndl._data
+        else:
+            new_w, new_n = invoke(_k_rmsprop, weight, grad, state, lr,
+                                  gamma1=self.gamma1, epsilon=self.epsilon,
+                                  **self._common(index))
+            state._data = new_n._data
+        weight._data = new_w._data
+
+
+@register("adagrad")
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return _nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._scalar(self._get_lr(index), weight)
+        new_w, new_h = invoke(_k_adagrad, weight, grad, state, lr,
+                              epsilon=self.float_stable_eps,
+                              **self._common(index))
+        state._data = new_h._data
+        weight._data = new_w._data
+
+
+@register("adadelta")
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.9, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        z = lambda: _nd.zeros(weight.shape, dtype=weight.dtype,
+                              ctx=weight.context)
+        return (z(), z())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        acc_g, acc_d = state
+        new_w, ng, ndlt = invoke(_k_adadelta, weight, grad, acc_g, acc_d,
+                                 rho=self.rho, epsilon=self.epsilon,
+                                 **self._common(index))
+        acc_g._data, acc_d._data = ng._data, ndlt._data
+        weight._data = new_w._data
+
+
+@register("ftrl")
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        z = lambda: _nd.zeros(weight.shape, dtype=weight.dtype,
+                              ctx=weight.context)
+        return (z(), z())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._scalar(self._get_lr(index), weight)
+        z, n = state
+        new_w, nz, nn = invoke(_k_ftrl, weight, grad, z, n, lr,
+                               lamda1=self.lamda1, beta=self.beta,
+                               **self._common(index))
+        z._data, n._data = nz._data, nn._data
+        weight._data = new_w._data
+
+
+@register("signum")
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        return _nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        # momentum already accumulates the NEGATIVE gradient in _k_signum,
+        # so +lr*sign(mom) is descent
+        lr = self._scalar(self._get_lr(index), weight)
+        new_w, new_mom = invoke(_k_signum, weight, grad, state, lr,
+                                momentum=self.momentum, **self._common(index))
+        state._data = new_mom._data
+        weight._data = new_w._data
+
+
+@register("lamb")
+class LAMB(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+
+    def create_state(self, index, weight):
+        z = lambda: _nd.zeros(weight.shape, dtype=weight.dtype,
+                              ctx=weight.context)
+        return (z(), z())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._scalar(self._get_lr(index), weight)
+        t_arr = self._scalar(float(t), weight)
+        mean, var = state
+        new_w, m, v = invoke(_k_lamb, weight, grad, mean, var, lr, t_arr,
+                             beta1=self.beta1, beta2=self.beta2,
+                             epsilon=self.epsilon,
+                             lower_bound=self.lower_bound,
+                             upper_bound=self.upper_bound,
+                             **self._common(index))
+        mean._data, var._data = m._data, v._data
+        weight._data = new_w._data
+
+
+def create(name, **kwargs):
+    """Ref: mx.optimizer.create / Optimizer.create_optimizer."""
+    if isinstance(name, Optimizer):
+        return name
+    return _registry.get(name)(**kwargs)
+
+
+Optimizer.create_optimizer = staticmethod(create)
+
+# MXNet exposes updater-style API for kvstore server-side optimize
+class Updater:
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(
+                index, weight)
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+
+        return pickle.dumps({k: _states_to_np(v)
+                             for k, v in self.states.items()})
+
+    def set_states(self, states):
+        import pickle
+
+        loaded = pickle.loads(states)
+        self.states = {k: _states_from_np(v) for k, v in loaded.items()}
+
+
+def _states_to_np(state):
+    if state is None:
+        return None
+    if isinstance(state, tuple):
+        return tuple(_states_to_np(s) for s in state)
+    return state.asnumpy()
+
+
+def _states_from_np(state):
+    if state is None:
+        return None
+    if isinstance(state, tuple):
+        return tuple(_states_from_np(s) for s in state)
+    return _nd.array(state)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

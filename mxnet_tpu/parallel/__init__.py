@@ -1,0 +1,16 @@
+"""Parallelism subsystems: mesh SPMD data-parallel, distributed runtime,
+sequence parallelism (ref: §2.3 of SURVEY.md — kvstore comm, ps-lite,
+DataParallelExecutorGroup; plus capability upgrades beyond the
+reference: sharded SPMD training, ring attention)."""
+from . import dist  # noqa: F401
+
+
+def __getattr__(name):
+    if name in ("mesh", "data_parallel", "ring_attention"):
+        import importlib
+
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(
+        f"module 'mxnet_tpu.parallel' has no attribute {name!r}")

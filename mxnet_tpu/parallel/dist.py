@@ -1,0 +1,89 @@
+"""Distributed runtime: multi-process coordination + DCN collectives.
+
+Ref: 3rdparty/ps-lite (Postoffice/Van — node management, barrier) and
+src/kvstore/kvstore_dist.h.  TPU-native design: process groups come from
+``jax.distributed`` (coordinator service = the Postoffice role); cross-
+process reductions ride XLA collectives over ICI/DCN via
+``multihost_utils``-style jitted psums on process-spanning meshes.
+
+In a single process (no DMLC_/JAX coordinator env), everything degrades
+to identity so kvstore('dist_sync') behaves like 'device' — the same
+trick the reference's `local` launcher uses to run nightly dist tests on
+one machine (SURVEY §4).
+"""
+from __future__ import annotations
+
+import os
+
+from ..base import getenv
+
+_initialized = False
+
+
+def init(coordinator_address=None, num_processes=None, process_id=None):
+    """Initialize the process group (ref: Postoffice::Start; modern form
+    of the DMLC_PS_ROOT_URI env protocol set by tools/launch.py)."""
+    global _initialized
+    if _initialized:
+        return
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "MXTPU_COORDINATOR") or os.environ.get("DMLC_PS_ROOT_URI")
+    if coordinator_address and num_processes is None:
+        num_processes = int(os.environ.get(
+            "MXTPU_NUM_WORKER", os.environ.get("DMLC_NUM_WORKER", "1")))
+        process_id = int(os.environ.get(
+            "MXTPU_WORKER_ID", os.environ.get("DMLC_WORKER_ID", "0")))
+        port = os.environ.get("DMLC_PS_ROOT_PORT")
+        if port and ":" not in coordinator_address:
+            coordinator_address = f"{coordinator_address}:{port}"
+    if coordinator_address:
+        jax.distributed.initialize(coordinator_address, num_processes,
+                                   process_id)
+    _initialized = True
+
+
+def is_multiprocess():
+    import jax
+
+    return jax.process_count() > 1
+
+
+def rank():
+    import jax
+
+    return jax.process_index()
+
+
+def num_workers():
+    import jax
+
+    return jax.process_count()
+
+
+def allreduce(value):
+    """Sum an NDArray across processes (ref: KVStoreDist push+pull pair →
+    DCN all-reduce).  Single-process: identity."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    from ..engine import track
+    from ..ndarray.ndarray import _wrap
+
+    summed = multihost_utils.process_allgather(value._data)
+    return _wrap(track(summed.sum(axis=0)))
+
+
+def barrier(name="kvstore"):
+    """Ref: Postoffice barrier."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)

@@ -34,9 +34,33 @@ def seed(seed_state=None, ctx="all"):
         _counter = 0
 
 
+# trace-local key stack: inside a hybrid graph capture, randomness must
+# derive from the graph's key INPUT (else the compiled executable would
+# bake the mask as a constant).  See gluon/block.py CachedOp.
+_trace_keys = threading.local()
+
+
+def push_trace_key(key):
+    stack = getattr(_trace_keys, "stack", None)
+    if stack is None:
+        stack = _trace_keys.stack = []
+    stack.append([key, 0])
+    return len(stack) - 1
+
+
+def pop_trace_key(token):
+    _trace_keys.stack.pop()
+
+
 def next_key():
     """Draw a fresh PRNG key (traced arg to random ops)."""
     global _base_key, _counter
+    stack = getattr(_trace_keys, "stack", None)
+    if stack:
+        entry = stack[-1]
+        k = jax.random.fold_in(entry[0], entry[1])
+        entry[1] += 1
+        return k
     with _lock:
         if _base_key is None:
             s = getenv("TEST_SEED", None, int)

@@ -1,0 +1,322 @@
+"""Gluon tests (ref: tests/python/unittest/test_gluon.py +
+tests/python/train/ convergence tests)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_dense_forward():
+    layer = nn.Dense(4, in_units=3)
+    layer.initialize()
+    x = nd.random.uniform(shape=(2, 3))
+    out = layer(x)
+    assert out.shape == (2, 4)
+    w = layer.weight.data().asnumpy()
+    b = layer.bias.data().asnumpy()
+    assert np.allclose(out.asnumpy(), x.asnumpy() @ w.T + b, atol=1e-5)
+
+
+def test_dense_deferred_init():
+    layer = nn.Dense(7)
+    layer.initialize()
+    out = layer(nd.ones((5, 11)))
+    assert out.shape == (5, 7)
+    assert layer.weight.shape == (7, 11)
+
+
+def test_dense_activation_noflatten():
+    layer = nn.Dense(4, activation="relu", flatten=False)
+    layer.initialize()
+    out = layer(nd.random.normal(shape=(2, 5, 8)))
+    assert out.shape == (2, 5, 4)
+    assert (out.asnumpy() >= 0).all()
+
+
+def test_sequential_and_params():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(8))
+    net.initialize()
+    out = net(nd.ones((4, 10)))
+    assert out.shape == (4, 8)
+    params = net.collect_params()
+    assert len(params) == 4  # 2 weights + 2 biases
+    sel = net.collect_params(".*weight")
+    assert len(sel) == 2
+
+
+def test_conv_pool_net():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, kernel_size=3, padding=1, activation="relu"),
+            nn.MaxPool2D(2),
+            nn.Conv2D(16, kernel_size=3),
+            nn.GlobalAvgPool2D(),
+            nn.Flatten())
+    net.initialize()
+    out = net(nd.random.uniform(shape=(2, 3, 16, 16)))
+    assert out.shape == (2, 16)
+
+
+def test_batchnorm_layer_updates_stats():
+    bn = nn.BatchNorm(in_channels=4)
+    bn.initialize()
+    x = nd.random.normal(loc=3.0, scale=2.0, shape=(8, 4, 5, 5))
+    with autograd.record():
+        y = bn(x)
+    rm = bn.running_mean.data().asnumpy()
+    assert not np.allclose(rm, 0), "running mean should update in training"
+    # eval mode: using running stats, not batch stats
+    y2 = bn(x)
+    assert not np.allclose(y.asnumpy(), y2.asnumpy())
+
+
+def test_hybridize_basic():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+    net.initialize()
+    x = nd.random.uniform(shape=(4, 6))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    assert np.allclose(eager, hybrid, atol=1e-5)
+    # second call goes through the cached executable
+    hybrid2 = net(x).asnumpy()
+    assert np.allclose(hybrid, hybrid2)
+
+
+def test_hybridize_grad_matches_eager():
+    def make_net():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="tanh"), nn.Dense(1))
+        return net
+
+    np.random.seed(0)
+    x = nd.random.uniform(shape=(4, 5))
+    net = make_net()
+    net.initialize()
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    eager_grads = {k: p.grad().asnumpy()
+                   for k, p in net.collect_params().items()}
+    net.hybridize()
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    for k, p in net.collect_params().items():
+        assert np.allclose(eager_grads[k], p.grad().asnumpy(), atol=1e-4), k
+
+
+def test_hybridize_batchnorm_stats():
+    bn_net = nn.HybridSequential()
+    bn_net.add(nn.Conv2D(4, 3, in_channels=2), nn.BatchNorm(in_channels=4))
+    bn_net.initialize()
+    bn_net.hybridize()
+    x = nd.random.normal(loc=1.0, shape=(4, 2, 8, 8))
+    with autograd.record():
+        y = bn_net(x)
+    bn = bn_net[1]
+    assert not np.allclose(bn.running_mean.data().asnumpy(), 0), \
+        "hybridized BatchNorm must still update moving stats"
+
+
+def test_hybridize_dropout_stochastic():
+    net = nn.HybridSequential()
+    net.add(nn.Dropout(0.5))
+    net.initialize()
+    net.hybridize()
+    x = nd.ones((32, 32))
+    with autograd.record():
+        a = net(x).asnumpy()
+        b = net(x).asnumpy()
+    assert not np.allclose(a, b), "dropout must differ across hybrid calls"
+    c = net(x).asnumpy()  # predict mode: identity
+    assert np.allclose(c, 1.0)
+
+
+def test_trainer_sgd_step():
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = nd.array([[1.0, 2.0]])
+    w_before = net.weight.data().asnumpy().copy()
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(1)
+    w_after = net.weight.data().asnumpy()
+    assert np.allclose(w_after, w_before - 0.1 * np.array([[1.0, 2.0]]),
+                       atol=1e-5)
+
+
+def test_trainer_lr_change():
+    net = nn.Dense(1, in_units=1)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    assert trainer.learning_rate == 0.5
+    trainer.set_learning_rate(0.01)
+    assert trainer.learning_rate == 0.01
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    net.initialize()
+    x = nd.ones((1, 3))
+    out1 = net(x).asnumpy()
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(4), nn.Dense(2))
+    # structural names differ (fresh prefixes) — MXNet matches by
+    # collect_params order only when names align; here we rebuild with
+    # matching names via parameter sharing of shapes: load by position
+    loaded = nd.load(f)
+    assert len(loaded) == 4
+    # same-architecture same-prefix round trip
+    net3 = nn.HybridSequential(prefix="copy_")
+    with net3.name_scope():
+        net3.add(nn.Dense(4, prefix="d0_"), nn.Dense(2, prefix="d1_"))
+    net.load_parameters(f)  # reload into itself works
+    assert np.allclose(net(x).asnumpy(), out1)
+
+
+def test_losses():
+    from mxnet_tpu.gluon.loss import (L1Loss, L2Loss,
+                                      SigmoidBinaryCrossEntropyLoss,
+                                      SoftmaxCrossEntropyLoss)
+
+    pred = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    label = nd.array([[1.5, 2.5], [2.0, 3.0]])
+    l2 = L2Loss()(pred, label)
+    assert np.allclose(l2.asnumpy(), [0.125, 0.5], atol=1e-6)
+    l1 = L1Loss()(pred, label)
+    assert np.allclose(l1.asnumpy(), [0.5, 1.0], atol=1e-6)
+
+    logits = nd.array([[10.0, -10.0], [-10.0, 10.0]])
+    labels = nd.array([0, 1])
+    ce = SoftmaxCrossEntropyLoss()(logits, labels)
+    assert (ce.asnumpy() < 1e-4).all()
+
+    sb = SigmoidBinaryCrossEntropyLoss()(nd.array([100.0]), nd.array([1.0]))
+    assert sb.asscalar() < 1e-4
+
+
+def test_constant_param():
+    class Net(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.const = self.params.get_constant(
+                "const", np.array([2.0, 3.0], dtype=np.float32))
+
+        def hybrid_forward(self, F, x, const):
+            return x * const
+
+    net = Net()
+    net.initialize()
+    out = net(nd.ones((2,)))
+    assert np.allclose(out.asnumpy(), [2, 3])
+
+
+def test_metrics():
+    from mxnet_tpu import metric
+
+    acc = metric.Accuracy()
+    acc.update(nd.array([1, 0, 1]), nd.array([[0.1, 0.9], [0.8, 0.2],
+                                              [0.3, 0.7]]))
+    assert acc.get()[1] == 1.0
+    topk = metric.TopKAccuracy(top_k=2)
+    topk.update(nd.array([2]), nd.array([[0.3, 0.5, 0.4]]))
+    assert topk.get()[1] == 1.0
+    comp = metric.create(["acc", "ce"])
+    comp.update(nd.array([1]), nd.array([[0.2, 0.8]]))
+    names, values = comp.get()
+    assert "accuracy" in names[0]
+    assert np.isclose(values[1], -np.log(0.8), atol=1e-5)
+
+
+def test_lr_schedulers():
+    from mxnet_tpu import lr_scheduler as lrs
+
+    fs = lrs.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert fs(0) == 1.0 and fs(10) == 0.5 and fs(20) == 0.25
+    ms = lrs.MultiFactorScheduler(step=[5, 15], factor=0.1, base_lr=1.0)
+    assert np.isclose(ms(4), 1.0) and np.isclose(ms(6), 0.1) \
+        and np.isclose(ms(16), 0.01)
+    cs = lrs.CosineScheduler(max_update=100, base_lr=1.0, final_lr=0.0)
+    assert np.isclose(cs(0), 1.0) and cs(50) < 1.0 and np.isclose(cs(100), 0.0)
+    ws = lrs.FactorScheduler(step=100, base_lr=1.0, warmup_steps=10,
+                             warmup_begin_lr=0.0)
+    assert ws(5) == 0.5
+
+
+def test_clip_global_norm():
+    a = nd.array([3.0])
+    b = nd.array([4.0])
+    total = gluon.utils.clip_global_norm([a, b], 1.0)
+    assert np.isclose(total, 5.0)
+    assert np.isclose(np.sqrt(a.asscalar() ** 2 + b.asscalar() ** 2), 1.0,
+                      atol=1e-4)
+
+
+def test_split_and_load():
+    data = nd.arange(0, 16).reshape(8, 2)
+    ctxs = [mx.xla(0), mx.xla(1)]
+    parts = gluon.utils.split_and_load(data, ctxs)
+    assert parts[0].shape == (4, 2)
+    assert parts[1].context.device_id == 1
+
+
+def test_lenet_mnist_convergence():
+    """THE minimum end-to-end slice (SURVEY §7 phase 3): LeNet, Gluon,
+    hybridized, SGD — learns synthetic MNIST-like data."""
+    np.random.seed(42)
+    mx.random.seed(42)
+
+    # synthetic 2-class 'digits': class k has a bright k-quadrant
+    n = 256
+    X = np.random.rand(n, 1, 28, 28).astype(np.float32) * 0.1
+    y = np.random.randint(0, 2, n)
+    X[y == 0, :, :14, :14] += 0.9
+    X[y == 1, :, 14:, 14:] += 0.9
+
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, kernel_size=5, activation="relu"),
+            nn.MaxPool2D(2),
+            nn.Conv2D(16, kernel_size=5, activation="relu"),
+            nn.MaxPool2D(2),
+            nn.Flatten(),
+            nn.Dense(64, activation="relu"),
+            nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    bs = 32
+    losses = []
+    for epoch in range(3):
+        for i in range(0, n, bs):
+            xb = nd.array(X[i:i + bs])
+            yb = nd.array(y[i:i + bs])
+            with autograd.record():
+                out = net(xb)
+                loss = loss_fn(out, yb)
+            loss.backward()
+            trainer.step(bs)
+            losses.append(float(loss.mean().asscalar()))
+
+    # converged: accuracy high on train set
+    from mxnet_tpu import metric
+
+    acc = metric.Accuracy()
+    acc.update(nd.array(y), net(nd.array(X)))
+    assert acc.get()[1] > 0.95, (acc.get(), losses[:5], losses[-5:])
+    assert losses[-1] < losses[0] * 0.5

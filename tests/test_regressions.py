@@ -77,3 +77,98 @@ def test_setitem_newaxis_array_mix():
     x[nd.array([0, 2], dtype="int32")] = 5.0
     assert np.allclose(x.asnumpy()[[0, 2]], 5)
     assert np.allclose(x.asnumpy()[1], 0)
+
+
+def test_signum_descends():
+    """Review finding: Signum must perform gradient DEscent."""
+    from mxnet_tpu import optimizer as opt
+
+    o = opt.create("signum", learning_rate=0.01)
+    w = nd.array([1.0])
+    state = o.create_state(0, w)
+    for _ in range(20):
+        g = 2 * w  # grad of w^2
+        o.update(0, w, g, state)
+    assert abs(w.asscalar()) < 1.0, w.asscalar()
+
+
+def test_accuracy_2d_label():
+    from mxnet_tpu import metric
+
+    acc = metric.Accuracy()
+    acc.update(nd.array([[1], [0]]), nd.array([[0.1, 0.9], [0.8, 0.2]]))
+    assert acc.get()[1] == 1.0
+
+
+def test_sigmoid_bce_pos_weight():
+    from mxnet_tpu.gluon.loss import SigmoidBinaryCrossEntropyLoss
+
+    loss_fn = SigmoidBinaryCrossEntropyLoss()
+    pred = nd.array([[0.5]])
+    label = nd.array([[1.0]])
+    base = loss_fn(pred, label).asscalar()
+    weighted = loss_fn(pred, label, None, nd.array([10.0])).asscalar()
+    assert np.isclose(weighted, 10 * base, atol=1e-5)
+
+
+def test_rmsprop_centered_state():
+    from mxnet_tpu import optimizer as opt
+
+    o = opt.create("rmsprop", centered=True, learning_rate=0.01)
+    w = nd.array([1.0])
+    state = o.create_state(0, w)
+    assert isinstance(state, tuple) and len(state) == 3
+    for _ in range(30):
+        o.update(0, w, 2 * w, state)
+    assert abs(w.asscalar()) < 1.0
+
+
+def test_trainer_num_update_once_per_step_multictx():
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Dense(1, in_units=2)
+    ctxs = [mx.xla(0), mx.xla(1)]
+    net.initialize(ctx=ctxs)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    from mxnet_tpu import autograd as ag
+
+    for step in range(3):
+        for ctx in ctxs:
+            x = nd.ones((2, 2), ctx=ctx)
+            with ag.record():
+                loss = net(x).sum()
+            loss.backward()
+        trainer.step(4)
+    assert trainer._optimizer.num_update == 3
+    # replicas stay in sync
+    w0 = net.weight.data(ctxs[0]).asnumpy()
+    w1 = net.weight.data(ctxs[1]).asnumpy()
+    assert np.allclose(w0, w1)
+
+
+def test_kvstore_dist_single_process_fallback():
+    from mxnet_tpu import kvstore
+
+    kv = kvstore.create("dist_sync")
+    assert kv.rank == 0 and kv.num_workers == 1
+    kv.init("w", nd.ones((2,)))
+    kv.push("w", [nd.ones((2,)) * 3])
+    out = nd.zeros((2,))
+    kv.pull("w", out=out)
+    assert np.allclose(out.asnumpy(), 3.0)
+    kv.barrier()
+
+
+def test_cached_op_eviction():
+    from mxnet_tpu import _imperative
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    net.hybridize()
+    net(nd.ones((1, 2)))
+    size_before = len(_imperative._jit_cache)
+    net.hybridize(False)  # clears + evicts
+    assert len(_imperative._jit_cache) < size_before
