@@ -1,0 +1,67 @@
+"""Benchmark entry point — prints ONE JSON line.
+
+Runs the flagship training step (compiled SPMD path: forward + backward
++ optimizer fused into one XLA computation) on the available device(s)
+and reports training throughput.
+
+vs_baseline: BASELINE.json carries no published reference numbers
+(`published: {}` — see BASELINE.md provenance); the ratio is reported
+against the first recorded value of this bench (BENCH_BASELINE_VALUE),
+so cross-round progress is visible.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# first-round recorded value (samples/sec, TPU v5e, 2026-07-29);
+# update when re-baselining
+BENCH_BASELINE_VALUE = 14524.0
+
+
+def main():
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import data_parallel, mesh as mesh_mod
+    from __graft_entry__ import _flagship_net
+
+    mx.random.seed(0)
+    np.random.seed(0)
+
+    bs = 256
+    x = np.random.rand(bs, 1, 28, 28).astype(np.float32)
+    y = np.random.randint(0, 10, bs).astype(np.float32)
+
+    net = _flagship_net()
+    net.initialize(mx.init.Xavier())
+    trainer = data_parallel.DataParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 1e-3})
+
+    # warmup / compile
+    trainer.step(x, y).wait_to_read()
+    trainer.step(x, y).wait_to_read()
+
+    iters = 30
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = trainer.step(x, y)
+    loss.wait_to_read()
+    dt = time.perf_counter() - t0
+    sps = iters * bs / dt
+
+    vs = sps / BENCH_BASELINE_VALUE if BENCH_BASELINE_VALUE else 1.0
+    print(json.dumps({
+        "metric": "flagship_cnn_train_throughput",
+        "value": round(sps, 2),
+        "unit": "samples/sec",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

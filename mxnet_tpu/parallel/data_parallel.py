@@ -1,0 +1,237 @@
+"""SPMD data-parallel training: the whole-step compiled path.
+
+Ref: §3.3 of SURVEY.md — Trainer.step's kvstore push/pull pair becomes a
+psum INSIDE the compiled step ("TPU translation: push+pull → psum over
+ICI mesh axis inside the step computation; update_on_kvstore → sharded
+optimizer state").  This module is that north-star path: ONE jitted XLA
+computation per training step containing forward, backward, gradient
+all-reduce (inserted by GSPMD from shardings) and the optimizer update,
+with parameter donation for in-place update.
+
+Works with any HybridBlock + gluon Loss + optimizer name.  The eager
+Trainer (gluon/trainer.py) stays for MXNet-parity semantics; this class
+is the performance path the bench uses.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import autograd
+from .. import random as _random
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, _wrap
+from . import mesh as mesh_mod
+
+
+class DataParallelTrainer:
+    """Compiled SPMD train step over a device mesh.
+
+    batch axis sharded on 'dp'; params replicated (or tp-sharded via
+    shard_params=True); grads psum'ed by GSPMD; optimizer fused in-step.
+    """
+
+    def __init__(self, block, loss_fn, optimizer="sgd", optimizer_params=None,
+                 mesh=None, shard_params=False, donate=True):
+        self.block = block
+        self.loss_fn = loss_fn
+        self.mesh = mesh if mesh is not None else mesh_mod.make_mesh()
+        opt_params = dict(optimizer_params or {})
+        self._lr = float(opt_params.pop("learning_rate", 0.01))
+        self._opt_name = optimizer
+        self._opt_params = opt_params
+        self._shard_params = shard_params
+        self._donate = donate
+        self._step_fn = None
+        self._named = None      # [(name, Parameter)]
+        self._params = None     # list of raw jax arrays (device, sharded)
+        self._states = None     # optimizer state pytree per param
+        self._t = 0
+
+    # -- param plumbing ------------------------------------------------------
+
+    def _gather_params(self, sample_x):
+        if self.block._active is False:
+            self.block.hybridize()
+        # one eager probe to finish deferred init
+        probe = self.block(sample_x)
+        if isinstance(probe, (list, tuple)):
+            for p in probe:
+                p.wait_to_read()
+        self._named = self.block._ordered_params()
+        from jax.sharding import NamedSharding
+
+        params = []
+        self._param_shardings = []
+        for name, p in self._named:
+            raw = p.data()._data
+            if self._shard_params:
+                spec = mesh_mod.shard_param_spec(raw.shape, self.mesh)
+            else:
+                from jax.sharding import PartitionSpec
+
+                spec = PartitionSpec()
+            sh = NamedSharding(self.mesh, spec)
+            params.append(jax.device_put(raw, sh))
+            self._param_shardings.append(sh)
+        self._params = tuple(params)
+        self._trainable = [p.grad_req != "null" for _, p in self._named]
+
+    def _init_opt_states(self):
+        name = self._opt_name
+        states = []
+        # built below; stored as a tuple to keep jit pytree structure stable
+        for raw, trainable in zip(self._params, self._trainable):
+            if not trainable:
+                states.append(None)
+            elif name == "sgd" and self._opt_params.get("momentum", 0):
+                states.append(jnp.zeros_like(raw))
+            elif name in ("adam", "adamw", "lamb"):
+                states.append((jnp.zeros_like(raw), jnp.zeros_like(raw)))
+            elif name == "sgd":
+                states.append(None)
+            else:
+                raise MXNetError(
+                    f"DataParallelTrainer supports sgd/adam/adamw/lamb, "
+                    f"got {name!r}")
+        self._states = tuple(states)
+
+    # -- the compiled step --------------------------------------------------
+
+    def _build_step(self):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        block, loss_block = self.block, self.loss_fn
+        named = self._named
+        trainable = self._trainable
+        opt_name = self._opt_name
+        op = dict(self._opt_params)
+        momentum = float(op.get("momentum", 0.0))
+        wd = float(op.get("wd", 0.0))
+        beta1 = float(op.get("beta1", 0.9))
+        beta2 = float(op.get("beta2", 0.999))
+        eps = float(op.get("epsilon", 1e-8))
+        clip = op.get("clip_gradient")
+
+        from ..gluon.block import _tracing
+
+        def forward_loss(param_raws, x_raw, y_raw, key):
+            params = [p for _, p in named]
+            old = [p._traced_value for p in params]
+            prev = getattr(_tracing, "active", False)
+            _tracing.active = True
+            tok = _random.push_trace_key(key)
+            wrappers = [_wrap(r) for r in param_raws]
+            try:
+                for p, w in zip(params, wrappers):
+                    p._traced_value = w
+                with autograd.pause(train_mode=True):
+                    out = block.forward(_wrap(x_raw))
+                    loss = loss_block(out, _wrap(y_raw))
+            finally:
+                _random.pop_trace_key(tok)
+                _tracing.active = prev
+                for p, o in zip(params, old):
+                    p._traced_value = o
+            # aux side effects (BatchNorm moving stats): wrappers mutated
+            # in place during forward; surface as aux outputs
+            aux = tuple(w._data for w in wrappers)
+            return jnp.mean(loss._data), aux
+
+        def apply_opt(raw, g, state, lr, t):
+            if clip is not None:
+                g = jnp.clip(g, -clip, clip)
+            if opt_name == "sgd":
+                g = g + wd * raw
+                if momentum:
+                    new_m = momentum * state - lr * g
+                    return raw + new_m, new_m
+                return raw - lr * g, None
+            m, v = state
+            if opt_name != "adamw":
+                g = g + wd * raw
+            nm = beta1 * m + (1 - beta1) * g
+            nv = beta2 * v + (1 - beta2) * jnp.square(g)
+            mhat = nm / (1 - beta1 ** t)
+            vhat = nv / (1 - beta2 ** t)
+            upd = mhat / (jnp.sqrt(vhat) + eps)
+            if opt_name == "adamw":
+                upd = upd + wd * raw
+            if opt_name == "lamb":
+                wn = jnp.linalg.norm(raw)
+                un = jnp.linalg.norm(upd)
+                ratio = jnp.where((wn > 0) & (un > 0), wn / un, 1.0)
+                upd = ratio * upd
+            return raw - lr * upd, (nm, nv)
+
+        def step(params, states, x, y, key, lr, t):
+            (loss, aux), grads = jax.value_and_grad(
+                forward_loss, has_aux=True)(params, x, y, key)
+            new_params, new_states = [], []
+            for raw, g, st, tr, new_raw in zip(params, grads, states,
+                                               trainable, aux):
+                if not tr:
+                    # non-trainable: take the aux-updated value (BN stats)
+                    new_params.append(new_raw)
+                    new_states.append(st)
+                else:
+                    nw, ns = apply_opt(raw, g, st, lr, t)
+                    new_params.append(nw)
+                    new_states.append(ns)
+            return loss, tuple(new_params), tuple(new_states)
+
+        data_sh = mesh_mod.batch_sharding(self.mesh)
+        repl = NamedSharding(self.mesh, PartitionSpec())
+        in_shardings = (tuple(self._param_shardings),
+                        None, data_sh, data_sh, repl, repl, repl)
+        # pin param output shardings to the input layout, else GSPMD may
+        # pick a different layout for returned params and the next call's
+        # in_shardings check rejects them
+        out_shardings = (repl, tuple(self._param_shardings), None)
+        donate = (0, 1) if self._donate else ()
+        self._step_fn = jax.jit(step, in_shardings=in_shardings,
+                                out_shardings=out_shardings,
+                                donate_argnums=donate)
+
+    # -- public api ---------------------------------------------------------
+
+    def step(self, x, y):
+        """One compiled SPMD step; returns scalar loss NDArray."""
+        if isinstance(x, NDArray):
+            x = x._data
+        if isinstance(y, NDArray):
+            y = y._data
+        if self._step_fn is None:
+            self._gather_params(_wrap(jnp.asarray(x[:2])))
+            self._init_opt_states()
+            self._build_step()
+        data_sh = mesh_mod.batch_sharding(self.mesh)
+        x = jax.device_put(jnp.asarray(x), data_sh)
+        y = jax.device_put(jnp.asarray(y), data_sh)
+        self._t += 1
+        key = _random.next_key()
+        loss, self._params, self._states = self._step_fn(
+            self._params, self._states, x, y, key,
+            jnp.asarray(self._lr, jnp.float32),
+            jnp.asarray(float(self._t), jnp.float32))
+        return _wrap(loss)
+
+    @property
+    def learning_rate(self):
+        return self._lr
+
+    def set_learning_rate(self, lr):
+        self._lr = float(lr)
+
+    def sync_to_block(self):
+        """Write the trained params back into the block's Parameters."""
+        if self._named is None:
+            return
+        for (name, p), raw in zip(self._named, self._params):
+            gathered = jax.device_get(raw)
+            from ..ndarray import ndarray as _nd
+
+            p.set_data(_nd.array(np.asarray(gathered)))

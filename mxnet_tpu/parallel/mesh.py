@@ -1,0 +1,64 @@
+"""Device mesh helpers for SPMD parallelism.
+
+Ref: the reference has no mesh concept — its parallelism is explicit
+per-device replicas + kvstore comm (SURVEY §2.3).  The TPU-native
+replacement: a ``jax.sharding.Mesh`` whose axes name the parallelism
+dimensions (dp = data, tp = tensor, pp = pipeline, sp = sequence), with
+XLA inserting ICI collectives from sharding annotations.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+
+
+def make_mesh(axis_shapes=None, devices=None):
+    """Build a Mesh.  axis_shapes: dict axis->size or None for all-dp."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if axis_shapes is None:
+        axis_shapes = {"dp": n}
+    names = tuple(axis_shapes)
+    sizes = tuple(axis_shapes.values())
+    if int(np.prod(sizes)) != n:
+        raise MXNetError(
+            f"mesh {axis_shapes} needs {int(np.prod(sizes))} devices, "
+            f"have {n}")
+    arr = np.array(devices).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharding(mesh, axis="dp"):
+    """Shard dim 0 over the data axis (split_and_load, SPMD form)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def shard_param_spec(shape, mesh, tp_axis="tp"):
+    """Megatron-ish default: shard the largest dim of >=2D params over
+    the tensor axis when divisible; replicate otherwise."""
+    from jax.sharding import PartitionSpec
+
+    if tp_axis not in mesh.axis_names or len(shape) < 2:
+        return PartitionSpec()
+    tp = mesh.shape[tp_axis]
+    if tp <= 1:
+        return PartitionSpec()
+    dims = [None] * len(shape)
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for i in order:
+        if shape[i] % tp == 0 and shape[i] >= tp * 2:
+            dims[i] = tp_axis
+            break
+    return PartitionSpec(*dims)
