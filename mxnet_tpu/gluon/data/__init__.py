@@ -1,0 +1,7 @@
+"""gluon.data (ref: python/mxnet/gluon/data/)."""
+from .dataset import (Dataset, SimpleDataset, ArrayDataset,  # noqa: F401
+                      RecordFileDataset)
+from .sampler import (Sampler, SequentialSampler, RandomSampler,  # noqa: F401
+                      BatchSampler)
+from .dataloader import DataLoader  # noqa: F401
+from . import vision  # noqa: F401

@@ -1,0 +1,84 @@
+"""DataLoader (ref: python/mxnet/gluon/data/dataloader.py).
+
+The reference uses multiprocessing workers rebuilding NDArrays through
+shared memory; that exists to dodge the GIL during OpenCV decode.  Here
+host-side batchification runs on the engine's thread pool (NumPy/PIL
+release the GIL) with a bounded prefetch queue — same overlap, no
+process fork (fork is unsafe once the PjRt runtime is live, the same
+reason the reference forks workers BEFORE CUDA init).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import engine
+from ...ndarray import ndarray as _nd
+from ...ndarray.ndarray import NDArray
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (ref: default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        import jax.numpy as jnp
+
+        return _nd.from_jax(jnp.stack([d._data for d in data]))
+    if isinstance(data[0], tuple):
+        return tuple(default_batchify_fn(list(x)) for x in zip(*data))
+    arr = np.asarray(data)
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    return _nd.array(arr)
+
+
+class DataLoader:
+    """Ref: gluon.data.DataLoader — same signature; num_workers sizes the
+    host thread pool prefetch depth."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 thread_pool=False, timeout=120):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size required without batch_sampler")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle mutually exclusive with sampler")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._prefetch_depth = max(
+            1, prefetch if prefetch is not None else 2 * max(num_workers, 1))
+
+    def __iter__(self):
+        fetch = self._fetch_batch
+        batches = iter(self._batch_sampler)
+        pending = []
+
+        def enqueue():
+            try:
+                idxs = next(batches)
+            except StopIteration:
+                return False
+            pending.append(engine.push_host(fetch, idxs))
+            return True
+
+        for _ in range(self._prefetch_depth):
+            if not enqueue():
+                break
+        while pending:
+            fut = pending.pop(0)
+            out = fut.result()
+            enqueue()
+            yield out
+
+    def _fetch_batch(self, idxs):
+        return self._batchify_fn([self._dataset[i] for i in idxs])
+
+    def __len__(self):
+        return len(self._batch_sampler)

@@ -1,0 +1,162 @@
+"""Vision transforms (ref: python/mxnet/gluon/data/vision/transforms.py).
+
+Transforms are HybridBlocks operating on HWC uint8/float NDArrays
+(MXNet convention) — ToTensor converts to CHW float32 in [0,1].
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ....ndarray import ndarray as _nd
+from ....ndarray.ndarray import NDArray
+from ...block import Block, HybridBlock
+from ...nn.basic_layers import Sequential, HybridSequential
+
+
+class Compose(Sequential):
+    """Ref: transforms.Compose."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        self.add(*transforms)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.cast(x, dtype=self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (ref: ToTensor)."""
+
+    def hybrid_forward(self, F, x):
+        if x.ndim == 4:
+            return F.transpose(F.cast(x, dtype="float32"),
+                               axes=(0, 3, 1, 2)) / 255.0
+        return F.transpose(F.cast(x, dtype="float32"), axes=(2, 0, 1)) / 255.0
+
+
+class Normalize(HybridBlock):
+    """Channel-wise (x - mean)/std on CHW input (ref: Normalize)."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
+        self._std = np.asarray(std, np.float32).reshape(-1, 1, 1)
+
+    def hybrid_forward(self, F, x):
+        mean = _nd.array(self._mean)
+        std = _nd.array(self._std)
+        return (x - mean) / std
+
+
+class Resize(Block):
+    """Resize HWC image (ref: Resize)."""
+
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+        self._keep = keep_ratio
+
+    def forward(self, x):
+        from PIL import Image
+
+        arr = x.asnumpy().astype(np.uint8)
+        squeeze = arr.shape[-1] == 1
+        pil = Image.fromarray(arr[..., 0] if squeeze else arr)
+        w, h = self._size
+        if self._keep:
+            scale = max(w / pil.size[0], h / pil.size[1])
+            pil = pil.resize((int(round(pil.size[0] * scale)),
+                              int(round(pil.size[1] * scale))))
+        else:
+            pil = pil.resize((w, h))
+        out = np.asarray(pil)
+        if squeeze:
+            out = out[..., None]
+        return _nd.array(out, dtype=np.uint8)
+
+
+class CenterCrop(Block):
+    def __init__(self, size):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+
+    def forward(self, x):
+        w, h = self._size
+        ih, iw = x.shape[0], x.shape[1]
+        y0, x0 = max((ih - h) // 2, 0), max((iw - w) // 2, 0)
+        return x[y0:y0 + h, x0:x0 + w]
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        from PIL import Image
+
+        arr = x.asnumpy().astype(np.uint8)
+        squeeze = arr.shape[-1] == 1
+        pil = Image.fromarray(arr[..., 0] if squeeze else arr)
+        iw, ih = pil.size
+        area = iw * ih
+        for _ in range(10):
+            target = area * np.random.uniform(*self._scale)
+            ar = np.random.uniform(*self._ratio)
+            w = int(round(np.sqrt(target * ar)))
+            h = int(round(np.sqrt(target / ar)))
+            if w <= iw and h <= ih:
+                x0 = np.random.randint(0, iw - w + 1)
+                y0 = np.random.randint(0, ih - h + 1)
+                pil = pil.crop((x0, y0, x0 + w, y0 + h))
+                break
+        pil = pil.resize(self._size)
+        out = np.asarray(pil)
+        if squeeze:
+            out = out[..., None]
+        return _nd.array(out, dtype=np.uint8)
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if np.random.rand() < 0.5:
+            return x.flip(axis=1)
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if np.random.rand() < 0.5:
+            return x.flip(axis=0)
+        return x
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._b = brightness
+
+    def forward(self, x):
+        f = 1.0 + np.random.uniform(-self._b, self._b)
+        return (x.astype("float32") * f).clip(0, 255)
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._c = contrast
+
+    def forward(self, x):
+        f = 1.0 + np.random.uniform(-self._c, self._c)
+        xf = x.astype("float32")
+        mean = xf.mean()
+        return ((xf - mean) * f + mean).clip(0, 255)

@@ -1,0 +1,4 @@
+"""IO subsystem (ref: src/io/ + python/mxnet/io/)."""
+from .io import (DataBatch, DataDesc, DataIter, NDArrayIter, MNISTIter,  # noqa: F401
+                 CSVIter, ImageRecordIter, PrefetchingIter, ResizeIter)
+from . import recordio  # noqa: F401

@@ -1,0 +1,480 @@
+"""Data iterators (ref: src/io/ + python/mxnet/io/io.py).
+
+DataIter/DataBatch API kept exactly; the C++ decode-thread pipeline of
+ImageRecordIter (ref: src/io/iter_image_recordio_2.cc) maps to the
+host worker pool (engine.host_pool) with double-buffered prefetch —
+host decode overlaps device compute, the H2D copy is an async
+device_put (ref §3.5 TPU translation).
+"""
+from __future__ import annotations
+
+import os
+import struct
+
+import numpy as np
+
+from .. import engine
+from ..base import MXNetError, getenv
+from ..context import cpu
+from ..ndarray import ndarray as _nd
+from ..ndarray.ndarray import NDArray
+
+
+class DataDesc:
+    def __init__(self, name, shape, dtype=np.float32, layout="NCHW"):
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.layout = layout
+
+    def __repr__(self):
+        return f"DataDesc[{self.name},{self.shape},{self.dtype}]"
+
+
+class DataBatch:
+    """One batch (ref: mx.io.DataBatch)."""
+
+    def __init__(self, data, label=None, pad=0, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """Base iterator (ref: mx.io.DataIter)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(self.getdata(), self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        return 0
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (ref: mx.io.NDArrayIter)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, False, data_name)
+        self.label = _init_data(label, True, label_name)
+        self.num_data = self.data[0][1].shape[0] if self.data else 0
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.cursor = -batch_size
+        self._order = np.arange(self.num_data)
+        if shuffle:
+            np.random.shuffle(self._order)
+        if last_batch_handle == "discard":
+            self.num_batches = self.num_data // batch_size
+        else:
+            self.num_batches = (self.num_data + batch_size - 1) // batch_size
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+        if self.shuffle:
+            np.random.shuffle(self._order)
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        if self.last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def _take(self, arrays):
+        end = self.cursor + self.batch_size
+        idx = self._order[self.cursor:min(end, self.num_data)]
+        if end > self.num_data and self.last_batch_handle == "pad":
+            pad = end - self.num_data
+            idx = np.concatenate([idx, self._order[:pad]])
+        out = []
+        for _, v in arrays:
+            out.append(_nd.array(v[idx]))
+        return out
+
+    def getdata(self):
+        return self._take(self.data)
+
+    def getlabel(self):
+        return self._take(self.label)
+
+    def getpad(self):
+        end = self.cursor + self.batch_size
+        if self.last_batch_handle == "pad" and end > self.num_data:
+            return end - self.num_data
+        return 0
+
+
+def _init_data(data, allow_empty, default_name):
+    if data is None:
+        return []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = {default_name: data}
+    if isinstance(data, (list, tuple)):
+        data = {f"{default_name}_{i}" if i else default_name: d
+                for i, d in enumerate(data)}
+    out = []
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        out.append((k, np.asarray(v)))
+    return out
+
+
+class MNISTIter(DataIter):
+    """Reads the classic idx-ubyte MNIST files (ref: src/io/iter_mnist.cc)."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True,
+                 flat=False, seed=0, silent=False, data_name="data",
+                 label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        self._images = _read_idx_images(image)
+        self._labels = _read_idx_labels(label)
+        if self._images.shape[0] != self._labels.shape[0]:
+            raise MXNetError("MNIST image/label count mismatch")
+        if flat:
+            self._images = self._images.reshape(self._images.shape[0], -1)
+        else:
+            self._images = self._images[:, None, :, :]  # NCHW
+        self._images = self._images.astype(np.float32) / 255.0
+        self._iter = NDArrayIter(
+            {data_name: self._images}, {label_name: self._labels},
+            batch_size=batch_size, shuffle=shuffle,
+            last_batch_handle="discard")
+
+    @property
+    def provide_data(self):
+        return self._iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self._iter.provide_label
+
+    def reset(self):
+        self._iter.reset()
+
+    def next(self):
+        return self._iter.next()
+
+    def iter_next(self):
+        return self._iter.iter_next()
+
+
+def _read_idx_images(path):
+    import gzip
+
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 2051:
+            raise MXNetError(f"{path}: bad MNIST image magic {magic}")
+        return np.frombuffer(f.read(n * rows * cols),
+                             dtype=np.uint8).reshape(n, rows, cols)
+
+
+def _read_idx_labels(path):
+    import gzip
+
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        if magic != 2049:
+            raise MXNetError(f"{path}: bad MNIST label magic {magic}")
+        return np.frombuffer(f.read(n), dtype=np.uint8).astype(np.float32)
+
+
+class CSVIter(DataIter):
+    """Ref: src/io/iter_csv.cc."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32,
+                          ndmin=2)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32,
+                               ndmin=2).reshape((-1,) + tuple(label_shape))
+        else:
+            label = np.zeros((data.shape[0], 1), np.float32)
+        self._iter = NDArrayIter(data, label, batch_size=batch_size,
+                                 last_batch_handle="pad" if round_batch
+                                 else "discard")
+
+    @property
+    def provide_data(self):
+        return self._iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self._iter.provide_label
+
+    def reset(self):
+        self._iter.reset()
+
+    def next(self):
+        return self._iter.next()
+
+
+class ImageRecordIter(DataIter):
+    """ImageNet-style packed-record pipeline (ref: iter_image_recordio_2.cc).
+
+    Decode+augment runs on host worker threads with double-buffered
+    prefetch; batches land as NCHW float32.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size=1,
+                 path_imgidx=None, shuffle=False, rand_crop=False,
+                 rand_mirror=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 std_r=1.0, std_g=1.0, std_b=1.0, resize=-1,
+                 label_width=1, preprocess_threads=4, prefetch_buffer=2,
+                 round_batch=True, seed=0, **kwargs):
+        super().__init__(batch_size)
+        from . import recordio as rio
+
+        self.data_shape = tuple(data_shape)
+        idx_path = path_imgidx or os.path.splitext(path_imgrec)[0] + ".idx"
+        if os.path.exists(idx_path):
+            self._rec = rio.MXIndexedRecordIO(idx_path, path_imgrec, "r")
+            self._keys = list(self._rec.keys)
+        else:
+            self._rec = rio.MXRecordIO(path_imgrec, "r")
+            self._keys = None
+        self.shuffle = shuffle
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.resize = resize
+        self.mean = np.array([mean_r, mean_g, mean_b], np.float32)
+        self.std = np.array([std_r, std_g, std_b], np.float32)
+        self._rng = np.random.RandomState(seed)
+        self._order = None
+        self._pos = 0
+        self._prefetch = []
+        self._prefetch_depth = max(1, prefetch_buffer)
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        self._pos = 0
+        if self._keys is not None:
+            self._order = list(self._keys)
+            if self.shuffle:
+                self._rng.shuffle(self._order)
+        else:
+            self._rec.reset()
+        self._prefetch = []
+        for _ in range(self._prefetch_depth):
+            self._enqueue()
+
+    def _read_raw(self):
+        if self._keys is not None:
+            if self._pos >= len(self._order):
+                return None
+            rec = self._rec.read_idx(self._order[self._pos])
+        else:
+            rec = self._rec.read()
+            if rec is None:
+                return None
+        self._pos += 1
+        return rec
+
+    def _enqueue(self):
+        recs = []
+        for _ in range(self.batch_size):
+            r = self._read_raw()
+            if r is None:
+                break
+            recs.append(r)
+        if len(recs) < self.batch_size:
+            self._prefetch.append(None)
+            return
+        # decode+augment on the host pool (the decode-thread role)
+        fut = engine.push_host(self._decode_batch, recs,
+                               self._rng.randint(1 << 30))
+        self._prefetch.append(fut)
+
+    def _decode_batch(self, recs, seed):
+        from . import recordio as rio
+
+        rng = np.random.RandomState(seed)
+        c, h, w = self.data_shape
+        data = np.empty((len(recs), c, h, w), np.float32)
+        labels = np.empty((len(recs),), np.float32)
+        for i, rec in enumerate(recs):
+            header, img = rio.unpack_img(rec, iscolor=1 if c == 3 else 0)
+            labels[i] = header.label if np.isscalar(header.label) \
+                else header.label[0]
+            img = self._augment(img, rng)
+            if img.ndim == 2:
+                img = img[:, :, None]
+            chw = img.transpose(2, 0, 1).astype(np.float32)
+            chw -= self.mean[:c, None, None]
+            chw /= self.std[:c, None, None]
+            data[i] = chw
+        return data, labels
+
+    def _augment(self, img, rng):
+        from PIL import Image
+
+        c, h, w = self.data_shape
+        if self.resize > 0:
+            pil = Image.fromarray(img)
+            short = min(pil.size)
+            scale = self.resize / short
+            pil = pil.resize((max(w, int(pil.size[0] * scale)),
+                              max(h, int(pil.size[1] * scale))))
+            img = np.asarray(pil)
+        ih, iw = img.shape[:2]
+        if ih < h or iw < w:
+            pil = Image.fromarray(img).resize((max(w, iw), max(h, ih)))
+            img = np.asarray(pil)
+            ih, iw = img.shape[:2]
+        if self.rand_crop:
+            y0 = rng.randint(0, ih - h + 1)
+            x0 = rng.randint(0, iw - w + 1)
+        else:
+            y0, x0 = (ih - h) // 2, (iw - w) // 2
+        img = img[y0:y0 + h, x0:x0 + w]
+        if self.rand_mirror and rng.rand() < 0.5:
+            img = img[:, ::-1]
+        return img
+
+    def next(self):
+        if not self._prefetch:
+            raise StopIteration
+        fut = self._prefetch.pop(0)
+        if fut is None:
+            raise StopIteration
+        data, labels = fut.result()
+        self._enqueue()
+        return DataBatch([_nd.array(data)], [_nd.array(labels)],
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def iter_next(self):
+        return bool(self._prefetch) and self._prefetch[0] is not None
+
+
+class PrefetchingIter(DataIter):
+    """Wrap an iter with async prefetch (ref: src/io/iter_prefetcher.h)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        self._iter = iters if isinstance(iters, DataIter) else iters[0]
+        super().__init__(self._iter.batch_size)
+        self._fut = None
+        self._prime()
+
+    @property
+    def provide_data(self):
+        return self._iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self._iter.provide_label
+
+    def _prime(self):
+        def _pull():
+            try:
+                return self._iter.next()
+            except StopIteration:
+                return None
+
+        self._fut = engine.push_host(_pull)
+
+    def reset(self):
+        if self._fut is not None:
+            self._fut.result()
+        self._iter.reset()
+        self._prime()
+
+    def next(self):
+        batch = self._fut.result()
+        if batch is None:
+            raise StopIteration
+        self._prime()
+        return batch
+
+
+class ResizeIter(DataIter):
+    """Cap an iterator at `size` batches (ref: mx.io.ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def next(self):
+        if self.cur >= self.size:
+            raise StopIteration
+        self.cur += 1
+        try:
+            return self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            return self.data_iter.next()

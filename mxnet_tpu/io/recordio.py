@@ -1,0 +1,214 @@
+"""RecordIO / IndexedRecordIO (ref: 3rdparty/dmlc-core/include/dmlc/
+recordio.h + python/mxnet/recordio.py).
+
+Byte-format compatible with the reference so .rec files pack/unpack
+across frameworks: each record is
+  [kMagic u32][lrec u32][data][pad to 4B]
+where lrec's upper 3 bits are the continuation flag and lower 29 bits
+the length.  IRHeader (image records) = [flag u32][label f32][id u64]
+[id2 u64] optionally followed by extra float labels when flag > 1.
+
+A C++ twin of this reader lives in src/recordio.cc (built to
+libmxtpu_io.so) for the multi-threaded decode pipeline; this Python
+implementation is the reference/oracle and fallback.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import struct
+
+import numpy as np
+
+from ..base import MXNetError
+
+KMAGIC = 0xCED7230A
+_LEN_MASK = (1 << 29) - 1
+
+
+class MXRecordIO:
+    """Sequential record reader/writer (ref: dmlc::RecordIOWriter/Reader)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.record = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.record = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.record = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise MXNetError(f"invalid flag {self.flag}")
+
+    def close(self):
+        if self.record is not None:
+            self.record.close()
+            self.record = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self.record.tell()
+
+    def seek(self, pos):
+        assert not self.writable
+        self.record.seek(pos)
+
+    def write(self, buf):
+        assert self.writable
+        if isinstance(buf, str):
+            buf = buf.encode()
+        n = len(buf)
+        # single record, no continuation chunks (cflag=0); the reference
+        # splits >2^29 records into chunks — enforce the same limit
+        if n > _LEN_MASK:
+            raise MXNetError("record too large (>512MB); chunking TODO")
+        self.record.write(struct.pack("<II", KMAGIC, n))
+        self.record.write(buf)
+        pad = (4 - n % 4) % 4
+        if pad:
+            self.record.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        header = self.record.read(8)
+        if len(header) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", header)
+        if magic != KMAGIC:
+            raise MXNetError(f"{self.uri}: bad record magic {magic:#x}")
+        n = lrec & _LEN_MASK
+        data = self.record.read(n)
+        pad = (4 - n % 4) % 4
+        if pad:
+            self.record.read(pad)
+        return data
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access records via a .idx file (ref: MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if self.flag == "r" and os.path.exists(self.idx_path):
+            with open(self.idx_path) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) >= 2:
+                        k = self.key_type(parts[0])
+                        self.idx[k] = int(parts[1])
+                        self.keys.append(k)
+        elif self.flag == "w":
+            self.fidx = open(self.idx_path, "w")
+
+    def close(self):
+        if self.fidx is not None:
+            self.fidx.close()
+            self.fidx = None
+        super().close()
+
+    def read_idx(self, idx):
+        self.seek(self.idx[idx])
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write(f"{key}\t{pos}\n")
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+IRHeader = collections.namedtuple("IRHeader", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack an IRHeader + payload (ref: mx.recordio.pack)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, (int, float)):
+        hdr = struct.pack(_IR_FORMAT, header.flag, float(header.label),
+                          header.id, header.id2)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        hdr = struct.pack(_IR_FORMAT, label.size, 0.0, header.id,
+                          header.id2) + label.tobytes()
+    if isinstance(s, str):
+        s = s.encode()
+    return hdr + s
+
+
+def unpack(s):
+    """Unpack to (IRHeader, payload) (ref: mx.recordio.unpack)."""
+    flag, label, id_, id2 = struct.unpack(_IR_FORMAT, s[:_IR_SIZE])
+    payload = s[_IR_SIZE:]
+    if flag > 1:
+        label = np.frombuffer(payload[:4 * flag], dtype=np.float32)
+        payload = payload[4 * flag:]
+        header = IRHeader(flag, label, id_, id2)
+    else:
+        header = IRHeader(flag, label, id_, id2)
+    return header, payload
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Encode an image array and pack (ref: mx.recordio.pack_img)."""
+    import io as _io
+
+    from PIL import Image
+
+    arr = np.asarray(img)
+    if arr.ndim == 3 and arr.shape[2] == 3:
+        pil = Image.fromarray(arr.astype(np.uint8))
+    else:
+        pil = Image.fromarray(arr.astype(np.uint8))
+    buf = _io.BytesIO()
+    fmt = "JPEG" if img_fmt in (".jpg", ".jpeg") else "PNG"
+    pil.save(buf, format=fmt, quality=quality)
+    return pack(header, buf.getvalue())
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpack to (IRHeader, image ndarray HWC BGR-free/RGB)."""
+    import io as _io
+
+    from PIL import Image
+
+    header, payload = unpack(s)
+    img = Image.open(_io.BytesIO(payload))
+    if iscolor == 0:
+        img = img.convert("L")
+    elif iscolor == 1:
+        img = img.convert("RGB")
+    return header, np.asarray(img)

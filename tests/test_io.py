@@ -1,0 +1,218 @@
+"""IO tests (ref: tests/python/unittest/test_io.py,
+test_recordio.py, test_gluon_data.py)."""
+import gzip
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.io import (CSVIter, DataBatch, MNISTIter, NDArrayIter,
+                          ImageRecordIter, PrefetchingIter, ResizeIter,
+                          recordio)
+
+
+def test_ndarray_iter_basic():
+    data = np.arange(40, dtype=np.float32).reshape(10, 4)
+    label = np.arange(10, dtype=np.float32)
+    it = NDArrayIter(data, label, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (3, 4)
+    assert batches[3].pad == 2
+    it.reset()
+    assert len(list(it)) == 4
+    # discard mode
+    it2 = NDArrayIter(data, label, batch_size=3,
+                      last_batch_handle="discard")
+    assert len(list(it2)) == 3
+
+
+def test_ndarray_iter_shuffle_and_dict():
+    data = {"a": np.random.rand(8, 2).astype(np.float32)}
+    label = {"lbl": np.arange(8, dtype=np.float32)}
+    it = NDArrayIter(data, label, batch_size=4, shuffle=True)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (4, 2)
+    assert it.provide_data[0].name == "a"
+    assert it.provide_label[0].name == "lbl"
+
+
+def _write_mnist(tmp_path, n=64):
+    img = tmp_path / "train-images-idx3-ubyte"
+    lbl = tmp_path / "train-labels-idx1-ubyte"
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 255, (n, 28, 28), dtype=np.uint8)
+    lbls = rng.randint(0, 10, n).astype(np.uint8)
+    with open(img, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(imgs.tobytes())
+    with open(lbl, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(lbls.tobytes())
+    return str(img), str(lbl), imgs, lbls
+
+
+def test_mnist_iter(tmp_path):
+    img, lbl, imgs, lbls = _write_mnist(tmp_path)
+    it = MNISTIter(image=img, label=lbl, batch_size=16, shuffle=False)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (16, 1, 28, 28)
+    assert batch.data[0].asnumpy().max() <= 1.0
+    assert np.allclose(batch.label[0].asnumpy(), lbls[:16])
+    assert len(list(it)) == 3  # one consumed + 3 remaining of 4
+
+
+def test_mnist_iter_flat(tmp_path):
+    img, lbl, *_ = _write_mnist(tmp_path)
+    it = MNISTIter(image=img, label=lbl, batch_size=8, flat=True,
+                   shuffle=False)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (8, 784)
+
+
+def test_csv_iter(tmp_path):
+    data_csv = tmp_path / "d.csv"
+    np.savetxt(data_csv, np.arange(12).reshape(4, 3), delimiter=",")
+    it = CSVIter(data_csv=str(data_csv), data_shape=(3,), batch_size=2)
+    b = next(iter(it))
+    assert b.data[0].shape == (2, 3)
+
+
+def test_recordio_roundtrip(tmp_path):
+    rec = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    for i in range(5):
+        w.write(f"record{i}".encode())
+    w.close()
+    r = recordio.MXRecordIO(rec, "r")
+    out = []
+    while True:
+        item = r.read()
+        if item is None:
+            break
+        out.append(item.decode())
+    assert out == [f"record{i}" for i in range(5)]
+
+
+def test_indexed_recordio(tmp_path):
+    rec, idx = str(tmp_path / "t.rec"), str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(10):
+        w.write_idx(i, f"rec{i}".encode())
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, rec, "r")
+    assert r.read_idx(7).decode() == "rec7"
+    assert r.read_idx(2).decode() == "rec2"
+    assert len(r.keys) == 10
+
+
+def test_pack_unpack_img(tmp_path):
+    img = (np.random.RandomState(0).rand(32, 32, 3) * 255).astype(np.uint8)
+    s = recordio.pack_img(recordio.IRHeader(0, 3.0, 7, 0), img,
+                          img_fmt=".png")
+    header, out = recordio.unpack_img(s, iscolor=1)
+    assert header.label == 3.0 and header.id == 7
+    assert out.shape == (32, 32, 3)
+    assert np.array_equal(out, img)  # png lossless
+
+
+def test_pack_multi_label():
+    s = recordio.pack(recordio.IRHeader(3, [1.0, 2.0, 3.0], 0, 0), b"x")
+    header, payload = recordio.unpack(s)
+    assert np.allclose(header.label, [1, 2, 3])
+    assert payload == b"x"
+
+
+def _make_rec_dataset(tmp_path, n=12, size=40):
+    rec, idx = str(tmp_path / "img.rec"), str(tmp_path / "img.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    rng = np.random.RandomState(1)
+    for i in range(n):
+        img = (rng.rand(size, size, 3) * 255).astype(np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i, 0), img, img_fmt=".png"))
+    w.close()
+    return rec
+
+
+def test_image_record_iter(tmp_path):
+    rec = _make_rec_dataset(tmp_path)
+    it = ImageRecordIter(path_imgrec=rec, data_shape=(3, 32, 32),
+                         batch_size=4, shuffle=True, rand_crop=True,
+                         rand_mirror=True)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 3, 32, 32)
+    labels = batches[0].label[0].asnumpy()
+    assert ((labels >= 0) & (labels <= 2)).all()
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_prefetching_resize_iter():
+    data = np.random.rand(20, 2).astype(np.float32)
+    base = NDArrayIter(data, np.arange(20, dtype=np.float32), batch_size=5)
+    pre = PrefetchingIter(base)
+    assert len(list(pre)) == 4
+    base2 = NDArrayIter(data, np.arange(20, dtype=np.float32), batch_size=5)
+    rz = ResizeIter(base2, 2)
+    assert len(list(rz)) == 2
+
+
+def test_gluon_dataloader():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    X = np.random.rand(20, 3).astype(np.float32)
+    Y = np.arange(20, dtype=np.float32)
+    ds = ArrayDataset(X, Y)
+    assert len(ds) == 20
+    loader = DataLoader(ds, batch_size=6, shuffle=True, last_batch="keep")
+    batches = list(loader)
+    assert len(batches) == 4
+    assert batches[0][0].shape == (6, 3)
+    # discard
+    loader2 = DataLoader(ds, batch_size=6, last_batch="discard")
+    assert len(list(loader2)) == 3
+
+
+def test_gluon_dataset_transform():
+    from mxnet_tpu.gluon.data import ArrayDataset
+
+    X = np.ones((4, 2), np.float32)
+    Y = np.arange(4, dtype=np.float32)
+    ds = ArrayDataset(X, Y).transform_first(lambda x: x * 2)
+    x0, y0 = ds[0]
+    assert np.allclose(x0, 2.0)
+    assert y0 == 0
+
+
+def test_vision_transforms():
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    img = nd.array((np.random.rand(40, 40, 3) * 255).astype(np.uint8),
+                   dtype=np.uint8)
+    t = T.Compose([T.Resize(32), T.ToTensor(),
+                   T.Normalize(mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5))])
+    out = t(img)
+    assert out.shape == (3, 32, 32)
+    assert out.dtype == np.float32
+    assert out.asnumpy().min() >= -1.01 and out.asnumpy().max() <= 1.01
+    cc = T.CenterCrop(20)(img)
+    assert cc.shape == (20, 20, 3)
+    rrc = T.RandomResizedCrop(16)(img)
+    assert rrc.shape == (16, 16, 3)
+    fl = T.RandomFlipLeftRight()(img)
+    assert fl.shape == (40, 40, 3)
+
+
+def test_synthetic_mnist_dataset():
+    from mxnet_tpu.gluon.data.vision import MNIST
+
+    ds = MNIST(root="/nonexistent-path-xyz", train=False, synthetic=True)
+    x, y = ds[0]
+    assert x.shape == (28, 28, 1)
+    assert 0 <= int(y) <= 9
+    assert len(ds) == 256
