@@ -378,6 +378,18 @@ class HybridBlock(Block):
             "infer_shape; initialize with explicit in_units/in_channels")
 
     def forward(self, x, *args):
+        from ..symbol.symbol import Symbol
+
+        if isinstance(x, Symbol):
+            # symbolic trace (export path, ref: _get_graph): params become
+            # named variables
+            from ..symbol import symbol as sym_ns
+
+            params = {k: (p._traced_value if isinstance(p._traced_value,
+                                                        Symbol)
+                          else sym_ns.var(p.name))
+                      for k, p in self._reg_params.items()}
+            return self.hybrid_forward(sym_ns, x, *args, **params)
         if not isinstance(x, NDArray):
             raise MXNetError("HybridBlock.forward expects NDArray inputs")
         if self._active and not is_tracing():

@@ -1,0 +1,360 @@
+"""Module API: the legacy symbolic trainer.
+
+Ref: python/mxnet/module/{base_module,module}.py — bind/init_params/
+init_optimizer/forward/backward/update/fit/predict/score + checkpoints.
+Data-parallelism (DataParallelExecutorGroup) collapses to one executor
+per context with kvstore aggregation, same as gluon.Trainer.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .. import callback as _callback
+from .. import kvstore as _kvstore
+from .. import metric as _metric
+from .. import optimizer as _opt
+from ..base import MXNetError
+from ..context import cpu, current_context
+from ..initializer import Uniform
+from ..io.io import DataBatch, DataDesc
+from ..ndarray import ndarray as _nd
+from ..ndarray.ndarray import NDArray
+
+
+class BaseModule:
+    def __init__(self, logger=logging):
+        self.logger = logger
+        self.binded = False
+        self.for_training = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+
+    # -- high-level train loop (ref: base_module.py fit) --------------------
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.01),),
+            eval_end_callback=None, eval_batch_end_callback=None,
+            initializer=Uniform(0.01), arg_params=None, aux_params=None,
+            allow_missing=False, force_rebind=False, force_init=False,
+            begin_epoch=0, num_epoch=None, validation_metric=None,
+            monitor=None, sparse_row_id_fn=None):
+        assert num_epoch is not None, "please specify num_epoch"
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_rebind)
+        self.init_params(initializer=initializer, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+        if not isinstance(eval_metric, _metric.EvalMetric):
+            eval_metric = _metric.create(eval_metric)
+        validation_metric = validation_metric or eval_metric
+
+        for epoch in range(begin_epoch, num_epoch):
+            eval_metric.reset()
+            train_data.reset()
+            for nbatch, data_batch in enumerate(train_data):
+                self.forward_backward(data_batch)
+                self.update()
+                self.update_metric(eval_metric, data_batch.label)
+                if batch_end_callback is not None:
+                    p = _callback.BatchEndParam(epoch, nbatch, eval_metric)
+                    for cb in _as_list(batch_end_callback):
+                        cb(p)
+            for name, val in eval_metric.get_name_value():
+                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            if epoch_end_callback is not None:
+                arg_params, aux_params = self.get_params()
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self.symbol, arg_params, aux_params)
+            if eval_data is not None:
+                res = self.score(eval_data, validation_metric,
+                                 epoch=epoch)
+                for name, val in res:
+                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
+                                     name, val)
+
+    def score(self, eval_data, eval_metric, num_batch=None,
+              batch_end_callback=None, reset=True, epoch=0, **kwargs):
+        assert self.binded and self.params_initialized
+        if not isinstance(eval_metric, _metric.EvalMetric):
+            eval_metric = _metric.create(eval_metric)
+        eval_metric.reset()
+        if reset:
+            eval_data.reset()
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(batch, is_train=False)
+            self.update_metric(eval_metric, batch.label)
+        return eval_metric.get_name_value()
+
+    def predict(self, eval_data, num_batch=None, merge_batches=True,
+                reset=True, always_output_list=False):
+        assert self.binded and self.params_initialized
+        if reset:
+            eval_data.reset()
+        outputs = []
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(batch, is_train=False)
+            outs = [o.copy() for o in self.get_outputs()]
+            if batch.pad:
+                outs = [o[:o.shape[0] - batch.pad] for o in outs]
+            outputs.append(outs)
+        if merge_batches:
+            merged = [_nd.concatenate([b[i] for b in outputs], axis=0)
+                      for i in range(len(outputs[0]))]
+            if len(merged) == 1 and not always_output_list:
+                return merged[0]
+            return merged
+        return outputs
+
+    def forward_backward(self, data_batch):
+        self.forward(data_batch, is_train=True)
+        self.backward()
+
+    def _as_list(self, x):
+        return _as_list(x)
+
+
+def _as_list(x):
+    if isinstance(x, (list, tuple)):
+        return x
+    return [x]
+
+
+class Module(BaseModule):
+    """Ref: python/mxnet/module/module.py."""
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger)
+        self._symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._context = context or current_context()
+        if isinstance(self._context, (list, tuple)):
+            self._context = self._context[0]  # multi-device via kvstore TODO
+        self._fixed_param_names = set(fixed_param_names or [])
+        self._exec = None
+        self._optimizer = None
+        self._updater_states = {}
+        self._arg_params = None
+        self._aux_params = None
+        self._data_shapes = None
+        self._label_shapes = None
+
+    @property
+    def symbol(self):
+        return self._symbol
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        return [(n, o.shape) for n, o in
+                zip(self.output_names, self._exec.outputs)]
+
+    # -- bind ---------------------------------------------------------------
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self._data_shapes = [_as_desc(d) for d in data_shapes]
+        self._label_shapes = [_as_desc(l) for l in (label_shapes or [])]
+        shape_kwargs = {d.name: d.shape for d in self._data_shapes}
+        shape_kwargs.update({l.name: l.shape for l in self._label_shapes})
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**shape_kwargs)
+        arg_names = self._symbol.list_arguments()
+        aux_names = self._symbol.list_auxiliary_states()
+        args, grads = {}, {}
+        input_names = set(self._data_names) | set(self._label_names)
+        req = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            args[name] = _nd.zeros(shape, ctx=self._context)
+            if for_training and name not in input_names \
+                    and name not in self._fixed_param_names:
+                grads[name] = _nd.zeros(shape, ctx=self._context)
+                req[name] = grad_req
+            else:
+                req[name] = "null"
+        aux = {n: _nd.zeros(s, ctx=self._context)
+               for n, s in zip(aux_names, aux_shapes)}
+        self._exec = self._symbol.bind(self._context, args, grads, req, aux)
+        self.binded = True
+        self.for_training = for_training
+        if shared_module is not None and shared_module.params_initialized:
+            ap, xp = shared_module.get_params()
+            self.set_params(ap, xp)
+
+    def init_params(self, initializer=Uniform(0.01), arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded
+        input_names = set(self._data_names) | set(self._label_names)
+        for name, arr in self._exec.arg_dict.items():
+            if name in input_names:
+                continue
+            if arg_params is not None and name in arg_params:
+                arr._data = arg_params[name].as_in_context(
+                    self._context)._data
+            else:
+                if arg_params is not None and not allow_missing:
+                    raise MXNetError(f"missing arg_param {name}")
+                initializer(name, arr)
+        for name, arr in self._exec.aux_dict.items():
+            if aux_params is not None and name in aux_params:
+                arr._data = aux_params[name].as_in_context(
+                    self._context)._data
+            else:
+                initializer(name, arr)
+        self.params_initialized = True
+
+    def get_params(self):
+        input_names = set(self._data_names) | set(self._label_names)
+        arg_params = {k: v.copy() for k, v in self._exec.arg_dict.items()
+                      if k not in input_names}
+        aux_params = {k: v.copy() for k, v in self._exec.aux_dict.items()}
+        return arg_params, aux_params
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(None, arg_params, aux_params, allow_missing,
+                         force_init)
+
+    # -- optimizer ----------------------------------------------------------
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer_params, tuple):
+            optimizer_params = dict(optimizer_params)
+        self._optimizer = _opt.create(optimizer, **optimizer_params)
+        self._updater = _opt.get_updater(self._optimizer)
+        self.optimizer_initialized = True
+
+    # -- compute ------------------------------------------------------------
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feed = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feed[name] = arr
+        if data_batch.label is not None:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feed[name] = arr
+        self._exec.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads)
+
+    def update(self):
+        assert self.optimizer_initialized
+        input_names = set(self._data_names) | set(self._label_names)
+        for i, name in enumerate(self._exec._arg_names):
+            if name in input_names or name not in self._exec.grad_dict:
+                continue
+            self._updater(i, self._exec.grad_dict[name],
+                          self._exec.arg_dict[name])
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update(labels, self.get_outputs())
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    # -- checkpoints (ref: module.py save_checkpoint/load) ------------------
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        save_checkpoint(prefix, epoch, self._symbol, *self.get_params())
+        if save_optimizer_states:
+            with open(f"{prefix}-{epoch:04d}.states", "wb") as f:
+                f.write(self._updater.get_states())
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        sym, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        mod = Module(sym, **kwargs)
+        mod._preloaded = (arg_params, aux_params)
+        mod._arg_params, mod._aux_params = arg_params, aux_params
+
+        orig_bind = mod.bind
+
+        def bind_and_set(*a, **k):
+            orig_bind(*a, **k)
+            mod.init_params(arg_params=arg_params, aux_params=aux_params,
+                            allow_missing=False, force_init=True)
+
+        mod.bind = bind_and_set
+        if load_optimizer_states:
+            mod._load_states_path = f"{prefix}-{epoch:04d}.states"
+        return mod
+
+    def load_optimizer_states(self, fname):
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """Ref: mx.model.save_checkpoint format: -symbol.json + -NNNN.params."""
+    symbol.save(f"{prefix}-symbol.json")
+    payload = {f"arg:{k}": v for k, v in arg_params.items()}
+    payload.update({f"aux:{k}": v for k, v in aux_params.items()})
+    _nd.save(f"{prefix}-{epoch:04d}.params", payload)
+
+
+def load_checkpoint(prefix, epoch):
+    from ..symbol import symbol as sym_mod
+
+    sym = sym_mod.load(f"{prefix}-symbol.json")
+    loaded = _nd.load(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for k, v in loaded.items():
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = v
+    return sym, arg_params, aux_params
+
+
+def _as_desc(d):
+    if isinstance(d, DataDesc):
+        return d
+    name, shape = d[0], d[1]
+    return DataDesc(name, shape)

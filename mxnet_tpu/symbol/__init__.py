@@ -1,0 +1,9 @@
+"""Symbolic frontend (ref: python/mxnet/symbol/)."""
+from .symbol import (Symbol, Executor, var, Variable, load, fromjson,  # noqa: F401
+                     Group)
+from . import symbol as _symbol_mod
+from . import export  # noqa: F401
+
+
+def __getattr__(name):
+    return getattr(_symbol_mod, name)

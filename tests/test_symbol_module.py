@@ -1,0 +1,200 @@
+"""Symbol + Module tests (ref: tests/python/unittest/test_symbol.py,
+test_module.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.io import NDArrayIter
+from mxnet_tpu.module import BucketingModule, Module
+
+
+def _mlp_symbol(hidden=8, classes=3):
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, num_hidden=hidden, name="fc1")
+    act = sym.Activation(fc1, act_type="relu")
+    fc2 = sym.FullyConnected(act, num_hidden=classes, name="fc2")
+    return sym.SoftmaxOutput(fc2, sym.var("softmax_label"), name="softmax")
+
+
+def test_symbol_compose_and_arguments():
+    s = _mlp_symbol()
+    args = s.list_arguments()
+    assert "data" in args and "fc1_weight" in args and "fc1_bias" in args
+    assert "fc2_weight" in args and "softmax_label" in args
+
+
+def test_symbol_infer_shape():
+    s = _mlp_symbol(hidden=8, classes=3)
+    arg_shapes, out_shapes, aux_shapes = s.infer_shape(
+        data=(4, 10), softmax_label=(4,))
+    args = s.list_arguments()
+    d = dict(zip(args, arg_shapes))
+    assert d["fc1_weight"] == (8, 10)
+    assert d["fc1_bias"] == (8,)
+    assert d["fc2_weight"] == (3, 8)
+    assert out_shapes == [(4, 3)]
+
+
+def test_symbol_json_roundtrip(tmp_path):
+    s = _mlp_symbol()
+    js = s.tojson()
+    s2 = sym.fromjson(js)
+    assert s2.list_arguments() == s.list_arguments()
+    f = str(tmp_path / "m-symbol.json")
+    s.save(f)
+    s3 = sym.load(f)
+    assert s3.list_arguments() == s.list_arguments()
+
+
+def test_symbol_bind_forward_backward():
+    data = sym.var("data")
+    w = sym.var("w")
+    out = sym.FullyConnected(data, w, num_hidden=2, no_bias=True,
+                             name="fc")
+    x_np = np.random.rand(3, 4).astype(np.float32)
+    w_np = np.random.rand(2, 4).astype(np.float32)
+    ex = out.bind(mx.cpu(), {"data": nd.array(x_np), "w": nd.array(w_np)},
+                  {"data": nd.zeros((3, 4)), "w": nd.zeros((2, 4))})
+    (y,) = ex.forward(is_train=True)
+    assert np.allclose(y.asnumpy(), x_np @ w_np.T, atol=1e-5)
+    ex.backward(nd.ones((3, 2)))
+    assert np.allclose(ex.grad_dict["w"].asnumpy(),
+                       np.ones((3, 2)).T @ x_np, atol=1e-5)
+
+
+def test_symbol_simple_bind_and_eval():
+    s = _mlp_symbol()
+    ex = s.simple_bind(ctx=mx.cpu(), data=(2, 6), softmax_label=(2,))
+    assert ex.arg_dict["fc1_weight"].shape == (8, 6)
+    ex.arg_dict["data"][:] = 1.0
+    outs = ex.forward()
+    assert outs[0].shape == (2, 3)
+    # softmax outputs sum to 1
+    assert np.allclose(outs[0].asnumpy().sum(1), 1.0, atol=1e-5)
+
+
+def test_symbol_arithmetic():
+    a, b = sym.var("a"), sym.var("b")
+    c = (a + b) * 2 - a / 2
+    ex = c.bind(mx.cpu(), {"a": nd.array([2.0]), "b": nd.array([3.0])})
+    (out,) = ex.forward()
+    assert np.isclose(out.asscalar(), (2 + 3) * 2 - 1.0)
+
+
+def test_symbol_internals_getitem():
+    s = _mlp_symbol()
+    internals = s.get_internals()
+    fc1_out = internals["fc1_output"]
+    assert fc1_out.name == "fc1"
+
+
+def test_module_fit_convergence():
+    """Train-as-test (ref: tests/python/train/): Module.fit learns."""
+    np.random.seed(0)
+    mx.random.seed(0)
+    n, d = 400, 8
+    X = np.random.rand(n, d).astype(np.float32)
+    Y = (X.sum(axis=1) > d / 2).astype(np.float32)
+
+    s = _mlp_symbol(hidden=16, classes=2)
+    train_iter = NDArrayIter(X, Y, batch_size=40, shuffle=True,
+                             label_name="softmax_label")
+    mod = Module(s, context=mx.cpu())
+    mod.fit(train_iter, num_epoch=12, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier())
+    from mxnet_tpu import metric
+
+    acc_res = mod.score(NDArrayIter(X, Y, batch_size=40), "acc")
+    assert acc_res[0][1] > 0.9, acc_res
+
+
+def test_module_predict_and_checkpoint(tmp_path):
+    np.random.seed(1)
+    s = _mlp_symbol(hidden=4, classes=2)
+    X = np.random.rand(20, 5).astype(np.float32)
+    it = NDArrayIter(X, np.zeros(20, np.float32), batch_size=5)
+    mod = Module(s, context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    preds = mod.predict(it)
+    assert preds.shape == (20, 2)
+
+    prefix = str(tmp_path / "model")
+    mod.init_optimizer()
+    mod.save_checkpoint(prefix, 3)
+    assert os.path.exists(prefix + "-symbol.json")
+    assert os.path.exists(prefix + "-0003.params")
+
+    mod2 = Module.load(prefix, 3, context=mx.cpu())
+    mod2.bind(it.provide_data, it.provide_label, for_training=False)
+    preds2 = mod2.predict(it)
+    assert np.allclose(preds.asnumpy(), preds2.asnumpy(), atol=1e-5)
+
+
+def test_bucketing_module():
+    """Ref: tests/python/train/test_bucketing.py — shared params across
+    sequence-length buckets."""
+    np.random.seed(2)
+
+    def sym_gen(seq_len):
+        data = sym.var("data")
+        fc = sym.FullyConnected(data, num_hidden=4, name="shared_fc",
+                                flatten=False)
+        pooled = sym.mean(fc, axis=1)
+        out = sym.SoftmaxOutput(pooled, sym.var("softmax_label"),
+                                name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    mod = BucketingModule(sym_gen, default_bucket_key=10, context=mx.cpu())
+    from mxnet_tpu.io import DataBatch, DataDesc
+
+    def make_batch(seq_len, bs=4):
+        return DataBatch(
+            [nd.array(np.random.rand(bs, seq_len, 6))],
+            [nd.array(np.random.randint(0, 4, bs))],
+            bucket_key=seq_len,
+            provide_data=[DataDesc("data", (bs, seq_len, 6))],
+            provide_label=[DataDesc("softmax_label", (bs,))])
+
+    mod.bind([DataDesc("data", (4, 10, 6))],
+             [DataDesc("softmax_label", (4,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+
+    for seq_len in (10, 5, 20, 10, 5):
+        batch = make_batch(seq_len)
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    # shared param persisted across buckets
+    arg_params, _ = mod.get_params()
+    assert "shared_fc_weight" in arg_params
+    assert arg_params["shared_fc_weight"].shape == (4, 6)
+
+
+def test_export_and_symbolblock(tmp_path):
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(5, activation="relu"), nn.Dense(3))
+    net.initialize()
+    x = nd.random.uniform(shape=(2, 4))
+    ref = net(x).asnumpy()
+    prefix = str(tmp_path / "exported")
+    sym_file, param_file = net.export(prefix, epoch=7)
+    assert os.path.exists(sym_file) and os.path.exists(param_file)
+
+    # load through the Module path
+    from mxnet_tpu.module.module import load_checkpoint
+
+    s, arg_params, aux_params = load_checkpoint(prefix, 7)
+    assert "data" in s.list_arguments()
+    ex = s.simple_bind(ctx=mx.cpu(), data=(2, 4))
+    ex.copy_params_from(arg_params, aux_params)
+    ex.forward(data=x)
+    assert np.allclose(ex.outputs[0].asnumpy(), ref, atol=1e-5)
