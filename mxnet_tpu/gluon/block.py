@@ -286,8 +286,13 @@ class CachedOp:
                 _tracing.active = prev_active
                 for p, old in zip(params, old_traced):
                     p._traced_value = old
-            multi = isinstance(out, (tuple, list))
-            outs = list(out) if multi else [out]
+            import jax
+
+            # arbitrary nesting (e.g. RNN layers return (out, [h, c])):
+            # flatten with NDArray leaves, remember the treedef
+            leaves, treedef = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, NDArray))
+            outs = [o for o in leaves if isinstance(o, NDArray)]
             # aux side effects (BatchNorm moving stats): wrapper buffers
             # replaced in place during forward
             aux_names, aux_raws = [], []
@@ -296,7 +301,7 @@ class CachedOp:
                 if w._data is not r:
                     aux_names.append(name)
                     aux_raws.append(w._data)
-            cached._meta[train] = (len(outs), aux_names, multi)
+            cached._meta[train] = (len(outs), aux_names, treedef)
             return tuple(o._data for o in outs) + tuple(aux_raws)
 
         return _cached_graph_fn
@@ -324,7 +329,7 @@ class CachedOp:
                      _n_params=len(param_nds))
         if not isinstance(res, tuple):
             res = (res,)
-        n_outs, aux_names, multi = self._meta[train]
+        n_outs, aux_names, treedef = self._meta[train]
         outs, auxs = res[:n_outs], res[n_outs:]
         if aux_names:
             pdict = dict(named)
@@ -332,9 +337,9 @@ class CachedOp:
                 p = pdict[name]
                 target = p.data(ctx) if ctx in (p._data or {}) else p.data()
                 target._data = new._data
-        if multi:
-            return list(outs)
-        return outs[0]
+        import jax
+
+        return jax.tree_util.tree_unflatten(treedef, list(outs))
 
 
 class HybridBlock(Block):
@@ -404,7 +409,7 @@ class HybridBlock(Block):
         return self._eager_forward(x, *args)
 
     def _eager_forward(self, x, *args):
-        from ..ndarray import ops as F  # eager namespace
+        from .. import ndarray as F  # eager namespace (ops + creation fns)
 
         ctx = None
         if not is_tracing():  # tracers have no concrete device

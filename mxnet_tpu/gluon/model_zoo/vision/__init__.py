@@ -1,0 +1,24 @@
+"""model_zoo.vision (ref: python/mxnet/gluon/model_zoo/vision/)."""
+from .resnet import (get_resnet, resnet18_v1, resnet34_v1, resnet50_v1,  # noqa: F401
+                     resnet101_v1, resnet152_v1, resnet18_v2, resnet34_v2,
+                     resnet50_v2, resnet101_v2, resnet152_v2, ResNetV1,
+                     ResNetV2)
+from .others import (alexnet, lenet, AlexNet, LeNet, VGG, get_vgg, vgg11,  # noqa: F401
+                     vgg13, vgg16, vgg19, vgg16_bn, vgg19_bn, MobileNet,
+                     MobileNetV2, mobilenet1_0, mobilenet0_5, mobilenet0_25,
+                     mobilenet_v2_1_0, SqueezeNet, squeezenet1_0,
+                     squeezenet1_1, DenseNet, densenet121, densenet169,
+                     densenet201)
+
+_models = {k: v for k, v in globals().items() if callable(v)
+           and not k.startswith("_") and k not in
+           ("get_resnet", "get_vgg")}
+
+
+def get_model(name, **kwargs):
+    """Ref: model_zoo.vision.get_model."""
+    name = name.lower()
+    if name not in _models:
+        raise ValueError(
+            f"unknown model {name!r}; available: {sorted(_models)}")
+    return _models[name](**kwargs)

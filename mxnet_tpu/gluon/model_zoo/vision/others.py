@@ -1,0 +1,341 @@
+"""AlexNet / VGG / MobileNet / SqueezeNet / DenseNet / LeNet
+(ref: python/mxnet/gluon/model_zoo/vision/{alexnet,vgg,mobilenet,
+squeezenet,densenet}.py)."""
+from __future__ import annotations
+
+from ....base import MXNetError
+from ...block import HybridBlock
+from ... import nn
+
+
+class LeNet(HybridBlock):
+    """The BASELINE LeNet/MNIST model (ref: example/image-classification)."""
+
+    def __init__(self, classes=10, **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential()
+        self.features.add(
+            nn.Conv2D(20, kernel_size=5, activation="tanh"),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(50, kernel_size=5, activation="tanh"),
+            nn.MaxPool2D(2, 2),
+            nn.Flatten(),
+            nn.Dense(500, activation="tanh"))
+        self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+class AlexNet(HybridBlock):
+    def __init__(self, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential()
+        self.features.add(
+            nn.Conv2D(64, 11, 4, 2, activation="relu"),
+            nn.MaxPool2D(3, 2),
+            nn.Conv2D(192, 5, padding=2, activation="relu"),
+            nn.MaxPool2D(3, 2),
+            nn.Conv2D(384, 3, padding=1, activation="relu"),
+            nn.Conv2D(256, 3, padding=1, activation="relu"),
+            nn.Conv2D(256, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(3, 2),
+            nn.Flatten(),
+            nn.Dense(4096, activation="relu"), nn.Dropout(0.5),
+            nn.Dense(4096, activation="relu"), nn.Dropout(0.5))
+        self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
+            13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
+            16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
+            19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512])}
+
+
+class VGG(HybridBlock):
+    def __init__(self, layers, filters, classes=1000, batch_norm=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential()
+        for i, num in enumerate(layers):
+            for _ in range(num):
+                self.features.add(nn.Conv2D(filters[i], 3, padding=1))
+                if batch_norm:
+                    self.features.add(nn.BatchNorm())
+                self.features.add(nn.Activation("relu"))
+            self.features.add(nn.MaxPool2D(2, 2))
+        self.features.add(nn.Flatten())
+        self.features.add(nn.Dense(4096, activation="relu"), nn.Dropout(0.5))
+        self.features.add(nn.Dense(4096, activation="relu"), nn.Dropout(0.5))
+        self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+def get_vgg(num_layers, **kwargs):
+    if kwargs.pop("pretrained", False):
+        raise MXNetError("pretrained weights unavailable (no egress)")
+    layers, filters = vgg_spec[num_layers]
+    return VGG(layers, filters, **kwargs)
+
+
+class MobileNet(HybridBlock):
+    """MobileNet v1 (depthwise separable convs)."""
+
+    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential()
+
+        def conv_bn(c, k, s, p, g=1):
+            self.features.add(nn.Conv2D(c, k, s, p, groups=g,
+                                        use_bias=False))
+            self.features.add(nn.BatchNorm())
+            self.features.add(nn.Activation("relu"))
+
+        dw_channels = [int(x * multiplier) for x in
+                       [32, 64] + [128] * 2 + [256] * 2 + [512] * 6
+                       + [1024]]
+        channels = [int(x * multiplier) for x in
+                    [64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024] * 2]
+        strides = [1, 2] * 3 + [1] * 5 + [2, 1]
+        conv_bn(dw_channels[0], 3, 2, 1)
+        for dwc, c, s in zip(dw_channels, channels, strides):
+            conv_bn(dwc, 3, s, 1, g=dwc)  # depthwise
+            conv_bn(c, 1, 1, 0)           # pointwise
+        self.features.add(nn.GlobalAvgPool2D())
+        self.features.add(nn.Flatten())
+        self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+class _InvertedResidual(HybridBlock):
+    def __init__(self, in_c, c, stride, expand, **kwargs):
+        super().__init__(**kwargs)
+        self.use_shortcut = stride == 1 and in_c == c
+        mid = in_c * expand
+        self.out = nn.HybridSequential()
+        if expand != 1:
+            self.out.add(nn.Conv2D(mid, 1, use_bias=False), nn.BatchNorm())
+            self.out.add(nn.Activation("relu"))
+        self.out.add(nn.Conv2D(mid, 3, stride, 1, groups=mid,
+                               use_bias=False), nn.BatchNorm())
+        self.out.add(nn.Activation("relu"))
+        self.out.add(nn.Conv2D(c, 1, use_bias=False), nn.BatchNorm())
+
+    def hybrid_forward(self, F, x):
+        out = self.out(x)
+        if self.use_shortcut:
+            return out + x
+        return out
+
+
+class MobileNetV2(HybridBlock):
+    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        m = multiplier
+        self.features = nn.HybridSequential()
+        self.features.add(nn.Conv2D(int(32 * m), 3, 2, 1, use_bias=False),
+                          nn.BatchNorm(), nn.Activation("relu"))
+        cfg = [  # t, c, n, s
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        in_c = int(32 * m)
+        for t, c, n, s in cfg:
+            c = int(c * m)
+            for i in range(n):
+                self.features.add(_InvertedResidual(
+                    in_c, c, s if i == 0 else 1, t))
+                in_c = c
+        last = int(1280 * max(1.0, m))
+        self.features.add(nn.Conv2D(last, 1, use_bias=False),
+                          nn.BatchNorm(), nn.Activation("relu"),
+                          nn.GlobalAvgPool2D(), nn.Flatten())
+        self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+class SqueezeNet(HybridBlock):
+    def __init__(self, version="1.0", classes=1000, **kwargs):
+        super().__init__(**kwargs)
+
+        def fire(squeeze, expand):
+            out = nn.HybridSequential()
+            out.add(nn.Conv2D(squeeze, 1, activation="relu"))
+            exp = _FireExpand(expand)
+            out.add(exp)
+            return out
+
+        self.features = nn.HybridSequential()
+        if version == "1.0":
+            self.features.add(nn.Conv2D(96, 7, 2, activation="relu"),
+                              nn.MaxPool2D(3, 2))
+            for sq, ex in [(16, 64), (16, 64), (32, 128)]:
+                self.features.add(fire(sq, ex))
+            self.features.add(nn.MaxPool2D(3, 2))
+            for sq, ex in [(32, 128), (48, 192), (48, 192), (64, 256)]:
+                self.features.add(fire(sq, ex))
+            self.features.add(nn.MaxPool2D(3, 2))
+            self.features.add(fire(64, 256))
+        else:
+            self.features.add(nn.Conv2D(64, 3, 2, activation="relu"),
+                              nn.MaxPool2D(3, 2))
+            for sq, ex in [(16, 64), (16, 64)]:
+                self.features.add(fire(sq, ex))
+            self.features.add(nn.MaxPool2D(3, 2))
+            for sq, ex in [(32, 128), (32, 128)]:
+                self.features.add(fire(sq, ex))
+            self.features.add(nn.MaxPool2D(3, 2))
+            for sq, ex in [(48, 192), (48, 192), (64, 256), (64, 256)]:
+                self.features.add(fire(sq, ex))
+        self.features.add(nn.Dropout(0.5))
+        self.output = nn.HybridSequential()
+        self.output.add(nn.Conv2D(classes, 1, activation="relu"),
+                        nn.GlobalAvgPool2D(), nn.Flatten())
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+class _FireExpand(HybridBlock):
+    def __init__(self, expand, **kwargs):
+        super().__init__(**kwargs)
+        self.e1 = nn.Conv2D(expand, 1, activation="relu")
+        self.e3 = nn.Conv2D(expand, 3, padding=1, activation="relu")
+
+    def hybrid_forward(self, F, x):
+        return F.concat(self.e1(x), self.e3(x), dim=1)
+
+
+class _DenseLayer(HybridBlock):
+    def __init__(self, growth_rate, bn_size, dropout, **kwargs):
+        super().__init__(**kwargs)
+        self.body = nn.HybridSequential()
+        self.body.add(nn.BatchNorm(), nn.Activation("relu"),
+                      nn.Conv2D(bn_size * growth_rate, 1, use_bias=False),
+                      nn.BatchNorm(), nn.Activation("relu"),
+                      nn.Conv2D(growth_rate, 3, padding=1, use_bias=False))
+        if dropout:
+            self.body.add(nn.Dropout(dropout))
+
+    def hybrid_forward(self, F, x):
+        return F.concat(x, self.body(x), dim=1)
+
+
+densenet_spec = {121: (64, 32, [6, 12, 24, 16]),
+                 161: (96, 48, [6, 12, 36, 24]),
+                 169: (64, 32, [6, 12, 32, 32]),
+                 201: (64, 32, [6, 12, 48, 32])}
+
+
+class DenseNet(HybridBlock):
+    def __init__(self, num_init_features, growth_rate, block_config,
+                 bn_size=4, dropout=0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential()
+        self.features.add(nn.Conv2D(num_init_features, 7, 2, 3,
+                                    use_bias=False),
+                          nn.BatchNorm(), nn.Activation("relu"),
+                          nn.MaxPool2D(3, 2, 1))
+        channels = num_init_features
+        for i, num_layers in enumerate(block_config):
+            for _ in range(num_layers):
+                self.features.add(_DenseLayer(growth_rate, bn_size, dropout))
+            channels += num_layers * growth_rate
+            if i != len(block_config) - 1:
+                self.features.add(nn.BatchNorm(), nn.Activation("relu"),
+                                  nn.Conv2D(channels // 2, 1,
+                                            use_bias=False),
+                                  nn.AvgPool2D(2, 2))
+                channels //= 2
+        self.features.add(nn.BatchNorm(), nn.Activation("relu"),
+                          nn.GlobalAvgPool2D(), nn.Flatten())
+        self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+def alexnet(**kw):
+    kw.pop("pretrained", None)
+    return AlexNet(**kw)
+
+
+def lenet(**kw):
+    return LeNet(**kw)
+
+
+def vgg11(**kw):
+    return get_vgg(11, **kw)
+
+
+def vgg13(**kw):
+    return get_vgg(13, **kw)
+
+
+def vgg16(**kw):
+    return get_vgg(16, **kw)
+
+
+def vgg19(**kw):
+    return get_vgg(19, **kw)
+
+
+def vgg16_bn(**kw):
+    return get_vgg(16, batch_norm=True, **kw)
+
+
+def vgg19_bn(**kw):
+    return get_vgg(19, batch_norm=True, **kw)
+
+
+def mobilenet1_0(**kw):
+    kw.pop("pretrained", None)
+    return MobileNet(1.0, **kw)
+
+
+def mobilenet0_5(**kw):
+    kw.pop("pretrained", None)
+    return MobileNet(0.5, **kw)
+
+
+def mobilenet0_25(**kw):
+    kw.pop("pretrained", None)
+    return MobileNet(0.25, **kw)
+
+
+def mobilenet_v2_1_0(**kw):
+    kw.pop("pretrained", None)
+    return MobileNetV2(1.0, **kw)
+
+
+def squeezenet1_0(**kw):
+    kw.pop("pretrained", None)
+    return SqueezeNet("1.0", **kw)
+
+
+def squeezenet1_1(**kw):
+    kw.pop("pretrained", None)
+    return SqueezeNet("1.1", **kw)
+
+
+def densenet121(**kw):
+    kw.pop("pretrained", None)
+    return DenseNet(*densenet_spec[121], **kw)
+
+
+def densenet169(**kw):
+    kw.pop("pretrained", None)
+    return DenseNet(*densenet_spec[169], **kw)
+
+
+def densenet201(**kw):
+    kw.pop("pretrained", None)
+    return DenseNet(*densenet_spec[201], **kw)

@@ -1,0 +1,4 @@
+"""gluon.rnn (ref: python/mxnet/gluon/rnn/)."""
+from .rnn_layer import RNN, LSTM, GRU  # noqa: F401
+from .rnn_cell import (RecurrentCell, RNNCell, LSTMCell, GRUCell,  # noqa: F401
+                       SequentialRNNCell, DropoutCell, ResidualCell)

@@ -1,0 +1,118 @@
+"""BERT (ref workload: BASELINE config 'BERT-base MLM pretrain
+(GluonNLP, Trainer + kvstore all-reduce on pod)'; model structure after
+the GluonNLP-era BERTModel: embeddings + transformer encoder + MLM/NSP
+heads).
+
+TPU notes: attention uses the fused scaled_dot_product_attention op
+(pallas flash path on TPU); everything hybridizes into one XLA step.
+"""
+from __future__ import annotations
+
+import math
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+
+
+class BERTEncoderLayer(HybridBlock):
+    def __init__(self, units=768, hidden_size=3072, num_heads=12,
+                 dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._num_heads = num_heads
+        self.attn_in_weight = self.params.get(
+            "attn_in_weight", shape=(3 * units, units))
+        self.attn_in_bias = self.params.get(
+            "attn_in_bias", shape=(3 * units,), init="zeros")
+        self.attn_out_weight = self.params.get(
+            "attn_out_weight", shape=(units, units))
+        self.attn_out_bias = self.params.get(
+            "attn_out_bias", shape=(units,), init="zeros")
+        self.attn_ln = nn.LayerNorm(in_channels=units)
+        self.ffn1 = nn.Dense(hidden_size, flatten=False)
+        self.ffn2 = nn.Dense(units, flatten=False)
+        self.ffn_ln = nn.LayerNorm(in_channels=units)
+        self.dropout = nn.Dropout(dropout)
+
+    def hybrid_forward(self, F, x, mask=None, attn_in_weight=None,
+                       attn_in_bias=None, attn_out_weight=None,
+                       attn_out_bias=None):
+        att = F.multihead_attention(x, x, x, attn_in_weight, attn_in_bias,
+                                    attn_out_weight, attn_out_bias, mask,
+                                    num_heads=self._num_heads)
+        x = self.attn_ln(x + self.dropout(att))
+        h = self.ffn2(F.LeakyReLU(self.ffn1(x), act_type="gelu"))
+        return self.ffn_ln(x + self.dropout(h))
+
+
+class BERTEncoder(HybridBlock):
+    def __init__(self, num_layers=12, units=768, hidden_size=3072,
+                 num_heads=12, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self.layers = nn.HybridSequential()
+        for _ in range(num_layers):
+            self.layers.add(BERTEncoderLayer(units, hidden_size, num_heads,
+                                             dropout))
+
+    def hybrid_forward(self, F, x, mask=None):
+        for layer in self.layers:
+            x = layer(x, mask)
+        return x
+
+
+class BERTModel(HybridBlock):
+    """BERT backbone + MLM decoder + NSP classifier."""
+
+    def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
+                 num_layers=12, num_heads=12, max_length=512,
+                 type_vocab_size=2, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self.word_embed = nn.Embedding(vocab_size, units)
+        self.token_type_embed = nn.Embedding(type_vocab_size, units)
+        self.position_embed = nn.Embedding(max_length, units)
+        self.embed_ln = nn.LayerNorm(in_channels=units)
+        self.embed_dropout = nn.Dropout(dropout)
+        self.encoder = BERTEncoder(num_layers, units, hidden_size,
+                                   num_heads, dropout)
+        self.pooler = nn.Dense(units, flatten=False, activation="tanh")
+        # MLM head (decoder shares transform; tied embedding optional)
+        self.mlm_transform = nn.Dense(units, flatten=False)
+        self.mlm_ln = nn.LayerNorm(in_channels=units)
+        self.mlm_decoder = nn.Dense(vocab_size, flatten=False)
+        self.nsp_classifier = nn.Dense(2, flatten=False)
+
+    def hybrid_forward(self, F, inputs, token_types, valid_length=None):
+        seq_len = inputs.shape[1]
+        positions = F.arange(0, seq_len, dtype="int32")
+        x = self.word_embed(inputs) + self.token_type_embed(token_types)
+        x = x + self.position_embed(positions)
+        x = self.embed_dropout(self.embed_ln(x))
+        mask = None
+        if valid_length is not None:
+            steps = F.arange(0, seq_len, dtype="float32")
+            m = F.broadcast_lesser(
+                steps.reshape(1, -1), valid_length.reshape(-1, 1))
+            mask = (m.reshape(m.shape[0], 1, 1, seq_len) - 1.0) * 1e9
+        seq = self.encoder(x, mask)
+        pooled = self.pooler(seq.slice_axis(1, 0, 1).reshape(
+            seq.shape[0], self._units))
+        mlm = self.mlm_decoder(
+            self.mlm_ln(F.LeakyReLU(self.mlm_transform(seq),
+                                    act_type="gelu")))
+        nsp = self.nsp_classifier(pooled)
+        return mlm, nsp
+
+
+def bert_base(vocab_size=30522, **kwargs):
+    """BERT-base: 12 layers, 768 units, 12 heads (the BASELINE config)."""
+    return BERTModel(vocab_size, 768, 3072, 12, 12, **kwargs)
+
+
+def bert_large(vocab_size=30522, **kwargs):
+    return BERTModel(vocab_size, 1024, 4096, 24, 16, **kwargs)
+
+
+def bert_tiny(vocab_size=1000, **kwargs):
+    """Small config for tests."""
+    return BERTModel(vocab_size, 64, 128, 2, 4, max_length=128, **kwargs)

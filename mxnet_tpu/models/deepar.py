@@ -1,0 +1,137 @@
+"""DeepAR probabilistic forecaster (ref workload: BASELINE config
+'DeepAR probabilistic forecasting (GluonTS, LSTM cell kernels →
+Pallas)'; structure after the GluonTS DeepAREstimator: autoregressive
+LSTM over lagged targets + covariates, Student-t / Gaussian output
+head, NLL training, ancestral-sampling prediction).
+
+The recurrence runs through the fused lax.scan LSTM (ops/rnn.py).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..gluon import nn, rnn
+from ..gluon.block import HybridBlock
+
+
+class GaussianOutput(HybridBlock):
+    """Projects hidden state to (mu, sigma); sigma via softplus."""
+
+    def __init__(self, in_units=0, **kwargs):
+        super().__init__(**kwargs)
+        self.proj = nn.Dense(2, flatten=False)
+
+    def hybrid_forward(self, F, h):
+        out = self.proj(h)
+        mu = out.slice_axis(-1, 0, 1)
+        sigma = F.Activation(out.slice_axis(-1, 1, 2), act_type="softrelu")
+        return mu.squeeze(axis=-1), sigma.squeeze(axis=-1) + 1e-4
+
+    @staticmethod
+    def nll(F, target, mu, sigma):
+        return (F.log(sigma) + 0.5 * math.log(2 * math.pi)
+                + 0.5 * F.square((target - mu) / sigma))
+
+    @staticmethod
+    def sample(mu, sigma, rng):
+        return rng.normal(mu, sigma)
+
+
+class StudentTOutput(HybridBlock):
+    """(mu, sigma, nu) head — the GluonTS default for DeepAR."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.proj = nn.Dense(3, flatten=False)
+
+    def hybrid_forward(self, F, h):
+        out = self.proj(h)
+        mu = out.slice_axis(-1, 0, 1).squeeze(axis=-1)
+        sigma = F.Activation(out.slice_axis(-1, 1, 2),
+                             act_type="softrelu").squeeze(axis=-1) + 1e-4
+        nu = 2.0 + F.Activation(out.slice_axis(-1, 2, 3),
+                                act_type="softrelu").squeeze(axis=-1)
+        return mu, sigma, nu
+
+    @staticmethod
+    def nll(F, target, mu, sigma, nu):
+        z = (target - mu) / sigma
+        return -(F.gammaln((nu + 1) / 2) - F.gammaln(nu / 2)
+                 - 0.5 * F.log(3.141592653589793 * nu) - F.log(sigma)
+                 - (nu + 1) / 2 * F.log(1 + F.square(z) / nu))
+
+
+class DeepARNetwork(HybridBlock):
+    """Training network: unrolls LSTM over context+prediction range and
+    returns per-step NLL."""
+
+    def __init__(self, num_cells=40, num_layers=2, dropout=0.1,
+                 distr="student_t", num_lags=3, **kwargs):
+        super().__init__(**kwargs)
+        self._num_lags = num_lags
+        self.lstm = rnn.LSTM(num_cells, num_layers, layout="NTC",
+                             dropout=dropout)
+        self.distr_output = StudentTOutput() if distr == "student_t" \
+            else GaussianOutput()
+        self._distr = distr
+
+    def _lag_features(self, F, target):
+        # target: (batch, T). features: lags 1..num_lags -> (batch, T, L)
+        lags = []
+        for lag in range(1, self._num_lags + 1):
+            padded = F.pad(target.expand_dims(1),
+                           mode="constant",
+                           pad_width=(0, 0, 0, 0, lag, 0),
+                           constant_value=0.0).squeeze(axis=1)
+            lags.append(padded.slice_axis(1, 0, target.shape[1]))
+        return F.stack(*lags, axis=-1)
+
+    def hybrid_forward(self, F, target, covariates=None):
+        """target: (batch, T); covariates: (batch, T, C) or None.
+        Returns mean NLL of one-step-ahead predictions."""
+        feats = self._lag_features(F, target)
+        if covariates is not None:
+            feats = F.concat(feats, covariates, dim=2)
+        out = self.lstm(feats)
+        params = self.distr_output(out)
+        if self._distr == "student_t":
+            mu, sigma, nu = params
+            nll = StudentTOutput.nll(F, target, mu, sigma, nu)
+        else:
+            mu, sigma = params
+            nll = GaussianOutput.nll(F, target, mu, sigma)
+        return F.mean(nll)
+
+    def predict(self, context, prediction_length=24, num_samples=100,
+                covariates=None, seed=0):
+        """Ancestral sampling (host loop over the compiled step)."""
+        from ..ndarray import ndarray as _nd
+
+        rng = np.random.RandomState(seed)
+        b, t0 = context.shape[:2]
+        paths = np.repeat(context.asnumpy()[:, :], num_samples, axis=0)
+        for step in range(prediction_length):
+            feats_nd = _nd.array(paths.astype(np.float32))
+            out = self.lstm(self._lag_features_nd(feats_nd))
+            params = self.distr_output(out)
+            if self._distr == "student_t":
+                mu, sigma, nu = [p.asnumpy()[:, -1] for p in params]
+                z = rng.standard_t(nu) * sigma + mu
+            else:
+                mu, sigma = [p.asnumpy()[:, -1] for p in params]
+                z = rng.normal(mu, sigma)
+            paths = np.concatenate([paths, z[:, None]], axis=1)
+        samples = paths[:, t0:].reshape(b, num_samples, prediction_length)
+        return samples
+
+    def _lag_features_nd(self, target):
+        from .. import ndarray as F
+
+        return self._lag_features(F, target)
+
+
+def deepar(num_cells=40, num_layers=2, **kwargs):
+    """The BASELINE DeepAR config (GluonTS defaults: 2x40 LSTM)."""
+    return DeepARNetwork(num_cells, num_layers, **kwargs)

@@ -1,0 +1,170 @@
+"""Transformer encoder-decoder (ref workload: BASELINE config
+'Transformer-big WMT14 En-De (Sockeye, hybridized encoder/decoder →
+XLA)'; structure after the Sockeye/transformer-big recipe built from
+the reference's sequence ops — ref: src/operator/contrib/transformer.cc
+era building blocks, here fused via scaled_dot_product_attention).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+
+
+def positional_encoding(length, dim):
+    pos = np.arange(length)[:, None]
+    i = np.arange(dim // 2)[None, :]
+    angle = pos / np.power(10000.0, 2 * i / dim)
+    enc = np.zeros((length, dim), np.float32)
+    enc[:, 0::2] = np.sin(angle)
+    enc[:, 1::2] = np.cos(angle)
+    return enc
+
+
+class TransformerLayer(HybridBlock):
+    def __init__(self, units, hidden_size, num_heads, dropout=0.1,
+                 is_decoder=False, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._num_heads = num_heads
+        self._is_decoder = is_decoder
+        self.self_in_weight = self.params.get(
+            "self_in_weight", shape=(3 * units, units))
+        self.self_in_bias = self.params.get(
+            "self_in_bias", shape=(3 * units,), init="zeros")
+        self.self_out_weight = self.params.get(
+            "self_out_weight", shape=(units, units))
+        self.self_out_bias = self.params.get(
+            "self_out_bias", shape=(units,), init="zeros")
+        self.ln1 = nn.LayerNorm(in_channels=units)
+        if is_decoder:
+            self.cross_in_weight = self.params.get(
+                "cross_in_weight", shape=(3 * units, units))
+            self.cross_in_bias = self.params.get(
+                "cross_in_bias", shape=(3 * units,), init="zeros")
+            self.cross_out_weight = self.params.get(
+                "cross_out_weight", shape=(units, units))
+            self.cross_out_bias = self.params.get(
+                "cross_out_bias", shape=(units,), init="zeros")
+            self.ln_cross = nn.LayerNorm(in_channels=units)
+        self.ffn1 = nn.Dense(hidden_size, flatten=False, activation="relu")
+        self.ffn2 = nn.Dense(units, flatten=False)
+        self.ln2 = nn.LayerNorm(in_channels=units)
+        self.dropout = nn.Dropout(dropout)
+
+    def hybrid_forward(self, F, x, memory=None, self_mask=None,
+                       mem_mask=None, **params):
+        att = F.multihead_attention(
+            x, x, x, params["self_in_weight"], params["self_in_bias"],
+            params["self_out_weight"], params["self_out_bias"], self_mask,
+            num_heads=self._num_heads, causal=self._is_decoder)
+        x = self.ln1(x + self.dropout(att))
+        if self._is_decoder and memory is not None:
+            catt = F.multihead_attention(
+                x, memory, memory, params["cross_in_weight"],
+                params["cross_in_bias"], params["cross_out_weight"],
+                params["cross_out_bias"], mem_mask,
+                num_heads=self._num_heads)
+            x = self.ln_cross(x + self.dropout(catt))
+        h = self.ffn2(self.ffn1(x))
+        return self.ln2(x + self.dropout(h))
+
+
+class TransformerModel(HybridBlock):
+    """Encoder-decoder for seq2seq (WMT-style)."""
+
+    def __init__(self, src_vocab, tgt_vocab, units=512, hidden_size=2048,
+                 num_layers=6, num_heads=8, max_length=512, dropout=0.1,
+                 tie_embeddings=False, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self.src_embed = nn.Embedding(src_vocab, units)
+        self.tgt_embed = nn.Embedding(tgt_vocab, units)
+        self.pos_const = self.params.get_constant(
+            "pos_enc", positional_encoding(max_length, units))
+        self.enc_layers = nn.HybridSequential()
+        for _ in range(num_layers):
+            self.enc_layers.add(TransformerLayer(units, hidden_size,
+                                                 num_heads, dropout))
+        self.dec_layers = nn.HybridSequential()
+        for _ in range(num_layers):
+            self.dec_layers.add(TransformerLayer(units, hidden_size,
+                                                 num_heads, dropout,
+                                                 is_decoder=True))
+        self.out_proj = nn.Dense(tgt_vocab, flatten=False)
+        self.dropout = nn.Dropout(dropout)
+
+    def _mask_from_len(self, F, valid_length, q_len, k_len):
+        steps = F.arange(0, k_len, dtype="float32")
+        m = F.broadcast_lesser(steps.reshape(1, -1),
+                               valid_length.reshape(-1, 1))
+        return (m.reshape(m.shape[0], 1, 1, k_len) - 1.0) * 1e9
+
+    def encode(self, F, src, src_valid_len=None):
+        s = src.shape[1]
+        pos = self.pos_const.data() if not hasattr(src, "_node") else None
+        x = self.src_embed(src) * math.sqrt(self._units)
+        x = x + pos[:s] if pos is not None else x
+        x = self.dropout(x)
+        mask = None
+        if src_valid_len is not None:
+            mask = self._mask_from_len(F, src_valid_len, s, s)
+        for layer in self.enc_layers:
+            x = layer(x, None, mask, None)
+        return x, mask
+
+    def decode(self, F, tgt, memory, mem_mask=None):
+        t = tgt.shape[1]
+        pos = self.pos_const.data()
+        x = self.tgt_embed(tgt) * math.sqrt(self._units)
+        x = x + pos[:t]
+        x = self.dropout(x)
+        for layer in self.dec_layers:
+            x = layer(x, memory, None, mem_mask)
+        return self.out_proj(x)
+
+    def hybrid_forward(self, F, src, tgt, src_valid_len=None, **params):
+        # params carries registered constants (pos_const); accessed via
+        # self.pos_const.data() inside encode/decode
+        memory, mem_mask = self.encode(F, src, src_valid_len)
+        return self.decode(F, tgt, memory, mem_mask)
+
+    def greedy_decode(self, src, max_len=32, bos=1, eos=2,
+                      src_valid_len=None):
+        """Greedy inference loop (host-side; each step hits the compiled
+        decode graph bucketed by length)."""
+        from ..ndarray import ndarray as _nd
+
+        b = src.shape[0]
+        out = np.full((b, 1), bos, np.int32)
+        for _ in range(max_len - 1):
+            logits = self(src, _nd.array(out, dtype="int32"),
+                          src_valid_len)
+            nxt = logits.asnumpy()[:, -1].argmax(-1).astype(np.int32)
+            out = np.concatenate([out, nxt[:, None]], axis=1)
+            if (nxt == eos).all():
+                break
+        return out
+
+
+def transformer_big(src_vocab, tgt_vocab, **kwargs):
+    """Transformer-big (the WMT14 BASELINE config): 1024 units, 16 heads,
+    4096 ffn, 6+6 layers."""
+    return TransformerModel(src_vocab, tgt_vocab, units=1024,
+                            hidden_size=4096, num_layers=6, num_heads=16,
+                            dropout=0.3, **kwargs)
+
+
+def transformer_base(src_vocab, tgt_vocab, **kwargs):
+    return TransformerModel(src_vocab, tgt_vocab, units=512,
+                            hidden_size=2048, num_layers=6, num_heads=8,
+                            **kwargs)
+
+
+def transformer_tiny(src_vocab=100, tgt_vocab=100, **kwargs):
+    return TransformerModel(src_vocab, tgt_vocab, units=32,
+                            hidden_size=64, num_layers=2, num_heads=4,
+                            max_length=64, **kwargs)

@@ -1,0 +1,140 @@
+"""Attention ops.
+
+Ref: src/operator/contrib/transformer.{cc,cu} (_contrib interleaved
+matmul selfatt ops) — the Sockeye-era building blocks — upgraded to a
+fused scaled-dot-product attention op (capability upgrade per SURVEY
+§2.2 'Fused attention as Pallas flash-attention kernel, still
+API-compatible').
+
+Two paths: a Pallas flash-attention kernel on TPU (ops/pallas/
+flash_attention.py) and this XLA fallback; the fallback is the oracle.
+Selection is automatic by platform; MXTPU_DISABLE_PALLAS=1 forces the
+fallback.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..base import getenv
+from .registry import register
+
+
+def _use_pallas():
+    if getenv("DISABLE_PALLAS", False, bool):
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:
+        return False
+
+
+def sdpa_reference(q, k, v, mask=None, *, scale=None, causal=False):
+    """Scaled dot-product attention, XLA fallback / numeric oracle.
+
+    q,k,v: (batch, heads, seq, head_dim). mask: additive (b,1,sq,sk) or
+    bool; causal adds a lower-triangular mask.
+    """
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / jnp.sqrt(
+        jnp.asarray(d, q.dtype))
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * s
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        causal_mask = jnp.tril(jnp.ones((sq, sk), bool), sk - sq)
+        logits = jnp.where(causal_mask, logits, jnp.asarray(-1e9, q.dtype))
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, jnp.asarray(-1e9, q.dtype))
+        else:
+            logits = logits + mask
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def _k_sdpa(q, k, v, mask=None, *, scale=None, causal=False,
+            dropout_p=0.0):
+    if _use_pallas():
+        try:
+            from .pallas.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, mask=mask, scale=scale,
+                                   causal=causal)
+        except Exception:  # pragma: no cover - pallas fallback safety
+            pass
+    return sdpa_reference(q, k, v, mask, scale=scale, causal=causal)
+
+
+register("scaled_dot_product_attention", _k_sdpa,
+         arg_names=("q", "k", "v", "mask"),
+         aliases=("_contrib_sdpa",))
+
+
+def _k_multihead_attention(query, key, value, in_weight, in_bias,
+                           out_weight, out_bias, mask=None, *,
+                           num_heads, causal=False):
+    """Full fused MHA: qkv projection + sdpa + output projection.
+
+    query/key/value: (batch, seq, model_dim); in_weight: (3*model, model)
+    packed q,k,v projections; out_weight: (model, model).
+    """
+    b, sq, m = query.shape
+    h = num_heads
+    hd = m // h
+    wq, wk, wv = jnp.split(in_weight, 3, axis=0)
+    bq, bk, bv = jnp.split(in_bias, 3, axis=0)
+
+    def proj(x, w, bias):
+        return (x @ w.T + bias).reshape(x.shape[0], x.shape[1], h, hd) \
+            .transpose(0, 2, 1, 3)
+
+    qh = proj(query, wq, bq)
+    kh = proj(key, wk, bk)
+    vh = proj(value, wv, bv)
+    out = _k_sdpa(qh, kh, vh, mask, scale=None, causal=causal)
+    out = out.transpose(0, 2, 1, 3).reshape(b, sq, m)
+    return out @ out_weight.T + out_bias
+
+
+register("multihead_attention", _k_multihead_attention,
+         arg_names=("query", "key", "value", "in_weight", "in_bias",
+                    "out_weight", "out_bias", "mask"))
+
+
+# Sockeye-era interleaved ops for parity with the reference's contrib
+# (ref: src/operator/contrib/transformer.cc)
+
+def _k_interleaved_matmul_selfatt_qk(qkv, *, heads):
+    # qkv: (seq, batch, 3*model) interleaved per head
+    s, b, m3 = qkv.shape
+    m = m3 // 3
+    hd = m // heads
+    x = qkv.reshape(s, b, heads, 3, hd)
+    q = x[:, :, :, 0]
+    k = x[:, :, :, 1]
+    q = q.transpose(1, 2, 0, 3) / jnp.sqrt(jnp.asarray(hd, qkv.dtype))
+    k = k.transpose(1, 2, 0, 3)
+    att = jnp.einsum("bhqd,bhkd->bhqk", q, k)
+    return att.reshape(b * heads, s, s)
+
+
+register("_contrib_interleaved_matmul_selfatt_qk",
+         _k_interleaved_matmul_selfatt_qk, arg_names=("queries_keys_values",))
+
+
+def _k_interleaved_matmul_selfatt_valatt(qkv, att, *, heads):
+    s, b, m3 = qkv.shape
+    m = m3 // 3
+    hd = m // heads
+    v = qkv.reshape(s, b, heads, 3, hd)[:, :, :, 2]
+    v = v.transpose(1, 2, 0, 3)
+    att = att.reshape(b, heads, s, s)
+    out = jnp.einsum("bhqk,bhkd->bhqd", att, v)
+    return out.transpose(2, 0, 1, 3).reshape(s, b, m)
+
+
+register("_contrib_interleaved_matmul_selfatt_valatt",
+         _k_interleaved_matmul_selfatt_valatt,
+         arg_names=("queries_keys_values", "attention"))
