@@ -1,0 +1,2 @@
+"""Pallas TPU kernels (the custom-call tier; ref: the reference's
+hand-CUDA/cuDNN kernels, re-expressed compiler-first)."""
