@@ -1,0 +1,21 @@
+# Native components (ref: the reference's C++ core; here the IO/runtime
+# tier — the compute tier is XLA/Pallas).
+CXX ?= g++
+CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -pthread
+LDFLAGS ?= -shared -ljpeg
+
+LIB := lib/libmxtpu_io.so
+
+all: $(LIB)
+
+$(LIB): src/recordio.cc
+	@mkdir -p lib
+	$(CXX) $(CXXFLAGS) $< -o $@ $(LDFLAGS)
+
+clean:
+	rm -rf lib
+
+test: all
+	python -m pytest tests/ -x -q
+
+.PHONY: all clean test

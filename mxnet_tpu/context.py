@@ -64,22 +64,27 @@ class Context:
     # -- JAX mapping --------------------------------------------------------
 
     def jax_device(self):
-        """Resolve to a concrete jax.Device."""
+        """Resolve to a concrete ADDRESSABLE jax.Device.  Multi-process
+        (jax.distributed) safety: only local devices are usable, so
+        resolution is over local_devices (ref: each worker binds its own
+        GPUs in the reference's dist mode)."""
         import jax
 
         if self.device_type in ("cpu", "cpu_pinned"):
             try:
-                return jax.devices("cpu")[self.device_id]
+                local = [d for d in jax.local_devices(backend="cpu")]
             except RuntimeError:
-                # no cpu backend registered: fall back to default backend
-                return jax.devices()[0]
-        # xla / gpu(compat alias): i-th device of the default (accelerator)
-        # backend; on a CPU-only host this is the i-th virtual CPU device.
-        devs = jax.devices()
+                local = jax.local_devices()
+            if self.device_id < len(local):
+                return local[self.device_id]
+            return local[0]
+        # xla / gpu(compat alias): i-th local device of the default
+        # (accelerator) backend; on a CPU-only host the i-th virtual CPU.
+        devs = jax.local_devices()
         if self.device_id >= len(devs):
             raise MXNetError(
                 f"device id {self.device_id} out of range; "
-                f"{len(devs)} device(s) visible")
+                f"{len(devs)} local device(s) visible")
         return devs[self.device_id]
 
     def __enter__(self):

@@ -272,7 +272,7 @@ class ImageRecordIter(DataIter):
                  rand_mirror=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
                  std_r=1.0, std_g=1.0, std_b=1.0, resize=-1,
                  label_width=1, preprocess_threads=4, prefetch_buffer=2,
-                 round_batch=True, seed=0, **kwargs):
+                 round_batch=True, seed=0, use_native=None, **kwargs):
         super().__init__(batch_size)
         from . import recordio as rio
 
@@ -295,7 +295,34 @@ class ImageRecordIter(DataIter):
         self._pos = 0
         self._prefetch = []
         self._prefetch_depth = max(1, prefetch_buffer)
+        # native C++ decode pipeline (src/recordio.cc) when available and
+        # the file is indexed JPEG (the ImageNet-path fast lane)
+        self._native = None
+        if use_native is not False and self._keys is not None:
+            from ..utils import native as native_mod
+
+            if native_mod.load() is not None and self._records_are_jpeg():
+                offsets = [self._rec.idx[k] for k in self._keys]
+                self._native = native_mod.NativeImagePipeline(
+                    path_imgrec, offsets, self.data_shape, batch_size,
+                    num_threads=preprocess_threads, shuffle=shuffle,
+                    rand_crop=rand_crop, rand_mirror=rand_mirror,
+                    resize_short=resize, mean=self.mean, std=self.std,
+                    seed=seed)
+            elif use_native is True:
+                raise MXNetError("native pipeline requested but "
+                                 "unavailable (need indexed JPEG .rec)")
         self.reset()
+
+    def _records_are_jpeg(self):
+        from . import recordio as rio
+
+        try:
+            rec = self._rec.read_idx(self._keys[0])
+            _, payload = rio.unpack(rec)
+            return payload[:2] == b"\xff\xd8"
+        except Exception:
+            return False
 
     @property
     def provide_data(self):
@@ -306,6 +333,9 @@ class ImageRecordIter(DataIter):
         return [DataDesc("softmax_label", (self.batch_size,))]
 
     def reset(self):
+        if self._native is not None:
+            self._native.reset()
+            return
         self._pos = 0
         if self._keys is not None:
             self._order = list(self._keys)
@@ -391,6 +421,14 @@ class ImageRecordIter(DataIter):
         return img
 
     def next(self):
+        if self._native is not None:
+            item = self._native.next()
+            if item is None:
+                raise StopIteration
+            data, labels = item
+            return DataBatch([_nd.array(data)], [_nd.array(labels)],
+                             provide_data=self.provide_data,
+                             provide_label=self.provide_label)
         if not self._prefetch:
             raise StopIteration
         fut = self._prefetch.pop(0)
@@ -403,6 +441,8 @@ class ImageRecordIter(DataIter):
                          provide_label=self.provide_label)
 
     def iter_next(self):
+        if self._native is not None:
+            return True  # native queue signals end via next()
         return bool(self._prefetch) and self._prefetch[0] is not None
 
 

@@ -69,13 +69,14 @@ def allreduce(value):
 
     if jax.process_count() <= 1:
         return value
+    import jax.numpy as jnp
     from jax.experimental import multihost_utils
 
     from ..engine import track
     from ..ndarray.ndarray import _wrap
 
-    summed = multihost_utils.process_allgather(value._data)
-    return _wrap(track(summed.sum(axis=0)))
+    gathered = multihost_utils.process_allgather(value._data)
+    return _wrap(track(jnp.asarray(gathered).sum(axis=0)))
 
 
 def barrier(name="kvstore"):

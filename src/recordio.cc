@@ -1,0 +1,454 @@
+// Native IO library: RecordIO + multithreaded image decode pipeline.
+//
+// Ref: 3rdparty/dmlc-core recordio (format: [magic u32][lrec u32][data]
+// [pad4], magic 0xced7230a) and src/io/iter_image_recordio_2.cc (N decode
+// threads -> batch queue -> prefetch).  This is the TPU build's native
+// data-loader: workers pread records, parse IRHeader, decode JPEG via
+// libjpeg, resize/crop/mirror/normalize into pinned batch buffers that
+// Python hands to PjRt host-to-device transfer.
+//
+// Exposed as a flat C ABI (ref: the c_api boundary) consumed via ctypes.
+
+#include <cstddef>
+#include <cstdio>
+
+#include <jpeglib.h>
+
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLenMask = (1u << 29) - 1;
+
+// ---------------------------------------------------------------------------
+// RecordIO
+
+struct RecordWriter {
+  FILE* f = nullptr;
+};
+
+struct RecordReader {
+  FILE* f = nullptr;
+  std::vector<char> buf;
+};
+
+// IRHeader (ref: mx.recordio.IRHeader): flag u32, label f32, id u64, id2 u64
+struct IRHeader {
+  uint32_t flag;
+  float label;
+  uint64_t id;
+  uint64_t id2;
+};
+
+// ---------------------------------------------------------------------------
+// JPEG decode via libjpeg
+
+bool DecodeJpeg(const uint8_t* data, size_t len, std::vector<uint8_t>* out,
+                int* w, int* h, int* channels, bool gray) {
+  jpeg_decompress_struct cinfo;
+  jpeg_error_mgr jerr;
+  cinfo.err = jpeg_std_error(&jerr);
+  // default error handler calls exit(); override fatal path with longjmp-free
+  // quiet failure by checking header first
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, data, len);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  cinfo.out_color_space = gray ? JCS_GRAYSCALE : JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  *w = cinfo.output_width;
+  *h = cinfo.output_height;
+  *channels = cinfo.output_components;
+  out->resize(static_cast<size_t>(*w) * (*h) * (*channels));
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = out->data() +
+        static_cast<size_t>(cinfo.output_scanline) * (*w) * (*channels);
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// bilinear resize HWC uint8
+void ResizeBilinear(const uint8_t* src, int sw, int sh, int c,
+                    uint8_t* dst, int dw, int dh) {
+  const float sx = static_cast<float>(sw) / dw;
+  const float sy = static_cast<float>(sh) / dh;
+  for (int y = 0; y < dh; ++y) {
+    float fy = (y + 0.5f) * sy - 0.5f;
+    int y0 = std::max(0, static_cast<int>(fy));
+    int y1 = std::min(sh - 1, y0 + 1);
+    float wy = fy - y0;
+    for (int x = 0; x < dw; ++x) {
+      float fx = (x + 0.5f) * sx - 0.5f;
+      int x0 = std::max(0, static_cast<int>(fx));
+      int x1 = std::min(sw - 1, x0 + 1);
+      float wx = fx - x0;
+      for (int ch = 0; ch < c; ++ch) {
+        float v00 = src[(y0 * sw + x0) * c + ch];
+        float v01 = src[(y0 * sw + x1) * c + ch];
+        float v10 = src[(y1 * sw + x0) * c + ch];
+        float v11 = src[(y1 * sw + x1) * c + ch];
+        float v = v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                  v10 * wy * (1 - wx) + v11 * wy * wx;
+        dst[(y * dw + x) * c + ch] = static_cast<uint8_t>(v + 0.5f);
+      }
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Image pipeline: threaded decode + augment + batch assembly
+
+struct PipelineConfig {
+  int c, h, w;
+  int batch_size;
+  int num_threads;
+  int shuffle, rand_crop, rand_mirror;
+  int resize_short;  // <=0: disabled
+  float mean[3], std_[3];
+  uint64_t seed;
+};
+
+struct Batch {
+  std::vector<float> data;
+  std::vector<float> labels;
+  int count = 0;
+};
+
+struct ImagePipeline {
+  FILE* f = nullptr;
+  std::vector<uint64_t> offsets;
+  std::vector<float> labels_at;  // parsed lazily; offsets drive reads
+  PipelineConfig cfg;
+  std::vector<size_t> order;
+  std::atomic<size_t> cursor{0};
+  size_t num_batches = 0;
+
+  std::vector<std::thread> workers;
+  std::queue<Batch*> ready;
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_space;
+  size_t max_queue = 4;
+  std::atomic<bool> stop{false};
+  std::atomic<int> active_workers{0};
+  uint64_t epoch_seed;
+
+  ~ImagePipeline() { Shutdown(); }
+
+  void Shutdown() {
+    stop.store(true);
+    cv_space.notify_all();
+    cv_ready.notify_all();
+    for (auto& t : workers) {
+      if (t.joinable()) t.join();
+    }
+    workers.clear();
+    std::lock_guard<std::mutex> lk(mu);
+    while (!ready.empty()) {
+      delete ready.front();
+      ready.pop();
+    }
+    if (f) {
+      fclose(f);
+      f = nullptr;
+    }
+  }
+
+  bool ReadRecordAt(uint64_t off, std::vector<char>* buf) {
+    // thread-safe independent reads via pread on the raw fd
+    uint32_t hdr[2];
+    int fd = fileno(f);
+    if (pread(fd, hdr, 8, off) != 8) return false;
+    if (hdr[0] != kMagic) return false;
+    uint32_t len = hdr[1] & kLenMask;
+    buf->resize(len);
+    return pread(fd, buf->data(), len, off + 8) ==
+           static_cast<ssize_t>(len);
+  }
+
+  void DecodeOne(const std::vector<char>& rec, float* out, float* label,
+                 std::mt19937* rng) {
+    const char* p = rec.data();
+    IRHeader h;
+    std::memcpy(&h, p, sizeof(h));
+    size_t skip = sizeof(h) + (h.flag > 1 ? 4u * h.flag : 0u);
+    *label = h.label;
+    const uint8_t* img = reinterpret_cast<const uint8_t*>(p + skip);
+    size_t img_len = rec.size() - skip;
+
+    std::vector<uint8_t> pixels;
+    int w = 0, hh = 0, ch = 0;
+    if (!DecodeJpeg(img, img_len, &pixels, &w, &hh, &ch, cfg.c == 1)) {
+      std::fill(out, out + static_cast<size_t>(cfg.c) * cfg.h * cfg.w, 0.f);
+      return;
+    }
+    // resize shorter side
+    std::vector<uint8_t> resized;
+    if (cfg.resize_short > 0) {
+      int shorter = std::min(w, hh);
+      float scale = static_cast<float>(cfg.resize_short) / shorter;
+      int nw = std::max(cfg.w, static_cast<int>(w * scale + 0.5f));
+      int nh = std::max(cfg.h, static_cast<int>(hh * scale + 0.5f));
+      resized.resize(static_cast<size_t>(nw) * nh * ch);
+      ResizeBilinear(pixels.data(), w, hh, ch, resized.data(), nw, nh);
+      pixels.swap(resized);
+      w = nw;
+      hh = nh;
+    }
+    if (w < cfg.w || hh < cfg.h) {
+      int nw = std::max(w, cfg.w), nh = std::max(hh, cfg.h);
+      resized.resize(static_cast<size_t>(nw) * nh * ch);
+      ResizeBilinear(pixels.data(), w, hh, ch, resized.data(), nw, nh);
+      pixels.swap(resized);
+      w = nw;
+      hh = nh;
+    }
+    // crop
+    int x0, y0;
+    if (cfg.rand_crop) {
+      x0 = static_cast<int>((*rng)() % (w - cfg.w + 1));
+      y0 = static_cast<int>((*rng)() % (hh - cfg.h + 1));
+    } else {
+      x0 = (w - cfg.w) / 2;
+      y0 = (hh - cfg.h) / 2;
+    }
+    bool mirror = cfg.rand_mirror && ((*rng)() & 1);
+    // HWC crop -> CHW normalized
+    for (int cc = 0; cc < cfg.c; ++cc) {
+      float m = cfg.mean[cc < 3 ? cc : 0];
+      float s = cfg.std_[cc < 3 ? cc : 0];
+      float* dst = out + static_cast<size_t>(cc) * cfg.h * cfg.w;
+      for (int y = 0; y < cfg.h; ++y) {
+        for (int x = 0; x < cfg.w; ++x) {
+          int sx = mirror ? (cfg.w - 1 - x) : x;
+          uint8_t v =
+              pixels[((y0 + y) * w + (x0 + sx)) * ch + (ch == 1 ? 0 : cc)];
+          dst[y * cfg.w + x] = (static_cast<float>(v) - m) / s;
+        }
+      }
+    }
+  }
+
+  void WorkerLoop(int tid) {
+    std::mt19937 rng(epoch_seed + 0x9e3779b9u * tid);
+    const size_t bs = cfg.batch_size;
+    while (!stop.load()) {
+      size_t b = cursor.fetch_add(1);
+      if (b >= num_batches) break;
+      auto* batch = new Batch;
+      batch->data.resize(bs * cfg.c * cfg.h * cfg.w);
+      batch->labels.resize(bs);
+      batch->count = static_cast<int>(bs);
+      std::vector<char> rec;
+      for (size_t i = 0; i < bs; ++i) {
+        size_t idx = order[b * bs + i];
+        if (!ReadRecordAt(offsets[idx], &rec)) {
+          batch->labels[i] = -1.f;
+          continue;
+        }
+        DecodeOne(rec, batch->data.data() +
+                       i * static_cast<size_t>(cfg.c) * cfg.h * cfg.w,
+                  &batch->labels[i], &rng);
+      }
+      std::unique_lock<std::mutex> lk(mu);
+      cv_space.wait(lk, [&] { return ready.size() < max_queue || stop; });
+      if (stop) {
+        delete batch;
+        break;
+      }
+      ready.push(batch);
+      cv_ready.notify_one();
+    }
+    if (active_workers.fetch_sub(1) == 1) cv_ready.notify_all();
+  }
+
+  void Start() {
+    stop.store(false);
+    cursor.store(0);
+    active_workers.store(cfg.num_threads);
+    for (int t = 0; t < cfg.num_threads; ++t) {
+      workers.emplace_back(&ImagePipeline::WorkerLoop, this, t);
+    }
+  }
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+
+extern "C" {
+
+// ---- RecordIO writer ----
+void* MXTPURecordIOWriterCreate(const char* path) {
+  auto* w = new RecordWriter;
+  w->f = fopen(path, "wb");
+  if (!w->f) {
+    delete w;
+    return nullptr;
+  }
+  return w;
+}
+
+int64_t MXTPURecordIOWrite(void* handle, const char* buf, uint64_t len) {
+  auto* w = static_cast<RecordWriter*>(handle);
+  int64_t pos = ftell(w->f);
+  uint32_t hdr[2] = {kMagic, static_cast<uint32_t>(len) & kLenMask};
+  if (fwrite(hdr, 1, 8, w->f) != 8) return -1;
+  if (fwrite(buf, 1, len, w->f) != len) return -1;
+  static const char pad[4] = {0, 0, 0, 0};
+  size_t p = (4 - len % 4) % 4;
+  if (p && fwrite(pad, 1, p, w->f) != p) return -1;
+  return pos;
+}
+
+void MXTPURecordIOWriterFree(void* handle) {
+  auto* w = static_cast<RecordWriter*>(handle);
+  if (w->f) fclose(w->f);
+  delete w;
+}
+
+// ---- RecordIO reader ----
+void* MXTPURecordIOReaderCreate(const char* path) {
+  auto* r = new RecordReader;
+  r->f = fopen(path, "rb");
+  if (!r->f) {
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+// returns length, 0 on EOF, -1 on error; data pointer valid until next call
+int64_t MXTPURecordIORead(void* handle, const char** out) {
+  auto* r = static_cast<RecordReader*>(handle);
+  uint32_t hdr[2];
+  if (fread(hdr, 1, 8, r->f) != 8) return 0;
+  if (hdr[0] != kMagic) return -1;
+  uint32_t len = hdr[1] & kLenMask;
+  r->buf.resize(len);
+  if (fread(r->buf.data(), 1, len, r->f) != len) return -1;
+  size_t p = (4 - len % 4) % 4;
+  if (p) fseek(r->f, static_cast<long>(p), SEEK_CUR);
+  *out = r->buf.data();
+  return static_cast<int64_t>(len);
+}
+
+void MXTPURecordIOSeek(void* handle, uint64_t pos) {
+  fseek(static_cast<RecordReader*>(handle)->f, static_cast<long>(pos),
+        SEEK_SET);
+}
+
+int64_t MXTPURecordIOTell(void* handle) {
+  return ftell(static_cast<RecordReader*>(handle)->f);
+}
+
+void MXTPURecordIOReaderFree(void* handle) {
+  auto* r = static_cast<RecordReader*>(handle);
+  if (r->f) fclose(r->f);
+  delete r;
+}
+
+// ---- Image pipeline ----
+void* MXTPUImagePipelineCreate(const char* rec_path,
+                               const uint64_t* offsets, uint64_t n,
+                               int c, int h, int w, int batch_size,
+                               int num_threads, int shuffle, int rand_crop,
+                               int rand_mirror, int resize_short,
+                               const float* mean, const float* std_,
+                               uint64_t seed) {
+  auto* p = new ImagePipeline;
+  p->f = fopen(rec_path, "rb");
+  if (!p->f) {
+    delete p;
+    return nullptr;
+  }
+  p->offsets.assign(offsets, offsets + n);
+  p->cfg = PipelineConfig{c, h, w, batch_size, num_threads, shuffle,
+                          rand_crop, rand_mirror, resize_short,
+                          {mean[0], mean[1], mean[2]},
+                          {std_[0], std_[1], std_[2]}, seed};
+  p->epoch_seed = seed;
+  return p;
+}
+
+// start (or restart) an epoch
+void MXTPUImagePipelineReset(void* handle, uint64_t epoch) {
+  auto* p = static_cast<ImagePipeline*>(handle);
+  p->stop.store(true);
+  p->cv_space.notify_all();
+  for (auto& t : p->workers) {
+    if (t.joinable()) t.join();
+  }
+  p->workers.clear();
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    while (!p->ready.empty()) {
+      delete p->ready.front();
+      p->ready.pop();
+    }
+  }
+  p->order.resize(p->offsets.size());
+  for (size_t i = 0; i < p->order.size(); ++i) p->order[i] = i;
+  p->epoch_seed = p->cfg.seed + epoch * 1000003ull;
+  if (p->cfg.shuffle) {
+    std::mt19937_64 rng(p->epoch_seed);
+    std::shuffle(p->order.begin(), p->order.end(), rng);
+  }
+  p->num_batches = p->order.size() / p->cfg.batch_size;
+  p->Start();
+}
+
+// copy next batch into out buffers; returns count (0 = epoch done)
+int MXTPUImagePipelineNext(void* handle, float* out_data,
+                           float* out_labels) {
+  auto* p = static_cast<ImagePipeline*>(handle);
+  std::unique_lock<std::mutex> lk(p->mu);
+  p->cv_ready.wait(lk, [&] {
+    return !p->ready.empty() || p->active_workers.load() == 0 ||
+           p->stop.load();
+  });
+  if (p->ready.empty()) return 0;
+  Batch* b = p->ready.front();
+  p->ready.pop();
+  p->cv_space.notify_one();
+  lk.unlock();
+  std::memcpy(out_data, b->data.data(), b->data.size() * sizeof(float));
+  std::memcpy(out_labels, b->labels.data(),
+              b->labels.size() * sizeof(float));
+  int count = b->count;
+  delete b;
+  return count;
+}
+
+uint64_t MXTPUImagePipelineNumBatches(void* handle) {
+  auto* p = static_cast<ImagePipeline*>(handle);
+  return p->offsets.size() / p->cfg.batch_size;
+}
+
+void MXTPUImagePipelineFree(void* handle) {
+  delete static_cast<ImagePipeline*>(handle);
+}
+
+const char* MXTPUVersion() { return "mxtpu_io 0.1.0"; }
+
+}  // extern "C"

@@ -1,0 +1,42 @@
+"""Multi-process dist kvstore test (ref: tests/nightly/dist_sync_kvstore.py
+launched via `tools/launch.py -n 2 --launcher local` — the
+multi-node-without-a-cluster mechanism, SURVEY §4).
+
+Asserts the reference's核 invariant: gradients pushed from N workers
+pull back as the N-worker sum.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # each proc: 1 CPU device
+
+from mxnet_tpu.parallel import dist  # noqa: E402
+
+dist.init()
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import kvstore, nd  # noqa: E402
+
+kv = kvstore.create("dist_sync")
+rank, size = kv.rank, kv.num_workers
+assert size == int(os.environ.get("MXTPU_NUM_WORKER", 1)), \
+    (size, os.environ.get("MXTPU_NUM_WORKER"))
+
+kv.init("w", nd.zeros((4,)))
+kv.barrier()
+
+# each worker pushes rank+1; the pulled value must be sum(1..size)
+kv.push("w", [nd.ones((4,)) * (rank + 1)])
+out = nd.zeros((4,))
+kv.pull("w", out=out)
+expected = size * (size + 1) / 2
+assert np.allclose(out.asnumpy(), expected), (out.asnumpy(), expected)
+kv.barrier()
+print(f"worker {rank}/{size}: dist_sync kvstore OK (sum={expected})")

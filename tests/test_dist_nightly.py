@@ -1,0 +1,24 @@
+"""Multi-process distributed tests, launched the reference's way:
+tools/launch.py -n N --launcher local (ref: tests/nightly/)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_dist_sync_kvstore_two_workers():
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # script forces cpu itself
+    res = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", sys.executable,
+         os.path.join(_ROOT, "tests", "nightly", "dist_sync_kvstore.py")],
+        capture_output=True, text=True, timeout=240, env=env, cwd=_ROOT)
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out[-3000:]
+    assert "worker 0/2: dist_sync kvstore OK" in out
+    assert "worker 1/2: dist_sync kvstore OK" in out

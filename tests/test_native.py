@@ -1,0 +1,103 @@
+"""Native C++ IO library tests (src/recordio.cc via ctypes)."""
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.io import ImageRecordIter, recordio
+from mxnet_tpu.utils import native
+
+pytestmark = pytest.mark.skipif(native.load() is None,
+                                reason="native lib unavailable")
+
+
+def test_native_recordio_roundtrip(tmp_path):
+    lib = native.load()
+    path = str(tmp_path / "n.rec").encode()
+    w = lib.MXTPURecordIOWriterCreate(path)
+    poss = []
+    for i in range(5):
+        payload = f"native-record-{i}".encode()
+        poss.append(lib.MXTPURecordIOWrite(w, payload, len(payload)))
+    lib.MXTPURecordIOWriterFree(w)
+    assert poss[0] == 0 and all(p >= 0 for p in poss)
+
+    r = lib.MXTPURecordIOReaderCreate(path)
+    out = ctypes.c_char_p()
+    got = []
+    while True:
+        n = lib.MXTPURecordIORead(r, ctypes.byref(out))
+        if n <= 0:
+            break
+        got.append(ctypes.string_at(out, n).decode())
+    lib.MXTPURecordIOReaderFree(r)
+    assert got == [f"native-record-{i}" for i in range(5)]
+
+
+def test_native_reads_python_written_rec(tmp_path):
+    """Byte-format compatibility: python writer -> native reader."""
+    lib = native.load()
+    rec = str(tmp_path / "py.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    w.write(b"hello from python")
+    w.close()
+    r = lib.MXTPURecordIOReaderCreate(rec.encode())
+    out = ctypes.c_char_p()
+    n = lib.MXTPURecordIORead(r, ctypes.byref(out))
+    assert ctypes.string_at(out, n) == b"hello from python"
+    lib.MXTPURecordIOReaderFree(r)
+
+
+def _make_jpeg_rec(tmp_path, n=16, size=40):
+    rec = str(tmp_path / "imgs.rec")
+    idx = str(tmp_path / "imgs.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    rng = np.random.RandomState(0)
+    raw = []
+    for i in range(n):
+        img = (rng.rand(size, size, 3) * 255).astype(np.uint8)
+        raw.append(img)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 4), i, 0), img, quality=95,
+            img_fmt=".jpg"))
+    w.close()
+    return rec, raw
+
+
+def test_native_image_pipeline_matches_python(tmp_path):
+    rec, raw = _make_jpeg_rec(tmp_path)
+    kw = dict(path_imgrec=rec, data_shape=(3, 32, 32), batch_size=4,
+              shuffle=False, rand_crop=False, rand_mirror=False)
+    it_native = ImageRecordIter(use_native=True, **kw)
+    it_py = ImageRecordIter(use_native=False, **kw)
+    assert it_native._native is not None
+    assert it_py._native is None
+
+    nb = pb = 0
+    for b_n, b_p in zip(it_native, it_py):
+        nb += 1
+        dn = b_n.data[0].asnumpy()
+        dp = b_p.data[0].asnumpy()
+        assert dn.shape == dp.shape == (4, 3, 32, 32)
+        # center-crop from the same JPEG: decoders may differ by a few
+        # LSBs; mean abs diff must be tiny
+        assert np.abs(dn - dp).mean() < 2.0, np.abs(dn - dp).mean()
+        assert np.allclose(b_n.label[0].asnumpy(),
+                           b_p.label[0].asnumpy())
+    assert nb == 4
+    # second epoch works
+    it_native.reset()
+    assert sum(1 for _ in it_native) == 4
+
+
+def test_native_pipeline_augment_shapes(tmp_path):
+    rec, _ = _make_jpeg_rec(tmp_path, n=8, size=48)
+    it = ImageRecordIter(path_imgrec=rec, data_shape=(3, 32, 32),
+                         batch_size=4, shuffle=True, rand_crop=True,
+                         rand_mirror=True, use_native=True)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (4, 3, 32, 32)
+    labels = np.concatenate([b.label[0].asnumpy() for b in batches])
+    assert ((labels >= 0) & (labels <= 3)).all()

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Pack an image folder or .lst file into RecordIO (ref: tools/im2rec.py).
+
+Usage:
+  python tools/im2rec.py PREFIX ROOT [--list] [--recursive]
+  python tools/im2rec.py PREFIX ROOT --num-thread 8 --quality 95
+
+Two phases like the reference: `--list` generates PREFIX.lst
+(idx\\tlabel\\trelpath); without it, packs PREFIX.lst into PREFIX.rec +
+PREFIX.idx (JPEG-encoded, readable by ImageRecordIter incl. the native
+C++ pipeline).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu.io import recordio  # noqa: E402
+
+EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def make_list(prefix, root, recursive=False, train_ratio=1.0):
+    items = []
+    if recursive:
+        label = 0
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            for fn in sorted(os.listdir(path)):
+                if fn.lower().endswith(EXTS):
+                    items.append((os.path.join(folder, fn), label))
+            label += 1
+    else:
+        for fn in sorted(os.listdir(root)):
+            if fn.lower().endswith(EXTS):
+                items.append((fn, 0))
+    with open(prefix + ".lst", "w") as f:
+        for i, (rel, label) in enumerate(items):
+            f.write(f"{i}\t{label}\t{rel}\n")
+    print(f"wrote {len(items)} entries to {prefix}.lst")
+
+
+def pack(prefix, root, quality=95, resize=0, color=1):
+    import numpy as np
+    from PIL import Image
+
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    n = 0
+    with open(prefix + ".lst") as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx, label, rel = int(parts[0]), float(parts[1]), parts[2]
+            img = Image.open(os.path.join(root, rel))
+            img = img.convert("RGB" if color else "L")
+            if resize:
+                short = min(img.size)
+                scale = resize / short
+                img = img.resize((int(img.size[0] * scale),
+                                  int(img.size[1] * scale)))
+            rec.write_idx(idx, recordio.pack_img(
+                recordio.IRHeader(0, label, idx, 0), np.asarray(img),
+                quality=quality, img_fmt=".jpg"))
+            n += 1
+    rec.close()
+    print(f"packed {n} images into {prefix}.rec")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("prefix")
+    ap.add_argument("root")
+    ap.add_argument("--list", action="store_true")
+    ap.add_argument("--recursive", action="store_true")
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--resize", type=int, default=0)
+    ap.add_argument("--color", type=int, default=1)
+    args = ap.parse_args()
+    if args.list:
+        make_list(args.prefix, args.root, args.recursive)
+    else:
+        if not os.path.exists(args.prefix + ".lst"):
+            make_list(args.prefix, args.root, recursive=True)
+        pack(args.prefix, args.root, args.quality, args.resize, args.color)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Multi-process cluster launcher (ref: tools/launch.py + dmlc-tracker).
+
+Spawns one worker process per host/slot with coordinator env set so
+mxnet_tpu.parallel.dist (jax.distributed) rendezvous, replacing the
+ps-lite scheduler/server roles (SURVEY §3.4 TPU translation).
+
+  python tools/launch.py -n 4 --launcher local python train.py
+  python tools/launch.py -n 8 -H hosts.txt python train.py   # ssh
+
+Env protocol per process (both spellings exported for compat):
+  MXTPU_COORDINATOR / DMLC_PS_ROOT_URI (+PORT)
+  MXTPU_NUM_WORKER  / DMLC_NUM_WORKER
+  MXTPU_WORKER_ID   / DMLC_WORKER_ID
+"""
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+
+def launch_local(n, cmd, port):
+    procs = []
+    for i in range(n):
+        env = dict(os.environ)
+        env.update({
+            "MXTPU_COORDINATOR": f"127.0.0.1:{port}",
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(port),
+            "MXTPU_NUM_WORKER": str(n), "DMLC_NUM_WORKER": str(n),
+            "MXTPU_WORKER_ID": str(i), "DMLC_WORKER_ID": str(i),
+            "DMLC_ROLE": "worker",
+        })
+        procs.append(subprocess.Popen(cmd, env=env))
+    code = 0
+    try:
+        for p in procs:
+            code |= p.wait()
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        code = 1
+    return code
+
+
+def launch_ssh(hosts, n, cmd, port):
+    coordinator = hosts[0]
+    procs = []
+    per_host = max(1, n // len(hosts))
+    wid = 0
+    for host in hosts:
+        for _ in range(per_host):
+            if wid >= n:
+                break
+            envs = " ".join([
+                f"MXTPU_COORDINATOR={coordinator}:{port}",
+                f"DMLC_PS_ROOT_URI={coordinator}",
+                f"DMLC_PS_ROOT_PORT={port}",
+                f"MXTPU_NUM_WORKER={n}", f"DMLC_NUM_WORKER={n}",
+                f"MXTPU_WORKER_ID={wid}", f"DMLC_WORKER_ID={wid}",
+                "DMLC_ROLE=worker",
+            ])
+            remote = f"cd {os.getcwd()} && env {envs} {' '.join(cmd)}"
+            procs.append(subprocess.Popen(["ssh", "-o",
+                                           "StrictHostKeyChecking=no",
+                                           host, remote]))
+            wid += 1
+    code = 0
+    for p in procs:
+        code |= p.wait()
+    return code
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="accepted for reference-CLI parity; the TPU "
+                         "build has no parameter servers (all-reduce)")
+    ap.add_argument("--launcher", choices=["local", "ssh"], default="local")
+    ap.add_argument("-H", "--hostfile", default=None)
+    ap.add_argument("-p", "--port", type=int, default=9099)
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    cmd = args.command
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        ap.error("no command given")
+    if args.launcher == "local":
+        sys.exit(launch_local(args.num_workers, cmd, args.port))
+    hosts = [h.strip() for h in open(args.hostfile) if h.strip()]
+    sys.exit(launch_ssh(hosts, args.num_workers, cmd, args.port))
+
+
+if __name__ == "__main__":
+    main()
