@@ -35,6 +35,8 @@ _LAZY = {
     "parallel": ".parallel",
     "models": ".models",
     "amp": ".amp",
+    "monitor": ".monitor",
+    "mon": ".monitor",
 }
 
 

@@ -87,7 +87,21 @@ def invoke(fn, *args, jit_compile=True, nondiff=False, **kwargs):
     from .ndarray.ndarray import NDArray, _wrap
 
     raws = [_raw(a) for a in args]
-    if jit_compile:
+    from . import profiler
+
+    if profiler.is_running():
+        import time as _time
+
+        t0 = _time.perf_counter() * 1e6
+        if jit_compile:
+            out = get_jitted(fn, kwargs)(*raws)
+        else:
+            out = fn(*raws, **kwargs)
+        if profiler._config.get("sync"):
+            jax.block_until_ready(out)
+        profiler.record_op(getattr(fn, "__name__", "op").lstrip("_k_"),
+                           t0, _time.perf_counter() * 1e6)
+    elif jit_compile:
         out = get_jitted(fn, kwargs)(*raws)
     else:
         out = fn(*raws, **kwargs)

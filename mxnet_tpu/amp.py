@@ -1,0 +1,84 @@
+"""Automatic mixed precision (ref: python/mxnet/contrib/amp/ — fp16
+cast lists + dynamic loss scaling).
+
+TPU-native: the low-precision dtype is bfloat16, which shares float32's
+exponent range — so dynamic loss scaling is unnecessary (kept as an
+always-1 scaler for API parity).  ``init()`` flips matmul/conv-heavy
+ops to bf16 accumulation by casting block parameters; ``convert_model``
+casts a whole Gluon block.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+
+_initialized = False
+_target_dtype = "bfloat16"
+
+# ops that benefit from low precision (MXU-bound) — ref: amp FP16_FUNCS
+TARGET_DTYPE_OPS = ["FullyConnected", "Convolution", "Deconvolution",
+                    "batch_dot", "dot", "RNN",
+                    "scaled_dot_product_attention",
+                    "multihead_attention"]
+# ops that must stay fp32 (ref: FP32_FUNCS)
+FP32_OPS = ["softmax", "log_softmax", "BatchNorm", "LayerNorm", "norm",
+            "mean", "sum", "SoftmaxOutput", "exp", "log"]
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Ref: amp.init() — on TPU this records the policy; casting happens
+    per-model via convert_model/convert_hybrid_block."""
+    global _initialized, _target_dtype
+    if target_dtype not in ("bfloat16", "float16"):
+        raise MXNetError("target_dtype must be bfloat16 or float16")
+    _target_dtype = target_dtype
+    _initialized = True
+
+
+def convert_model(block, target_dtype=None):
+    """Cast a Gluon block's parameters to the AMP dtype, keeping
+    normalization params in fp32 (the reference's cast-list split)."""
+    dt = target_dtype or _target_dtype
+    for name, p in block.collect_params().items():
+        stem = name.rsplit("_", 1)[-1]
+        if stem in ("gamma", "beta", "running_mean", "running_var",
+                    "moving_mean", "moving_var"):
+            continue
+        p.cast(dt)
+    if hasattr(block, "_clear_cache"):
+        block._clear_cache()
+    return block
+
+
+convert_hybrid_block = convert_model
+
+
+class LossScaler:
+    """API-parity loss scaler; bf16 needs no scaling (scale always 1)."""
+
+    def __init__(self, init_scale=1.0, scale_factor=2.0,
+                 scale_window=2000):
+        self.loss_scale = 1.0
+
+    def scale(self, loss):
+        return loss
+
+    def unscale(self, grads):
+        return grads
+
+    def update(self, overflow=False):
+        return False
+
+
+def scale_loss(loss, trainer):
+    """Context manager parity shim (ref: amp.scale_loss)."""
+    class _Noop:
+        def __enter__(self):
+            return loss
+
+        def __exit__(self, *a):
+            return False
+
+    return _Noop()

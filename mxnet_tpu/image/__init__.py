@@ -1,0 +1,5 @@
+"""Image module (ref: python/mxnet/image/)."""
+from .image import (imdecode, imread, imresize, resize_short, fixed_crop,  # noqa: F401
+                    center_crop, random_crop, color_normalize, Augmenter,
+                    ResizeAug, CenterCropAug, RandomCropAug,
+                    HorizontalFlipAug, CastAug, CreateAugmenter, ImageIter)

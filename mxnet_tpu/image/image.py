@@ -1,0 +1,236 @@
+"""Image utilities + ImageIter (ref: python/mxnet/image/image.py).
+
+Host-side decode via PIL (or the native pipeline for .rec), device-side
+transforms via the image ops registered in ops/nn.py.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import ndarray as _nd
+from ..ndarray.ndarray import NDArray
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an encoded image buffer to HWC NDArray (ref: mx.image.imdecode)."""
+    import io
+
+    from PIL import Image
+
+    if isinstance(buf, NDArray):
+        buf = buf.asnumpy().tobytes()
+    img = Image.open(io.BytesIO(bytes(buf)))
+    img = img.convert("RGB") if flag else img.convert("L")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return _nd.array(arr, dtype=np.uint8)
+
+
+def imread(filename, flag=1, to_rgb=True):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    from PIL import Image
+
+    arr = src.asnumpy().astype(np.uint8)
+    squeeze = arr.shape[-1] == 1
+    pil = Image.fromarray(arr[..., 0] if squeeze else arr)
+    out = np.asarray(pil.resize((w, h)))
+    if squeeze:
+        out = out[..., None]
+    return _nd.array(out, dtype=np.uint8)
+
+
+def resize_short(src, size, interp=1):
+    h, w = src.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(h * size / w)
+    else:
+        new_w, new_h = int(w * size / h), size
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=1):
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def center_crop(src, size, interp=1):
+    h, w = src.shape[:2]
+    new_w, new_h = size
+    x0 = max((w - new_w) // 2, 0)
+    y0 = max((h - new_h) // 2, 0)
+    return fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h), size,
+                      interp), (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=1):
+    h, w = src.shape[:2]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = np.random.randint(0, w - new_w + 1)
+    y0 = np.random.randint(0, h - new_h + 1)
+    return fixed_crop(src, x0, y0, new_w, new_h, size, interp), \
+        (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    src = src.astype("float32") if src.dtype == np.uint8 else src
+    out = src - mean
+    if std is not None:
+        out = out / std
+    return out
+
+
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=1):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        return resize_short(src, self.size)
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=1):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        return center_crop(src, self.size)[0]
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=1):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        return random_crop(src, self.size)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if np.random.rand() < self.p:
+            return src.flip(axis=1)
+        return src
+
+
+class CastAug(Augmenter):
+    def __call__(self, src):
+        return src.astype("float32")
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, **kwargs):
+    """Ref: mx.image.CreateAugmenter."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size))
+    else:
+        auglist.append(CenterCropAug(crop_size))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if mean is not None or std is not None:
+        mean = np.asarray(mean if mean is not None else [0, 0, 0],
+                          np.float32)
+        std = np.asarray(std if std is not None else [1, 1, 1], np.float32)
+
+        class NormAug(Augmenter):
+            def __call__(self, src):
+                return color_normalize(src, _nd.array(mean),
+                                       _nd.array(std))
+
+        auglist.append(NormAug())
+    return auglist
+
+
+class ImageIter:
+    """Python image iterator over .lst/.rec (ref: mx.image.ImageIter)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root="", shuffle=False,
+                 aug_list=None, **kwargs):
+        from ..io.io import DataBatch, DataDesc
+
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self._db = DataBatch
+        if path_imgrec:
+            from ..io.io import ImageRecordIter
+
+            self._rec_iter = ImageRecordIter(
+                path_imgrec=path_imgrec, data_shape=data_shape,
+                batch_size=batch_size, shuffle=shuffle, **kwargs)
+            self._mode = "rec"
+        elif path_imglist:
+            self._items = []
+            with open(path_imglist) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    self._items.append((float(parts[1]),
+                                        os.path.join(path_root, parts[-1])))
+            self._aug = aug_list if aug_list is not None else \
+                CreateAugmenter((data_shape[0], data_shape[1],
+                                 data_shape[2]))
+            self._shuffle = shuffle
+            self._order = list(range(len(self._items)))
+            self._pos = 0
+            self._mode = "list"
+        else:
+            raise MXNetError("need path_imgrec or path_imglist")
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        if self._mode == "rec":
+            self._rec_iter.reset()
+        else:
+            self._pos = 0
+            if self._shuffle:
+                np.random.shuffle(self._order)
+
+    def __next__(self):
+        return self.next()
+
+    def next(self):
+        if self._mode == "rec":
+            return self._rec_iter.next()
+        if self._pos + self.batch_size > len(self._items):
+            raise StopIteration
+        c, h, w = self.data_shape
+        data = np.empty((self.batch_size, c, h, w), np.float32)
+        labels = np.empty((self.batch_size,), np.float32)
+        for i in range(self.batch_size):
+            label, path = self._items[self._order[self._pos]]
+            self._pos += 1
+            img = imread(path, flag=1 if c == 3 else 0)
+            for aug in self._aug:
+                img = aug(img)
+            labels[i] = label
+            data[i] = img.asnumpy().transpose(2, 0, 1)
+        return self._db([_nd.array(data)], [_nd.array(labels)])

@@ -23,12 +23,25 @@ from .registry import register
 # FullyConnected (ref: src/operator/nn/fully_connected.cc)
 
 
+def _low_precision(dt):
+    return dt in (jnp.bfloat16, jnp.float16)
+
+
+def _amp_in(data, weight):
+    # AMP cast insertion (ref: contrib/amp cast lists): low-precision
+    # weights pull the activation down to the compute dtype
+    if _low_precision(weight.dtype) and data.dtype != weight.dtype:
+        return data.astype(weight.dtype)
+    return data
+
+
 def _k_fully_connected(data, weight, bias=None, *, num_hidden,
                        no_bias=False, flatten=True):
+    data = _amp_in(data, weight)
     x = data.reshape(data.shape[0], -1) if flatten and data.ndim > 2 else data
     out = jnp.dot(x, weight.T)
     if not no_bias and bias is not None:
-        out = out + bias
+        out = out + bias.astype(out.dtype)
     return out
 
 register("FullyConnected", _k_fully_connected,
@@ -51,6 +64,7 @@ def _k_convolution(data, weight, bias=None, *, kernel, stride=(), dilate=(),
     stride = stride or (1,) * nd
     dilate = dilate or (1,) * nd
     pad = pad or (0,) * nd
+    data = _amp_in(data, weight)
     dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_DIMS[nd])
     out = lax.conv_general_dilated(
         data, weight, window_strides=stride,
@@ -58,7 +72,7 @@ def _k_convolution(data, weight, bias=None, *, kernel, stride=(), dilate=(),
         dimension_numbers=dn, feature_group_count=num_group,
         preferred_element_type=None)
     if not no_bias and bias is not None:
-        out = out + bias.reshape((1, -1) + (1,) * nd)
+        out = out + bias.astype(out.dtype).reshape((1, -1) + (1,) * nd)
     return out
 
 register("Convolution", _k_convolution,
@@ -187,19 +201,27 @@ def _k_batch_norm(data, gamma, beta, moving_mean, moving_var, *,
     shape = [1] * data.ndim
     shape[axis] = data.shape[axis]
 
+    # stats math in fp32 even for bf16 activations (AMP-correct split;
+    # the reference's cuDNN BN does the same)
+    x32 = data.astype(jnp.float32)
     if _train and not use_global_stats:
-        mean = jnp.mean(data, axis=red)
-        var = jnp.var(data, axis=red)
-        new_mm = moving_mean * momentum + mean * (1 - momentum)
-        new_mv = moving_var * momentum + var * (1 - momentum)
+        mean = jnp.mean(x32, axis=red)
+        var = jnp.var(x32, axis=red)
+        new_mm = moving_mean * momentum + mean.astype(moving_mean.dtype) \
+            * (1 - momentum)
+        new_mv = moving_var * momentum + var.astype(moving_var.dtype) \
+            * (1 - momentum)
     else:
-        mean, var = moving_mean, moving_var
+        mean, var = (moving_mean.astype(jnp.float32),
+                     moving_var.astype(jnp.float32))
         new_mm, new_mv = moving_mean, moving_var
     mean_r = mean.reshape(shape)
     var_r = var.reshape(shape)
-    out = (data - mean_r) * lax.rsqrt(var_r + eps) * g.reshape(shape) \
-        + beta.reshape(shape)
-    return out, lax.stop_gradient(new_mm), lax.stop_gradient(new_mv)
+    out = (x32 - mean_r) * lax.rsqrt(var_r + eps) \
+        * g.astype(jnp.float32).reshape(shape) \
+        + beta.astype(jnp.float32).reshape(shape)
+    return (out.astype(data.dtype), lax.stop_gradient(new_mm),
+            lax.stop_gradient(new_mv))
 
 
 register("BatchNorm", _k_batch_norm,

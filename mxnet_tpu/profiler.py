@@ -1,0 +1,139 @@
+"""Profiler (ref: src/profiler/profiler.{h,cc} + python/mxnet/profiler.py).
+
+Two tiers, per SURVEY §5:
+1. Op-level chrome://tracing JSON — every imperative invoke is bracketed
+   (dispatch + optional sync timing), dumped via ``dumps()``/``dump()``
+   exactly like the reference's MXDumpProfile.
+2. XLA-level — ``start()`` can also open a jax.profiler trace
+   (tensorboard-plugin-profile readable) capturing device timelines.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from .base import getenv
+
+_state = threading.local()
+_config = {
+    "profile_all": False,
+    "profile_imperative": True,
+    "filename": "profile.json",
+    "aggregate_stats": False,
+    "xla_trace_dir": None,
+    "sync": False,  # block per op for accurate durations
+}
+_events = []
+_events_lock = threading.Lock()
+_running = False
+_xla_running = False
+
+
+def set_config(**kwargs):
+    """Ref: mx.profiler.set_config(profile_all=True, filename=...)."""
+    for k, v in kwargs.items():
+        if k in ("profile_symbolic", "profile_memory", "profile_api",
+                 "continuous_dump"):
+            continue  # accepted for parity
+        _config[k] = v
+
+
+def set_state(state="stop", profile_process="worker"):
+    if state == "run":
+        start()
+    else:
+        stop()
+
+
+def start():
+    global _running, _xla_running
+    _running = True
+    if _config.get("xla_trace_dir"):
+        import jax
+
+        jax.profiler.start_trace(_config["xla_trace_dir"])
+        _xla_running = True
+
+
+def stop():
+    global _running, _xla_running
+    _running = False
+    if _xla_running:
+        import jax
+
+        jax.profiler.stop_trace()
+        _xla_running = False
+
+
+def is_running():
+    return _running
+
+
+def record_op(name, begin_us, end_us, shapes=None):
+    if not _running:
+        return
+    with _events_lock:
+        _events.append({
+            "name": name, "ph": "X", "ts": begin_us,
+            "dur": max(end_us - begin_us, 0.01),
+            "pid": os.getpid(), "tid": threading.get_ident() % 100000,
+            "cat": "operator",
+            "args": {"shapes": str(shapes)} if shapes else {},
+        })
+
+
+class _OpScope:
+    __slots__ = ("name", "t0")
+
+    def __init__(self, name):
+        self.name = name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter() * 1e6
+        return self
+
+    def __exit__(self, *a):
+        record_op(self.name, self.t0, time.perf_counter() * 1e6)
+
+
+def op_scope(name):
+    return _OpScope(name)
+
+
+def dumps(reset=False):
+    """Return the chrome trace JSON string (ref: mx.profiler.dumps)."""
+    with _events_lock:
+        data = {"traceEvents": list(_events),
+                "displayTimeUnit": "ms"}
+        if reset:
+            _events.clear()
+    return json.dumps(data)
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write the trace file (ref: mx.profiler.dump)."""
+    with open(_config["filename"], "w") as f:
+        f.write(dumps())
+
+
+def reset():
+    with _events_lock:
+        _events.clear()
+
+
+def pause(profile_process="worker"):
+    global _running
+    _running = False
+
+
+def resume(profile_process="worker"):
+    global _running
+    _running = True
+
+
+# env autostart (ref: MXNET_PROFILER_AUTOSTART)
+if getenv("PROFILER_AUTOSTART", False, bool):
+    _config["profile_all"] = True
+    start()

@@ -1,0 +1,152 @@
+"""Test utilities (ref: python/mxnet/test_utils.py).
+
+The reference's key testing ideas (SURVEY §4): numpy oracles,
+finite-difference gradient checks, check_consistency with CPU as the
+oracle device (here: XLA:CPU vs TPU), and the @with_seed reproducibility
+decorator."""
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+
+from . import autograd, random as _random
+from .base import MXNetError, getenv
+from .context import Context, cpu, current_context, xla
+from .ndarray import ndarray as _nd
+from .ndarray.ndarray import NDArray
+
+_default_ctx = None
+
+
+def default_context():
+    return _default_ctx or current_context()
+
+
+def set_default_context(ctx):
+    global _default_ctx
+    _default_ctx = ctx
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=("a", "b"),
+                        equal_nan=False):
+    a = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, NDArray) else np.asarray(b)
+    if not np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan):
+        idx = np.unravel_index(np.argmax(np.abs(a - b)), a.shape)
+        raise AssertionError(
+            f"{names[0]} and {names[1]} differ: max abs err "
+            f"{np.abs(a - b).max():g} at {idx}: {a[idx]} vs {b[idx]}")
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20):
+    try:
+        assert_almost_equal(a, b, rtol, atol)
+        return True
+    except AssertionError:
+        return False
+
+
+def rand_ndarray(shape, dtype=np.float32, ctx=None, scale=1.0):
+    return _nd.array((np.random.rand(*shape) * scale).astype(dtype),
+                     ctx=ctx)
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, ndim))
+
+
+def with_seed(seed=None):
+    """Reproducibility decorator (ref: @with_seed / MXNET_TEST_SEED):
+    seeds numpy + mx.random; logs the seed on failure for replay."""
+
+    def decorator(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            env = getenv("TEST_SEED", None, int)
+            this_seed = seed if seed is not None else (
+                env if env is not None else np.random.randint(0, 2**31))
+            np.random.seed(this_seed)
+            _random.seed(this_seed)
+            try:
+                return fn(*args, **kwargs)
+            except Exception:
+                print(f"*** test failed with MXTPU_TEST_SEED={this_seed} "
+                      "— set this env var to reproduce ***")
+                raise
+
+        return wrapper
+
+    return decorator
+
+
+def check_numeric_gradient(fwd_fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-2):
+    """Finite-difference gradient check of fwd_fn(list[NDArray])->NDArray
+    (ref: check_numeric_gradient)."""
+    nds = [x if isinstance(x, NDArray) else _nd.array(x) for x in inputs]
+    for x in nds:
+        x.attach_grad()
+    with autograd.record():
+        out = fwd_fn(*nds)
+        loss = out.sum()
+    loss.backward()
+    analytic = [x.grad.asnumpy().copy() for x in nds]
+
+    for i, x in enumerate(nds):
+        base = x.asnumpy().astype(np.float64)
+        num = np.zeros_like(base)
+        it = np.nditer(base, flags=["multi_index"])
+        while not it.finished:
+            idx = it.multi_index
+            for sgn in (+1, -1):
+                pert = base.copy()
+                pert[idx] += sgn * eps
+                args = [nds[j] if j != i else _nd.array(
+                    pert.astype(np.float32)) for j in range(len(nds))]
+                val = float(fwd_fn(*args).sum().asscalar())
+                num[idx] += sgn * val
+            num[idx] /= 2 * eps
+            it.iternext()
+        if not np.allclose(analytic[i], num, rtol=rtol, atol=atol):
+            raise AssertionError(
+                f"gradient mismatch for input {i}: max err "
+                f"{np.abs(analytic[i] - num).max():g}")
+
+
+def check_consistency(fwd_fn, inputs, ctx_list=None, rtol=1e-4, atol=1e-5):
+    """Run the same computation on multiple contexts and compare
+    (ref: check_consistency CPU-vs-GPU — the single most important test
+    idea to copy; here XLA:CPU is the oracle for TPU)."""
+    ctx_list = ctx_list or [cpu(), xla(0)]
+    results = []
+    for ctx in ctx_list:
+        args = [x.as_in_context(ctx) if isinstance(x, NDArray)
+                else _nd.array(x, ctx=ctx) for x in inputs]
+        out = fwd_fn(*args)
+        results.append(out.asnumpy())
+    for r in results[1:]:
+        assert_almost_equal(results[0], r, rtol=rtol, atol=atol,
+                            names=(str(ctx_list[0]), "other"))
+    return results
+
+
+def same(a, b):
+    return np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def gen_buckets_probs_with_ppf(ppf, nbuckets):
+    probs = [1.0 / nbuckets] * nbuckets
+    buckets = [(ppf(i / nbuckets), ppf((i + 1) / nbuckets))
+               for i in range(nbuckets)]
+    return buckets, probs
+
+
+def list_gpus():
+    from .context import num_gpus
+
+    return list(range(num_gpus()))
+
+
+def download(url, fname=None, dirname=None, overwrite=False):
+    raise MXNetError("download unavailable: no network egress")

@@ -1,0 +1,149 @@
+"""Aux subsystem tests: profiler, test_utils, image, amp, monitor
+(ref: test_profiler.py, test_image.py)."""
+import json
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+def test_profiler_op_trace(tmp_path):
+    from mxnet_tpu import profiler
+
+    f = str(tmp_path / "trace.json")
+    profiler.set_config(profile_all=True, filename=f, sync=True)
+    profiler.start()
+    x = nd.ones((32, 32))
+    y = nd.dot(x, x)
+    y = nd.relu(y)
+    y.wait_to_read()
+    profiler.stop()
+    profiler.dump()
+    data = json.loads(open(f).read())
+    names = [e["name"] for e in data["traceEvents"]]
+    assert any("dot" in n for n in names), names
+    assert any("relu" in n for n in names), names
+    profiler.reset()
+
+
+def test_profiler_pause_resume():
+    from mxnet_tpu import profiler
+
+    profiler.reset()
+    profiler.start()
+    profiler.pause()
+    nd.relu(nd.ones((2, 2))).wait_to_read()
+    profiler.resume()
+    nd.sigmoid(nd.ones((2, 2))).wait_to_read()
+    profiler.stop()
+    names = [e["name"] for e in
+             json.loads(profiler.dumps(reset=True))["traceEvents"]]
+    assert not any("relu" in n for n in names)
+    assert any("sigmoid" in n for n in names)
+
+
+def test_check_numeric_gradient():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+
+    check_numeric_gradient(lambda x: (x * x).sum() * 0.5 + x.sum(),
+                           [np.random.rand(3, 3).astype(np.float32)])
+
+
+def test_check_consistency_cpu_vs_xla():
+    from mxnet_tpu.test_utils import check_consistency
+
+    check_consistency(lambda x: nd.softmax(nd.dot(x, x.T)),
+                      [np.random.rand(4, 4).astype(np.float32)])
+
+
+def test_with_seed_decorator():
+    from mxnet_tpu.test_utils import with_seed
+
+    vals = []
+
+    @with_seed(42)
+    def sample():
+        vals.append(nd.random.uniform(shape=(3,)).asnumpy())
+
+    sample()
+    sample()
+    assert np.allclose(vals[0], vals[1])
+
+
+def test_assert_almost_equal_raises():
+    from mxnet_tpu.test_utils import assert_almost_equal
+
+    assert_almost_equal(nd.ones((2,)), np.ones(2))
+    with pytest.raises(AssertionError):
+        assert_almost_equal(nd.ones((2,)), np.zeros(2))
+
+
+def test_image_utils():
+    from mxnet_tpu import image
+
+    img = nd.array((np.random.rand(40, 50, 3) * 255).astype(np.uint8),
+                   dtype=np.uint8)
+    r = image.imresize(img, 32, 24)
+    assert r.shape == (24, 32, 3)
+    rs = image.resize_short(img, 20)
+    assert min(rs.shape[:2]) == 20
+    cc, rect = image.center_crop(img, (16, 16))
+    assert cc.shape == (16, 16, 3)
+    rc, _ = image.random_crop(img, (16, 16))
+    assert rc.shape == (16, 16, 3)
+    normed = image.color_normalize(cc.astype("float32"),
+                                   nd.array([127.0, 127.0, 127.0]))
+    assert normed.asnumpy().max() <= 128.5
+    augs = image.CreateAugmenter((3, 24, 24), rand_mirror=True,
+                                 mean=[0, 0, 0], std=[1, 1, 1])
+    out = img
+    for a in augs:
+        out = a(out)
+    assert out.shape == (24, 24, 3)
+
+
+def test_imdecode_roundtrip(tmp_path):
+    import io as _io
+
+    from PIL import Image
+
+    from mxnet_tpu import image
+
+    arr = (np.random.rand(20, 20, 3) * 255).astype(np.uint8)
+    buf = _io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    out = image.imdecode(buf.getvalue())
+    assert np.array_equal(out.asnumpy(), arr)
+
+
+def test_amp_convert_model():
+    from mxnet_tpu import amp
+
+    amp.init()
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8), nn.BatchNorm(), nn.Dense(2))
+    net.initialize()
+    net(nd.ones((2, 4)))
+    amp.convert_model(net)
+    assert net[0].weight.data().dtype == np.dtype("bfloat16")
+    # norm params stay fp32
+    assert net[1].gamma.data().dtype == np.float32
+    out = net(nd.ones((2, 4)))
+    assert out.dtype == np.dtype("bfloat16")
+
+
+def test_monitor_hooks():
+    from mxnet_tpu.monitor import Monitor
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, activation="relu"), nn.Dense(2))
+    net.initialize()
+    mon = Monitor(interval=1).install(net)
+    mon.tic()
+    net(nd.ones((2, 3)))
+    stats = mon.toc()
+    assert len(stats) >= 2
+    assert all(np.isfinite(v) for _, _, v in stats)
