@@ -5,12 +5,17 @@ CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -pthread
 LDFLAGS ?= -shared -ljpeg
 
 LIB := lib/libmxtpu_io.so
+ENGINE_LIB := lib/libmxtpu_engine.so
 
-all: $(LIB)
+all: $(LIB) $(ENGINE_LIB)
 
 $(LIB): src/recordio.cc
 	@mkdir -p lib
 	$(CXX) $(CXXFLAGS) $< -o $@ $(LDFLAGS)
+
+$(ENGINE_LIB): src/engine.cc
+	@mkdir -p lib
+	$(CXX) $(CXXFLAGS) $< -o $@ -shared -pthread
 
 clean:
 	rm -rf lib

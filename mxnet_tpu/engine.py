@@ -14,6 +14,7 @@ buffers, and (c) a host-side thread pool used by the IO prefetcher.
 """
 from __future__ import annotations
 
+import atexit
 import concurrent.futures
 import weakref
 
@@ -62,6 +63,8 @@ def waitall():
     surfaces async execution errors, so real failures must propagate;
     only already-freed buffers (deleted/donated) are skipped.
     """
+    if _native is not None:
+        _native.wait_all()
     for arr in list(_live):
         try:
             arr.block_until_ready()
@@ -78,10 +81,14 @@ def wait_for_var(jarr):
 
 
 # ---------------------------------------------------------------------------
-# Host-side worker pool: the surviving role of the threaded engine — overlap
-# host work (decode, checkpoint, H2D staging) with device steps.
+# Host-side scheduling: the surviving role of the threaded engine — overlap
+# host work (decode, checkpoint, H2D staging) with device steps.  Backed by
+# the native C++ dependency engine (src/engine.cc, ThreadedVar RAW/WAR/WAW
+# semantics) when built; a plain thread pool otherwise.
 
 _pool = None
+_native = None
+_native_tried = False
 
 
 def host_pool():
@@ -93,13 +100,67 @@ def host_pool():
     return _pool
 
 
+def native_engine():
+    """The C++ threaded engine, or None when unavailable."""
+    global _native, _native_tried
+    if _native is None and not _native_tried:
+        _native_tried = True
+        try:
+            from .utils import native_engine as ne
+            if ne.load() is not None:
+                _native = ne.NativeEngine()
+                # C++ workers must not call back into Python during
+                # interpreter finalization: drain + free before teardown
+                # (ThreadPoolExecutor gets this via its own atexit hook).
+                atexit.register(_shutdown_native)
+        except Exception:
+            _native = None
+    return _native
+
+
+def _shutdown_native():
+    global _native
+    if _native is not None:
+        _native.close()
+        _native = None
+
+
+def _sync_future(fn, *args, **kwargs):
+    f = concurrent.futures.Future()
+    try:
+        f.set_result(fn(*args, **kwargs))
+    except BaseException as e:  # noqa: BLE001 - mirror future semantics
+        f.set_exception(e)
+    return f
+
+
+def new_variable():
+    """Engine var for dependency-tracked host ops (ref: NewVariable)."""
+    eng = native_engine()
+    assert eng is not None, "native engine unavailable"
+    return eng.new_variable()
+
+
+def push(fn, const_vars=(), mutable_vars=()):
+    """Push host work with explicit read/write var deps (ref: PushAsync).
+
+    The C++ engine guarantees: concurrent readers, exclusive writers,
+    FIFO grants per var.  Falls back to synchronous execution when the
+    native lib is missing (correct, just unoverlapped).
+    """
+    if is_naive():
+        return push_host(fn)
+    eng = native_engine()
+    if eng is None:
+        return _sync_future(fn)
+    return eng.push(fn, const_vars, mutable_vars)
+
+
 def push_host(fn, *args, **kwargs):
     """Run host-side work async (ref: Engine::PushAsync with CPU ctx)."""
     if is_naive():
-        f = concurrent.futures.Future()
-        try:
-            f.set_result(fn(*args, **kwargs))
-        except BaseException as e:  # noqa: BLE001 - mirror future semantics
-            f.set_exception(e)
-        return f
+        return _sync_future(fn, *args, **kwargs)
+    eng = native_engine()
+    if eng is not None:
+        return eng.push(lambda: fn(*args, **kwargs))
     return host_pool().submit(fn, *args, **kwargs)

@@ -1,0 +1,152 @@
+"""ctypes binding for the native dependency engine (src/engine.cc →
+lib/libmxtpu_engine.so).
+
+Ref: include/mxnet/engine.h — Engine::PushAsync/NewVariable/WaitForVar/
+WaitForAll, with the ThreadedVar RAW/WAR/WAW contract enforced in C++.
+The TPU build uses it for host-side work (decode, checkpoint, staging);
+device work is ordered by XLA/PjRt itself.  Falls back to None when the
+.so is unavailable (MXTPU_NO_NATIVE=1 forces pure-Python paths).
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import ctypes
+import itertools
+import os
+import shutil
+import subprocess
+import threading
+
+from ..base import getenv
+
+_lib = None
+_tried = False
+
+_EngineFn = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+def _repo_root():
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def load():
+    """Return the native engine lib handle or None."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if getenv("NO_NATIVE", False, bool):
+        return None
+    so = os.path.join(_repo_root(), "lib", "libmxtpu_engine.so")
+    if not os.path.exists(so) and shutil.which("g++"):
+        try:
+            # build just this target: the IO lib needs libjpeg and must
+            # not block the engine (which has no external deps)
+            subprocess.run(
+                ["make", "-C", _repo_root(), "lib/libmxtpu_engine.so"],
+                check=True, capture_output=True, timeout=120)
+        except Exception:
+            return None
+    if not os.path.exists(so):
+        return None
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError:
+        return None
+    lib.MXTPUEngineCreate.restype = ctypes.c_void_p
+    lib.MXTPUEngineCreate.argtypes = [ctypes.c_int, ctypes.c_int]
+    lib.MXTPUEngineFree.argtypes = [ctypes.c_void_p]
+    lib.MXTPUEngineNewVariable.restype = ctypes.c_uint64
+    lib.MXTPUEngineNewVariable.argtypes = [ctypes.c_void_p]
+    lib.MXTPUEngineDeleteVariable.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_uint64]
+    lib.MXTPUEnginePushAsync.argtypes = [
+        ctypes.c_void_p, _EngineFn, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
+    lib.MXTPUEngineWaitForVar.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.MXTPUEngineWaitForAll.argtypes = [ctypes.c_void_p]
+    lib.MXTPUEngineSelfTest.restype = ctypes.c_int
+    lib.MXTPUEngineSelfTest.argtypes = [ctypes.c_uint64, ctypes.c_int,
+                                        ctypes.c_int, ctypes.c_int]
+    _lib = lib
+    return _lib
+
+
+class NativeEngine:
+    """Python handle on the C++ threaded engine.
+
+    Ops are python callables; the C++ side enforces var dependencies and
+    runs them on its worker pool.  Each push returns a Future whose
+    result/exception comes from the callable.
+    """
+
+    def __init__(self, num_workers=None, naive=False):
+        lib = load()
+        assert lib is not None, "native engine library unavailable"
+        self._lib = lib
+        if num_workers is None:
+            num_workers = getenv("CPU_WORKER_NTHREADS", 4, int)
+        self._handle = ctypes.c_void_p(
+            lib.MXTPUEngineCreate(num_workers, int(naive)))
+        self._ops = {}
+        self._ops_lock = threading.Lock()
+        self._ids = itertools.count(1)
+        # single static trampoline; ctx carries the op id so no per-op
+        # CFUNCTYPE object lifetime to manage
+        self._trampoline = _EngineFn(self._run_op)
+
+    def _run_op(self, ctx):
+        with self._ops_lock:
+            fn, fut = self._ops.pop(int(ctx))
+        if not fut.set_running_or_notify_cancel():
+            return
+        try:
+            fut.set_result(fn())
+        except BaseException as e:  # noqa: BLE001 - future semantics
+            fut.set_exception(e)
+
+    def new_variable(self):
+        return self._lib.MXTPUEngineNewVariable(self._handle)
+
+    def delete_variable(self, var):
+        self._lib.MXTPUEngineDeleteVariable(self._handle, var)
+
+    def push(self, fn, const_vars=(), mutable_vars=()):
+        fut = concurrent.futures.Future()
+        op_id = next(self._ids)
+        with self._ops_lock:
+            self._ops[op_id] = (fn, fut)
+        cv = (ctypes.c_uint64 * len(const_vars))(*const_vars)
+        mv = (ctypes.c_uint64 * len(mutable_vars))(*mutable_vars)
+        self._lib.MXTPUEnginePushAsync(
+            self._handle, self._trampoline, ctypes.c_void_p(op_id),
+            cv, len(const_vars), mv, len(mutable_vars))
+        return fut
+
+    def wait_for_var(self, var):
+        self._lib.MXTPUEngineWaitForVar(self._handle, var)
+
+    def wait_all(self):
+        self._lib.MXTPUEngineWaitForAll(self._handle)
+
+    def close(self):
+        if getattr(self, "_handle", None):
+            self._lib.MXTPUEngineWaitForAll(self._handle)
+            self._lib.MXTPUEngineFree(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def self_test(seed=0, n_vars=16, n_ops=2000, num_workers=8):
+    """Random-DAG naive-vs-threaded equivalence check run inside the C++
+    lib (ref: tests/cpp/engine/threaded_engine_test.cc)."""
+    lib = load()
+    assert lib is not None, "native engine library unavailable"
+    return lib.MXTPUEngineSelfTest(seed, n_vars, n_ops, num_workers)
