@@ -37,6 +37,7 @@ _LAZY = {
     "amp": ".amp",
     "monitor": ".monitor",
     "mon": ".monitor",
+    "contrib": ".contrib",
 }
 
 

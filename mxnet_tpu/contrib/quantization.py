@@ -1,0 +1,459 @@
+"""Model quantization: calibration + INT8 graph rewrite.
+
+Ref: python/mxnet/contrib/quantization.py (quantize_model, quantize_net,
+_LayerOutputCollector, _get_optimal_threshold / KL calibration) and
+src/operator/quantization/calibrate.cc — the fork owner's upstream
+specialty (MKL-DNN INT8); here the int8 compute runs on the TPU MXU.
+
+Two entry points, mirroring the reference:
+  * ``quantize_model(sym, arg_params, aux_params, ...)`` — rewrites a
+    symbolic graph: every FullyConnected/Convolution (unless excluded)
+    becomes quantize→quantized_op→dequantize with weights quantized
+    offline into the returned qarg_params.
+  * ``quantize_net(net, ...)`` — replaces Dense/Conv2D children of a
+    Gluon block with int8 wrappers in place.
+
+Calibration modes: 'none' (dynamic per-batch ranges), 'naive' (min/max
+over calibration data), 'entropy' (KL-divergence-optimal thresholds).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ndarray as nd
+from .. import symbol as sym
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from ..symbol.symbol import Group, Symbol, _make_op_symbol, _topo_order
+
+_QUANTIZABLE = ("FullyConnected", "Convolution")
+
+
+# ---------------------------------------------------------------------------
+# Calibration
+
+
+def _get_optimal_threshold(arr, num_bins=8001, num_quantized_bins=255):
+    """KL-divergence-optimal |x| clipping threshold (ref:
+    _get_optimal_threshold in python/mxnet/contrib/quantization.py —
+    the TensorRT-style entropy calibration).
+    """
+    a = np.abs(np.asarray(arr, np.float64).ravel())
+    amax = float(a.max()) if a.size else 0.0
+    if amax == 0.0:
+        return 1e-8
+
+    def smooth(d, eps=1e-4):
+        # redistribute eps mass onto zero bins (ref: _smooth_distribution)
+        nz = d > 0
+        if not nz.any():
+            return None
+        out = d.astype(np.float64).copy()
+        n_zero = d.size - nz.sum()
+        if n_zero:
+            take = eps * n_zero / nz.sum()
+            out[nz] -= take * out[nz] / out[nz].max()
+            out[~nz] = eps
+        return out / out.sum()
+
+    hist, edges = np.histogram(a, bins=num_bins, range=(0.0, amax))
+    best_kl, best_t = np.inf, amax
+    for i in range(num_quantized_bins, num_bins + 1,
+                   max(1, num_bins // 200)):
+        sliced = hist[:i].astype(np.float64)
+        # P includes the clipped tail mass in its edge bin; Q is built
+        # from the histogram WITHOUT that mass — an aggressive threshold
+        # gives P an edge spike Q cannot represent, which is exactly
+        # what penalizes over-clipping.
+        p = sliced.copy()
+        p[-1] += hist[i:].sum()
+        if p.sum() == 0:
+            continue
+        nm = i // num_quantized_bins
+        q = np.zeros(i, np.float64)
+        for j in range(num_quantized_bins):
+            lo = j * nm
+            hi = i if j == num_quantized_bins - 1 else lo + nm
+            seg = sliced[lo:hi]
+            nz = np.count_nonzero(seg)
+            if nz:
+                q[lo:hi] = seg.sum() / nz
+        q[sliced == 0] = 0
+        pn, qn = smooth(p), smooth(q)
+        if pn is None or qn is None:
+            continue
+        mask = pn > 0
+        kl = float(np.sum(pn[mask] * np.log(pn[mask] / qn[mask])))
+        if kl < best_kl:
+            best_kl = kl
+            best_t = float(edges[i if i < len(edges) else -1])
+    return max(best_t, 1e-8)
+
+
+class _Stats:
+    """Running calibration statistics for one tensor."""
+
+    def __init__(self, mode):
+        self.mode = mode
+        self.mn = np.inf
+        self.mx = -np.inf
+        self.samples = []  # entropy mode keeps raw |x| samples
+
+    def update(self, a):
+        a = np.asarray(a)
+        self.mn = min(self.mn, float(a.min()))
+        self.mx = max(self.mx, float(a.max()))
+        if self.mode == "entropy":
+            self.samples.append(np.abs(a).ravel())
+
+    def range(self):
+        if self.mode == "entropy":
+            t = _get_optimal_threshold(np.concatenate(self.samples))
+            return -t, t
+        return self.mn, self.mx
+
+
+def _iter_calib_batches(calib_data, num_calib_examples=None):
+    """Yield numpy data batches from an iterator / NDArray / ndarray."""
+    if isinstance(calib_data, (NDArray, np.ndarray)):
+        yield np.asarray(calib_data.asnumpy() if isinstance(
+            calib_data, NDArray) else calib_data)
+        return
+    seen = 0
+    if hasattr(calib_data, "reset"):
+        calib_data.reset()
+    for batch in calib_data:
+        data = batch.data[0] if hasattr(batch, "data") else batch
+        if isinstance(data, (list, tuple)):
+            data = data[0]
+        arr = data.asnumpy() if isinstance(data, NDArray) else np.asarray(data)
+        yield arr
+        seen += arr.shape[0]
+        if num_calib_examples is not None and seen >= num_calib_examples:
+            return
+
+
+def _collect_layer_stats(symbol, arg_params, aux_params, targets, calib_data,
+                         calib_mode, data_name, num_calib_examples, ctx):
+    """Forward calibration batches through the fp32 graph, recording
+    stats for each target node's data input and output (ref:
+    _LayerOutputCollector / collect_quantized_stat)."""
+    handles = []
+    keys = []
+    for node in targets:
+        src, oi = node.inputs[0]
+        handles.append(Symbol(src, oi))
+        keys.append((node.name, "data"))
+        handles.append(Symbol(node, 0))
+        keys.append((node.name, "out"))
+    group = Group(handles)
+    stats = {k: _Stats(calib_mode) for k in keys}
+    # materialize batches once: calib_data may be a non-resettable
+    # generator, and the first batch is needed for binding anyway
+    batches = list(_iter_calib_batches(calib_data, num_calib_examples))
+    if not batches:
+        raise MXNetError("calibration data yielded no batches")
+    args = dict(arg_params)
+    args[data_name] = nd.array(batches[0], ctx=ctx)
+    ex = group.bind(ctx, args, grad_req="null",
+                    aux_states=dict(aux_params) if aux_params else None)
+    for arr in batches:
+        outs = ex.forward(is_train=False, **{data_name: nd.array(arr,
+                                                                 ctx=ctx)})
+        for k, o in zip(keys, outs):
+            stats[k].update(o.asnumpy())
+    return {k: s.range() for k, s in stats.items()}
+
+
+# ---------------------------------------------------------------------------
+# Symbolic graph rewrite
+
+
+def _offline_quantize(name, arr, qarg_params):
+    """Quantize a parameter offline; store q/min/max (ref: the reference
+    stores `<param>_quantize` plus range params in qarg_params)."""
+    a = arr.asnumpy() if isinstance(arr, NDArray) else np.asarray(arr)
+    r = float(np.max(np.abs(a))) or 1e-8
+    q = np.clip(np.round(a * (127.0 / r)), -127, 127).astype(np.int8)
+    qarg_params[name + "_quantize"] = nd.array(q)
+    qarg_params[name + "_min"] = nd.array(np.float32(-r).reshape(()))
+    qarg_params[name + "_max"] = nd.array(np.float32(r).reshape(()))
+    return (sym.var(name + "_quantize"), sym.var(name + "_min"),
+            sym.var(name + "_max"))
+
+
+def quantize_model(symbol, arg_params, aux_params=None, data_names=("data",),
+                   excluded_sym_names=(), calib_mode="none", calib_data=None,
+                   num_calib_examples=None, quantized_dtype="int8",
+                   ctx=None, logger=None):
+    """Quantize a symbolic model to INT8 (ref: quantize_model in
+    python/mxnet/contrib/quantization.py).
+
+    Returns ``(qsym, qarg_params, aux_params)``.  FullyConnected and
+    Convolution nodes are rewritten to int8 kernels; everything else
+    stays fp32, with dequantize stitching the boundaries.
+    """
+    from ..context import current_context
+
+    if quantized_dtype not in ("int8", "auto"):
+        raise MXNetError(f"unsupported quantized_dtype {quantized_dtype!r}"
+                         " (TPU build quantizes to signed int8)")
+    ctx = ctx or current_context()
+    aux_params = aux_params or {}
+    nodes = _topo_order([symbol._node])
+    targets = [n for n in nodes if n.op in _QUANTIZABLE
+               and n.name not in set(excluded_sym_names)
+               and n.inputs[1][0].op is None]  # weight must be a variable
+
+    calib_tbl = {}
+    if calib_mode != "none":
+        if calib_data is None:
+            raise MXNetError(f"calib_mode={calib_mode!r} needs calib_data")
+        calib_tbl = _collect_layer_stats(
+            symbol, arg_params, aux_params, targets, calib_data, calib_mode,
+            data_names[0], num_calib_examples, ctx)
+        if logger:
+            for k, v in calib_tbl.items():
+                logger.info("calib %s: [%g, %g]", k, *v)
+
+    qarg_params = {}
+    target_ids = {id(n) for n in targets}
+    rewritten = {}  # id(node) -> new node (for Symbol(node, idx) handles)
+
+    def handle(src, oi):
+        return Symbol(rewritten[id(src)], oi)
+
+    for n in nodes:
+        if n.op is None:
+            rewritten[id(n)] = sym.var(n.name)._node
+            continue
+        ins = [handle(s, oi) for s, oi in n.inputs]
+        if id(n) not in target_ids:
+            rewritten[id(n)] = _make_op_symbol(n.op, ins, dict(n.attrs),
+                                               name=n.name)._node
+            continue
+        # --- the quantized replacement -----------------------------------
+        data_in = ins[0]
+        dr = calib_tbl.get((n.name, "data"))
+        qattrs = {"out_type": "int8"}
+        if dr is not None:
+            qattrs.update(min_calib_range=dr[0], max_calib_range=dr[1])
+        qd = _make_op_symbol("_contrib_quantize_v2", [data_in], qattrs,
+                             name=n.name + "_quantize")
+        wname = n.inputs[1][0].name
+        qw, wmin, wmax = _offline_quantize(wname, arg_params[wname],
+                                           qarg_params)
+        no_bias = len(n.inputs) < 3 or bool(n.attrs.get("no_bias", False))
+        if not no_bias:
+            bname = n.inputs[2][0].name
+            qb, bmin, bmax = _offline_quantize(bname, arg_params[bname],
+                                               qarg_params)
+            q_ins = [qd[0], qw, qb, qd[1], qd[2], wmin, wmax, bmin, bmax]
+        else:
+            q_ins = [qd[0], qw, None, qd[1], qd[2], wmin, wmax]
+            q_ins = [x for x in q_ins if x is not None]
+        qop = ("_contrib_quantized_fully_connected"
+               if n.op == "FullyConnected" else "_contrib_quantized_conv")
+        attrs = dict(n.attrs)
+        attrs.pop("cudnn_tune", None), attrs.pop("cudnn_off", None)
+        attrs.pop("workspace", None)
+        attrs["no_bias"] = no_bias
+        qnode = _make_op_symbol(qop, q_ins, attrs, name=n.name + "_int8")
+        out, omin, omax = qnode[0], qnode[1], qnode[2]
+        orr = calib_tbl.get((n.name, "out"))
+        if orr is not None:
+            rq = _make_op_symbol(
+                "_contrib_requantize", [out, omin, omax],
+                {"min_calib_range": orr[0], "max_calib_range": orr[1]},
+                name=n.name + "_requantize")
+            out, omin, omax = rq[0], rq[1], rq[2]
+        deq = _make_op_symbol("_contrib_dequantize", [out, omin, omax], {},
+                              name=n.name + "_dequantize")
+        rewritten[id(n)] = deq._node
+
+    qsym = Symbol(rewritten[id(symbol._node)], symbol._index)
+    # carry over the fp32 params the rewritten graph still references
+    # (replaced weights drop out of list_arguments automatically)
+    for name in qsym.list_arguments():
+        if name not in qarg_params and name in arg_params:
+            qarg_params[name] = arg_params[name]
+    return qsym, qarg_params, dict(aux_params)
+
+
+# ---------------------------------------------------------------------------
+# Gluon net quantization
+
+
+class _QuantizedDense:
+    """int8 replacement for nn.Dense (ref: quantize_net's SymbolBlock
+    result; here an eager wrapper holding offline-quantized weights)."""
+
+    def __init__(self, layer, data_range=None, out_range=None):
+        self._units = layer._units
+        self._flatten = layer._flatten
+        self._activation = layer._activation
+        w = layer.weight.data()
+        self.qw, self.wmin, self.wmax = _np_quantize(w.asnumpy())
+        self.qbias = (_np_quantize(layer.bias.data().asnumpy())
+                      if layer.bias is not None else None)
+        self.data_range = data_range
+        # calibration hooks see the POST-activation output; requantizing
+        # the pre-activation accumulator to that range would clip wrongly,
+        # so a calibrated out range is only usable without activation
+        self.out_range = out_range if not self._activation else None
+
+    def __call__(self, x):
+        return _quantized_dense_forward(self, x)
+
+    # Block-protocol shims so the wrapper can sit in _children
+    def collect_params(self, select=None):
+        from ..gluon.parameter import ParameterDict
+        return ParameterDict()
+
+    def hybridize(self, active=True, **kwargs):
+        pass
+
+
+class _QuantizedConv(_QuantizedDense):
+    def __init__(self, layer, data_range=None, out_range=None):
+        self._kwargs = dict(layer._kwargs)
+        self._kwargs.pop("layout", None)
+        self._activation = layer._activation
+        w = layer.weight.data()
+        self.qw, self.wmin, self.wmax = _np_quantize(w.asnumpy())
+        self.qbias = (_np_quantize(layer.bias.data().asnumpy())
+                      if layer.bias is not None else None)
+        self.data_range = data_range
+        self.out_range = out_range if not self._activation else None
+
+    def __call__(self, x):
+        return _quantized_conv_forward(self, x)
+
+
+def _np_quantize(a):
+    r = float(np.max(np.abs(a))) or 1e-8
+    q = np.clip(np.round(a * (127.0 / r)), -127, 127).astype(np.int8)
+    return nd.array(q), nd.array(np.float32(-r).reshape(())), \
+        nd.array(np.float32(r).reshape(()))
+
+
+def _quantize_input(x, data_range):
+    if data_range is None:
+        return nd.contrib.quantize_v2(x)
+    return nd.contrib.quantize_v2(x, min_calib_range=data_range[0],
+                                  max_calib_range=data_range[1])
+
+
+def _finish(out32, omin, omax, out_range, activation):
+    if out_range is not None:
+        out32, omin, omax = nd.contrib.requantize(
+            out32, omin, omax, min_calib_range=out_range[0],
+            max_calib_range=out_range[1])
+    out = nd.contrib.dequantize(out32, omin, omax)
+    if activation:
+        out = nd.Activation(out, act_type=activation)
+    return out
+
+
+def _quantized_dense_forward(self, x):
+    qx, dmin, dmax = _quantize_input(x, self.data_range)
+    if self.qbias is not None:
+        qb, bmin, bmax = self.qbias
+        out32, omin, omax = nd.contrib.quantized_fully_connected(
+            qx, self.qw, qb, dmin, dmax, self.wmin, self.wmax, bmin, bmax,
+            num_hidden=self._units, flatten=self._flatten)
+    else:
+        out32, omin, omax = nd.contrib.quantized_fully_connected(
+            qx, self.qw, None, dmin, dmax, self.wmin, self.wmax,
+            num_hidden=self._units, no_bias=True, flatten=self._flatten)
+    return _finish(out32, omin, omax, self.out_range, self._activation)
+
+
+def _quantized_conv_forward(self, x):
+    qx, dmin, dmax = _quantize_input(x, self.data_range)
+    kw = self._kwargs
+    if self.qbias is not None:
+        qb, bmin, bmax = self.qbias
+        out32, omin, omax = nd.contrib.quantized_conv(
+            qx, self.qw, qb, dmin, dmax, self.wmin, self.wmax, bmin, bmax,
+            **kw)
+    else:
+        out32, omin, omax = nd.contrib.quantized_conv(
+            qx, self.qw, None, dmin, dmax, self.wmin, self.wmax, **kw)
+    return _finish(out32, omin, omax, self.out_range, self._activation)
+
+
+def quantize_net(network, calib_data=None, calib_mode="naive",
+                 exclude_layers=None, num_calib_examples=None,
+                 quantized_dtype="int8"):
+    """Quantize a Gluon network's Dense/Conv2D layers to INT8 in place
+    (ref: quantize_net in python/mxnet/contrib/quantization.py).
+
+    With calib_data, activation ranges are calibrated ('naive' min/max or
+    'entropy' KL); without, ranges are computed per batch at runtime.
+    """
+    from ..gluon import nn as gnn
+
+    exclude = set(exclude_layers or ())
+    targets = []  # (parent, child_key, layer)
+
+    def walk(block):
+        for key, child in list(block._children.items()):
+            if isinstance(child, gnn.Dense) and child.name not in exclude:
+                targets.append((block, key, child))
+            elif type(child).__name__ == "Conv2D" \
+                    and child.name not in exclude:
+                targets.append((block, key, child))
+            else:
+                walk(child)
+
+    walk(network)
+    ranges = {}
+    if calib_data is not None and calib_mode != "none":
+        stats = {id(t[2]): (_Stats(calib_mode), _Stats(calib_mode))
+                 for t in targets}
+        hooks = []
+        for _, _, layer in targets:
+            def hook(block, inputs, output, _s=stats):
+                s_in, s_out = _s[id(block)]
+                s_in.update(inputs[0].asnumpy())
+                s_out.update(output.asnumpy())
+            hooks.append(layer.register_forward_hook(hook))
+        for arr in _iter_calib_batches(calib_data, num_calib_examples):
+            network(nd.array(arr))
+        for h in hooks:
+            h.detach()
+        for _, _, layer in targets:
+            s_in, s_out = stats[id(layer)]
+            ranges[id(layer)] = (s_in.range(), s_out.range())
+
+    for parent, key, layer in targets:
+        dr, orr = ranges.get(id(layer), (None, None))
+        wrapper_cls = (_QuantizedDense if isinstance(layer, gnn.Dense)
+                       else _QuantizedConv)
+        wrapper = wrapper_cls(layer, data_range=dr, out_range=orr)
+        parent._children[key] = wrapper
+        # Sequential/HybridSequential iterate _layers, not _children
+        layers = getattr(parent, "_layers", None)
+        if layers is not None:
+            for i, l in enumerate(layers):
+                if l is layer:
+                    layers[i] = wrapper
+        # keep attribute access (net.fc1) pointing at the wrapper too
+        for attr, val in list(vars(parent).items()):
+            if val is layer:
+                object.__setattr__(parent, attr, wrapper)
+
+    # drop any stale compiled fp32 graphs: a hybridized ancestor would
+    # otherwise keep executing the original layers from its CachedOp
+    def dehybridize(block):
+        if hasattr(block, "_cached_op") and block._cached_op is not None:
+            block._cached_op.release()
+            block._cached_op = None
+        if hasattr(block, "_active"):
+            block._active = False
+        for child in getattr(block, "_children", {}).values():
+            dehybridize(child)
+
+    dehybridize(network)
+    return network

@@ -49,6 +49,25 @@ class _BlockScope:
         return f"{hint}{i}_"
 
 
+class HookHandle:
+    """Removable handle for a registered hook (ref: gluon.utils.HookHandle)."""
+
+    def __init__(self, hooks_list, hook):
+        self._hooks_list = hooks_list
+        self._hook = hook
+
+    def detach(self):
+        if self._hook is not None and self._hook in self._hooks_list:
+            self._hooks_list.remove(self._hook)
+        self._hook = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.detach()
+
+
 class Block:
     """Base container for layers & parameters (ref: gluon.Block)."""
 
@@ -193,11 +212,11 @@ class Block:
 
     def register_forward_hook(self, hook):
         self._forward_hooks.append(hook)
-        return hook
+        return HookHandle(self._forward_hooks, hook)
 
     def register_forward_pre_hook(self, hook):
         self._forward_pre_hooks.append(hook)
-        return hook
+        return HookHandle(self._forward_pre_hooks, hook)
 
     # -- call ---------------------------------------------------------------
 

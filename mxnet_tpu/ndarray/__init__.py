@@ -11,6 +11,24 @@ from .. import random  # noqa: F401  (mx.nd.random.* sampling namespace)
 from .ndarray import zeros_like, ones_like  # noqa: F401,E402
 
 
+class _ContribNamespace:
+    """mx.nd.contrib.X → the op registered as `_contrib_X`
+    (ref: python/mxnet generates the contrib submodule the same way)."""
+
+    def __init__(self, mod):
+        self._mod = mod
+
+    def __getattr__(self, name):
+        try:
+            return getattr(self._mod, "_contrib_" + name)
+        except AttributeError:
+            raise AttributeError(
+                f"contrib namespace has no operator '{name}'") from None
+
+
+contrib = _ContribNamespace(_gen_ops)
+
+
 def __getattr__(name):
     # fall through to generated ops for aliases added later
     return getattr(_gen_ops, name)

@@ -7,4 +7,5 @@ from . import tensor  # noqa: F401
 from . import nn  # noqa: F401
 from . import rnn  # noqa: F401
 from . import attention  # noqa: F401
+from . import quantization  # noqa: F401
 from .registry import get, list_ops, register  # noqa: F401

@@ -1,0 +1,215 @@
+"""INT8 quantization operator family (the fork's specialty).
+
+Ref: src/operator/quantization/ — quantize{,_v2}-inl.h, dequantize-inl.h,
+requantize-inl.h, quantized_conv.{cc,cu}, quantized_fully_connected.*,
+quantized_pooling.*, quantization_utils.h.
+
+TPU-native design: int8 × int8 → int32 matmul/conv runs natively on the
+MXU (``preferred_element_type=jnp.int32``), so the quantized compute ops
+are real int8 kernels, not emulation.  Range bookkeeping follows the
+reference: a quantized tensor travels as (q, min_range, max_range) with
+  int8  (signed, symmetric):  real = q * max(|min|,|max|) / 127
+  uint8 (affine):             real = min + q * (max-min) / 255
+  int32 (accumulator):        real = q * max(|min|,|max|) / (2^31 - 1)
+and the int32 output range of a s8·s8 product is
+INT32_MAX/(127*127) * r_data * r_weight (ref: quantization_utils.h
+QuantizedRangeForS8S8MultiplicationStruct).  All ops are inference-only
+(nondiff), matching the reference.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_INT32_MAX = float(2**31 - 1)
+
+
+def _abs_range(mn, mx):
+    return jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+
+
+def _q8(x, real_range):
+    scale = 127.0 / jnp.maximum(real_range, 1e-30)
+    return jnp.clip(jnp.round(x * scale), -127, 127).astype(jnp.int8)
+
+
+# ---------------------------------------------------------------------------
+# quantize / quantize_v2 (ref: quantize-inl.h, quantize_v2-inl.h)
+
+
+def _k_quantize(data, min_range, max_range, *, out_type="int8"):
+    mn = jnp.asarray(min_range, jnp.float32).reshape(())
+    mx = jnp.asarray(max_range, jnp.float32).reshape(())
+    if out_type == "uint8":
+        scale = 255.0 / jnp.maximum(mx - mn, 1e-30)
+        q = jnp.clip(jnp.round((data - mn) * scale), 0, 255).astype(jnp.uint8)
+        return q, mn, mx
+    r = _abs_range(mn, mx)
+    return _q8(data, r), -r, r
+
+register("_contrib_quantize", _k_quantize,
+         arg_names=("data", "min_range", "max_range"),
+         aliases=("quantize",), num_outputs=3, nondiff=True)
+
+
+def _k_quantize_v2(data, *, out_type="int8", min_calib_range=None,
+                   max_calib_range=None):
+    if min_calib_range is None or max_calib_range is None:
+        mn = jnp.min(data).astype(jnp.float32)
+        mx = jnp.max(data).astype(jnp.float32)
+    else:
+        mn = jnp.float32(min_calib_range)
+        mx = jnp.float32(max_calib_range)
+    return _k_quantize(data, mn, mx, out_type=out_type)
+
+register("_contrib_quantize_v2", _k_quantize_v2, arg_names=("data",),
+         aliases=("quantize_v2",), num_outputs=3, nondiff=True)
+
+
+# ---------------------------------------------------------------------------
+# dequantize (ref: dequantize-inl.h)
+
+
+def _k_dequantize(data, min_range, max_range, *, out_type="float32"):
+    mn = jnp.asarray(min_range, jnp.float32).reshape(())
+    mx = jnp.asarray(max_range, jnp.float32).reshape(())
+    if data.dtype == jnp.uint8:
+        return mn + data.astype(jnp.float32) * (mx - mn) / 255.0
+    if data.dtype == jnp.int32:
+        return data.astype(jnp.float32) * _abs_range(mn, mx) / _INT32_MAX
+    return data.astype(jnp.float32) * _abs_range(mn, mx) / 127.0
+
+register("_contrib_dequantize", _k_dequantize,
+         arg_names=("data", "min_range", "max_range"),
+         aliases=("dequantize",), nondiff=True)
+
+
+# ---------------------------------------------------------------------------
+# requantize: int32 accumulator → calibrated int8 (ref: requantize-inl.h)
+
+
+def _k_requantize(data, min_range, max_range, *, min_calib_range=None,
+                  max_calib_range=None):
+    real = _k_dequantize(data, min_range, max_range)
+    if min_calib_range is not None and max_calib_range is not None:
+        r = _abs_range(jnp.float32(min_calib_range),
+                       jnp.float32(max_calib_range))
+    else:
+        r = jnp.max(jnp.abs(real))
+    return _q8(real, r), -r, r
+
+register("_contrib_requantize", _k_requantize,
+         arg_names=("data", "min_range", "max_range"),
+         aliases=("requantize",), num_outputs=3, nondiff=True)
+
+
+# ---------------------------------------------------------------------------
+# quantized compute ops: FC / conv / pooling / flatten
+# Bias handling follows the reference: bias is re-quantized to the
+# accumulator scale s_data*s_weight and added in int32.
+
+
+def _s8s8_out_range(min_d, max_d, min_w, max_w):
+    r = (_abs_range(min_d, max_d) * _abs_range(min_w, max_w)
+         * (_INT32_MAX / (127.0 * 127.0)))
+    return -r, r
+
+
+def _bias_to_i32(bias, min_b, max_b, min_d, max_d, min_w, max_w):
+    real_b = _k_dequantize(bias, min_b, max_b)
+    s_d = 127.0 / jnp.maximum(_abs_range(min_d, max_d), 1e-30)
+    s_w = 127.0 / jnp.maximum(_abs_range(min_w, max_w), 1e-30)
+    return jnp.round(real_b * s_d * s_w).astype(jnp.int32)
+
+
+def _k_quantized_fully_connected(data, weight, bias, min_data, max_data,
+                                 min_weight, max_weight, min_bias=None,
+                                 max_bias=None, *, num_hidden, no_bias=False,
+                                 flatten=True):
+    x = data.reshape(data.shape[0], -1) if flatten and data.ndim > 2 else data
+    out = lax.dot_general(x, weight, (((x.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    if not no_bias and bias is not None:
+        out = out + _bias_to_i32(bias, min_bias, max_bias, min_data,
+                                 max_data, min_weight, max_weight)
+    mn, mx = _s8s8_out_range(min_data, max_data, min_weight, max_weight)
+    return out, mn, mx
+
+register("_contrib_quantized_fully_connected", _k_quantized_fully_connected,
+         arg_names=("data", "weight", "bias", "min_data", "max_data",
+                    "min_weight", "max_weight", "min_bias", "max_bias"),
+         aliases=("quantized_fully_connected",), num_outputs=3, nondiff=True)
+
+
+_CONV_DIMS = {1: ("NCW", "OIW", "NCW"),
+              2: ("NCHW", "OIHW", "NCHW"),
+              3: ("NCDHW", "OIDHW", "NCDHW")}
+
+
+def _k_quantized_conv(data, weight, bias, min_data, max_data, min_weight,
+                      max_weight, min_bias=None, max_bias=None, *, kernel,
+                      stride=(), dilate=(), pad=(), num_filter=0,
+                      num_group=1, no_bias=False, layout=None):
+    nd = len(kernel)
+    stride = stride or (1,) * nd
+    dilate = dilate or (1,) * nd
+    pad = pad or (0,) * nd
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_DIMS[nd])
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group, preferred_element_type=jnp.int32)
+    if not no_bias and bias is not None:
+        b = _bias_to_i32(bias, min_bias, max_bias, min_data, max_data,
+                         min_weight, max_weight)
+        out = out + b.reshape((1, -1) + (1,) * nd)
+    mn, mx = _s8s8_out_range(min_data, max_data, min_weight, max_weight)
+    return out, mn, mx
+
+register("_contrib_quantized_conv", _k_quantized_conv,
+         arg_names=("data", "weight", "bias", "min_data", "max_data",
+                    "min_weight", "max_weight", "min_bias", "max_bias"),
+         aliases=("quantized_conv",), num_outputs=3, nondiff=True)
+
+
+def _k_quantized_pooling(data, min_data, max_data, *, kernel=(), pool_type="max",
+                         stride=(), pad=(), global_pool=False):
+    nd = data.ndim - 2
+    if global_pool:
+        kernel = data.shape[2:]
+        stride = (1,) * nd
+        pad = (0,) * nd
+    stride = stride or (1,) * nd
+    pad = pad or (0,) * nd
+    window = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if pool_type == "max":
+        init = jnp.iinfo(jnp.int8).min if data.dtype == jnp.int8 else 0
+        out = lax.reduce_window(data, jnp.array(init, data.dtype),
+                                lax.max, window, strides, padding)
+    else:  # avg pooling stays in int32 then rounds back to the same scale
+        s = lax.reduce_window(data.astype(jnp.int32), jnp.int32(0), lax.add,
+                              window, strides, padding)
+        denom = 1
+        for k in kernel:
+            denom *= k
+        out = jnp.round(s / denom).astype(data.dtype)
+    return out, jnp.asarray(min_data, jnp.float32).reshape(()), \
+        jnp.asarray(max_data, jnp.float32).reshape(())
+
+register("_contrib_quantized_pooling", _k_quantized_pooling,
+         arg_names=("data", "min_data", "max_data"),
+         aliases=("quantized_pooling",), num_outputs=3, nondiff=True)
+
+
+def _k_quantized_flatten(data, min_data, max_data):
+    return (data.reshape(data.shape[0], -1),
+            jnp.asarray(min_data, jnp.float32).reshape(()),
+            jnp.asarray(max_data, jnp.float32).reshape(()))
+
+register("_contrib_quantized_flatten", _k_quantized_flatten,
+         arg_names=("data", "min_data", "max_data"),
+         aliases=("quantized_flatten",), num_outputs=3, nondiff=True)

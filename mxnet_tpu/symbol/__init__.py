@@ -3,6 +3,9 @@ from .symbol import (Symbol, Executor, var, Variable, load, fromjson,  # noqa: F
                      Group)
 from . import symbol as _symbol_mod
 from . import export  # noqa: F401
+from ..ndarray import _ContribNamespace
+
+contrib = _ContribNamespace(_symbol_mod)
 
 
 def __getattr__(name):

@@ -304,6 +304,8 @@ class _SymbolList(list):
 def _n_outputs(node):
     if node.op is None:
         return 1
+    if node.op == "_group":
+        return len(node.inputs)
     entry = _registry.get(node.op)
     if entry.num_outputs == 1:
         return 1
@@ -315,6 +317,8 @@ def _n_outputs(node):
         return 3
     if node.op == "topk":
         return 2 if node.attrs.get("ret_typ") == "both" else 1
+    if entry.num_outputs > 1:
+        return entry.num_outputs
     return 1
 
 
@@ -344,6 +348,8 @@ def _eval_graph(heads, feed, is_train=False, key=None):
             if n.name not in feed:
                 raise MXNetError(f"missing binding for variable {n.name!r}")
             vals[id(n)] = (feed[n.name],)
+        elif n.op == "_group":
+            vals[id(n)] = tuple(vals[id(src)][oi] for src, oi in n.inputs)
         else:
             entry = _registry.get(n.op)
             ins = [vals[id(src)][oi] for src, oi in n.inputs]
@@ -413,6 +419,9 @@ def _solve_param_shapes(heads, known):
             in_shapes.append(s[oi] if s is not None and oi < len(s)
                              else None)
         if any(s is None for s in in_shapes):
+            continue
+        if n.op == "_group":
+            out_shapes[id(n)] = tuple(tuple(s) for s in in_shapes)
             continue
         entry = _registry.get(n.op)
         attrs = dict(n.attrs)
@@ -663,11 +672,15 @@ for _nm, _fn in [("_plus_scalar", _add_scalar), ("_minus_scalar", _sub_scalar),
 
 
 def Group(symbols):
-    """Group outputs (ref: mx.sym.Group) — via a tuple-returning concat of
-    heads using the identity of the first node; simplest faithful form:
-    a multi-output pseudo-node."""
-    raise MXNetError("sym.Group: use list of symbols with Module outputs "
-                     "(Group pseudo-node lands with multi-head executor)")
+    """Group heads into one multi-output symbol (ref: mx.sym.Group /
+    nnvm Symbol::CreateGroup).  Executed as a `_group` pseudo-node that
+    just forwards its inputs' values."""
+    syms = list(symbols)
+    if not syms:
+        raise MXNetError("sym.Group: empty symbol list")
+    node = _Node("_group", _auto_name("group"),
+                 {}, [(s._node, s._index) for s in syms])
+    return Symbol(node, 0)
 
 
 def load(fname):

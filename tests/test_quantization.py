@@ -1,0 +1,227 @@
+"""INT8 quantization tests.
+
+Ref test strategy: tests/python/quantization/test_quantization.py —
+quantize/dequantize roundtrips, quantized op vs fp32 reference within
+tolerance, calibration, and whole-model quantization.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.contrib import quantization as qz
+
+
+def test_quantize_dequantize_roundtrip_int8():
+    x = np.random.RandomState(0).randn(16, 32).astype(np.float32) * 4
+    q, mn, mx_ = nd.contrib.quantize_v2(nd.array(x))
+    assert q.dtype == np.int8
+    back = nd.contrib.dequantize(q, mn, mx_).asnumpy()
+    step = float(mx_.asscalar()) / 127
+    assert np.abs(back - x).max() <= step / 2 + 1e-6
+
+
+def test_quantize_uint8_affine():
+    x = np.random.RandomState(1).rand(8, 8).astype(np.float32) * 10 - 2
+    q, mn, mx_ = nd.contrib.quantize(
+        nd.array(x), nd.array(np.float32(x.min()).reshape(())),
+        nd.array(np.float32(x.max()).reshape(())), out_type="uint8")
+    assert q.dtype == np.uint8
+    back = nd.contrib.dequantize(q, mn, mx_).asnumpy()
+    step = (x.max() - x.min()) / 255
+    assert np.abs(back - x).max() <= step / 2 + 1e-6
+
+
+def test_quantize_calibrated_clips():
+    x = np.array([-10.0, -1.0, 0.5, 1.0, 10.0], np.float32)
+    q, mn, mx_ = nd.contrib.quantize_v2(nd.array(x), min_calib_range=-1.0,
+                                        max_calib_range=1.0)
+    qn = q.asnumpy()
+    assert qn[0] == -127 and qn[-1] == 127  # outliers clip to the range
+    assert float(mx_.asscalar()) == pytest.approx(1.0)
+
+
+def test_quantized_fc_matches_fp32():
+    rs = np.random.RandomState(2)
+    x = rs.randn(10, 24).astype(np.float32)
+    w = rs.randn(6, 24).astype(np.float32)
+    b = rs.randn(6).astype(np.float32)
+    ref = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b),
+                            num_hidden=6).asnumpy()
+    qx, xmn, xmx = nd.contrib.quantize_v2(nd.array(x))
+    qw, wmn, wmx = nd.contrib.quantize_v2(nd.array(w))
+    qb, bmn, bmx = nd.contrib.quantize_v2(nd.array(b))
+    out, omn, omx = nd.contrib.quantized_fully_connected(
+        qx, qw, qb, xmn, xmx, wmn, wmx, bmn, bmx, num_hidden=6)
+    assert out.dtype == np.int32
+    got = nd.contrib.dequantize(out, omn, omx).asnumpy()
+    rel = np.abs(got - ref).max() / max(np.abs(ref).max(), 1e-6)
+    assert rel < 0.03, rel
+
+
+def test_quantized_conv_matches_fp32():
+    rs = np.random.RandomState(3)
+    x = rs.randn(2, 3, 10, 10).astype(np.float32)
+    w = rs.randn(8, 3, 3, 3).astype(np.float32)
+    ref = nd.Convolution(nd.array(x), nd.array(w), no_bias=True,
+                         kernel=(3, 3), num_filter=8).asnumpy()
+    qx, xmn, xmx = nd.contrib.quantize_v2(nd.array(x))
+    qw, wmn, wmx = nd.contrib.quantize_v2(nd.array(w))
+    out, omn, omx = nd.contrib.quantized_conv(
+        qx, qw, None, xmn, xmx, wmn, wmx, kernel=(3, 3), num_filter=8,
+        no_bias=True)
+    got = nd.contrib.dequantize(out, omn, omx).asnumpy()
+    rel = np.abs(got - ref).max() / max(np.abs(ref).max(), 1e-6)
+    assert rel < 0.03, rel
+
+
+def test_quantized_pooling_preserves_scale():
+    rs = np.random.RandomState(4)
+    x = rs.randn(2, 3, 8, 8).astype(np.float32)
+    qx, mn, mx_ = nd.contrib.quantize_v2(nd.array(x))
+    qp, pmn, pmx = nd.contrib.quantized_pooling(qx, mn, mx_, kernel=(2, 2),
+                                                stride=(2, 2))
+    ref = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                     pool_type="max").asnumpy()
+    got = nd.contrib.dequantize(qp, pmn, pmx).asnumpy()
+    assert np.abs(got - ref).max() < float(mx_.asscalar()) / 127 + 1e-6
+
+
+def test_requantize_to_calibrated_int8():
+    rs = np.random.RandomState(5)
+    x = rs.randn(4, 16).astype(np.float32)
+    w = rs.randn(4, 16).astype(np.float32)
+    qx, xmn, xmx = nd.contrib.quantize_v2(nd.array(x))
+    qw, wmn, wmx = nd.contrib.quantize_v2(nd.array(w))
+    out, omn, omx = nd.contrib.quantized_fully_connected(
+        qx, qw, None, xmn, xmx, wmn, wmx, num_hidden=4, no_bias=True)
+    ref = x.reshape(4, -1) @ w.T
+    amax = float(np.abs(ref).max())
+    q8, rmn, rmx = nd.contrib.requantize(out, omn, omx,
+                                         min_calib_range=-amax,
+                                         max_calib_range=amax)
+    assert q8.dtype == np.int8
+    got = nd.contrib.dequantize(q8, rmn, rmx).asnumpy()
+    rel = np.abs(got - ref).max() / amax
+    assert rel < 0.05, rel
+
+
+def test_kl_threshold_clips_outliers():
+    rs = np.random.RandomState(6)
+    arr = rs.randn(20000).astype(np.float32)
+    arr[0] = 1000.0  # single extreme outlier
+    t = qz._get_optimal_threshold(arr)
+    assert t < 100.0, "entropy calibration should clip the outlier"
+    assert t > 1.0
+
+
+def test_quantize_model_symbolic():
+    import mxnet_tpu.symbol as sym
+
+    rs = np.random.RandomState(7)
+    data = sym.var("data")
+    h = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    h = sym.Activation(h, act_type="relu")
+    out = sym.FullyConnected(h, num_hidden=4, name="fc2")
+
+    arg_params = {
+        "fc1_weight": nd.array(rs.randn(16, 8).astype(np.float32) * 0.3),
+        "fc1_bias": nd.array(rs.randn(16).astype(np.float32) * 0.1),
+        "fc2_weight": nd.array(rs.randn(4, 16).astype(np.float32) * 0.3),
+        "fc2_bias": nd.array(rs.randn(4).astype(np.float32) * 0.1),
+    }
+    x = rs.randn(32, 8).astype(np.float32)
+    ex = out.bind(mx.current_context(),
+                  dict(arg_params, data=nd.array(x)), grad_req="null")
+    ref = ex.forward()[0].asnumpy()
+
+    qsym, qargs, qaux = qz.quantize_model(out, arg_params,
+                                          calib_mode="none")
+    assert any(n.endswith("_quantize") for n in qargs), list(qargs)
+    qex = qsym.bind(mx.current_context(),
+                    dict(qargs, data=nd.array(x)), grad_req="null")
+    got = qex.forward()[0].asnumpy()
+    rel = np.abs(got - ref).max() / max(np.abs(ref).max(), 1e-6)
+    assert rel < 0.06, rel
+
+
+def test_quantize_model_calibrated():
+    import mxnet_tpu.symbol as sym
+
+    rs = np.random.RandomState(8)
+    data = sym.var("data")
+    out = sym.FullyConnected(data, num_hidden=8, name="fcq")
+    arg_params = {
+        "fcq_weight": nd.array(rs.randn(8, 12).astype(np.float32) * 0.5),
+        "fcq_bias": nd.array(rs.randn(8).astype(np.float32) * 0.1),
+    }
+    calib = rs.randn(64, 12).astype(np.float32)
+    qsym, qargs, _ = qz.quantize_model(
+        out, arg_params, calib_mode="naive", calib_data=calib)
+    # calibrated graph bakes requantize with fixed ranges
+    assert "_requantize" in qsym.tojson()
+    # evaluate on calibration-representative data: calibrated ranges
+    # legitimately clip inputs outside what calibration saw
+    x = calib[:16]
+    ex = out.bind(mx.current_context(),
+                  dict(arg_params, data=nd.array(x)), grad_req="null")
+    ref = ex.forward()[0].asnumpy()
+    qex = qsym.bind(mx.current_context(),
+                    dict(qargs, data=nd.array(x)), grad_req="null")
+    got = qex.forward()[0].asnumpy()
+    rel = np.abs(got - ref).max() / max(np.abs(ref).max(), 1e-6)
+    assert rel < 0.08, rel
+
+
+def test_quantize_net_gluon():
+    from mxnet_tpu.gluon import nn
+
+    rs = np.random.RandomState(9)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    x = rs.randn(16, 20).astype(np.float32)
+    ref = net(nd.array(x)).asnumpy()
+
+    calib = rs.randn(64, 20).astype(np.float32)
+    qnet = qz.quantize_net(net, calib_data=calib, calib_mode="naive")
+    # forward path must actually run the int8 wrappers, not stale fp32
+    assert all(type(l).__name__.startswith("_Quantized")
+               for l in qnet._layers), [type(l).__name__
+                                        for l in qnet._layers]
+    got = qnet(nd.array(x)).asnumpy()
+    err = np.abs(got - ref).max()
+    assert err > 0, "quantized output bit-identical to fp32 — no-op?"
+    rel = err / max(np.abs(ref).max(), 1e-6)
+    assert rel < 0.08, rel
+
+
+def test_quantize_net_hybridized_drops_stale_cache():
+    from mxnet_tpu.gluon import nn
+
+    rs = np.random.RandomState(11)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="sigmoid"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = rs.randn(8, 12).astype(np.float32)
+    ref = net(nd.array(x)).asnumpy()  # builds the fp32 CachedOp
+    qz.quantize_net(net)
+    got = net(nd.array(x)).asnumpy()
+    assert np.abs(got - ref).max() > 0, "stale fp32 CachedOp still used"
+    rel = np.abs(got - ref).max() / max(np.abs(ref).max(), 1e-6)
+    assert rel < 0.08, rel
+
+
+def test_quantize_net_excluded_layer():
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    d1, d2 = nn.Dense(16, activation="relu"), nn.Dense(4)
+    net.add(d1, d2)
+    net.initialize()
+    x = np.random.RandomState(10).randn(4, 8).astype(np.float32)
+    net(nd.array(x))
+    qz.quantize_net(net, exclude_layers=[d2.name])
+    kinds = [type(c).__name__ for c in net._children.values()]
+    assert kinds[0] == "_QuantizedDense" and kinds[1] == "Dense", kinds
