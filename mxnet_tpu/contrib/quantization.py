@@ -42,6 +42,18 @@ def _get_optimal_threshold(arr, num_bins=8001, num_quantized_bins=255):
     amax = float(a.max()) if a.size else 0.0
     if amax == 0.0:
         return 1e-8
+    hist, edges = np.histogram(a, bins=num_bins, range=(0.0, amax))
+    return _optimal_threshold_from_hist(hist, edges, num_quantized_bins)
+
+
+def _optimal_threshold_from_hist(hist, edges, num_quantized_bins=255):
+    """Histogram-based core of the KL search: the calibration collector
+    feeds an incrementally-built |x| histogram (fixed memory per tensor,
+    ref: calibrate.cc keeps histograms, never raw samples)."""
+    num_bins = len(hist)
+    amax = float(edges[-1])
+    if amax <= 0.0 or hist.sum() == 0:
+        return 1e-8
 
     def smooth(d, eps=1e-4):
         # redistribute eps mass onto zero bins (ref: _smooth_distribution)
@@ -56,7 +68,6 @@ def _get_optimal_threshold(arr, num_bins=8001, num_quantized_bins=255):
             out[~nz] = eps
         return out / out.sum()
 
-    hist, edges = np.histogram(a, bins=num_bins, range=(0.0, amax))
     best_kl, best_t = np.inf, amax
     for i in range(num_quantized_bins, num_bins + 1,
                    max(1, num_bins // 200)):
@@ -91,24 +102,54 @@ def _get_optimal_threshold(arr, num_bins=8001, num_quantized_bins=255):
 
 
 class _Stats:
-    """Running calibration statistics for one tensor."""
+    """Running calibration statistics for one tensor.
+
+    Entropy mode keeps one fixed-size |x| histogram per tensor, updated
+    batch-by-batch (ref: calibrate.cc accumulates histograms, never raw
+    activations) — host memory is O(num_bins) regardless of how much
+    calibration data flows through."""
+
+    NUM_BINS = 8001
 
     def __init__(self, mode):
         self.mode = mode
         self.mn = np.inf
         self.mx = -np.inf
-        self.samples = []  # entropy mode keeps raw |x| samples
+        self.hist = None
+        self.amax = 0.0
 
     def update(self, a):
         a = np.asarray(a)
         self.mn = min(self.mn, float(a.min()))
         self.mx = max(self.mx, float(a.max()))
-        if self.mode == "entropy":
-            self.samples.append(np.abs(a).ravel())
+        if self.mode != "entropy":
+            return
+        ab = np.abs(a.ravel().astype(np.float64))
+        bmax = float(ab.max()) if ab.size else 0.0
+        if self.hist is None:
+            self.amax = max(bmax, 1e-12)
+            self.hist = np.histogram(
+                ab, bins=self.NUM_BINS, range=(0.0, self.amax))[0]
+            return
+        if bmax > self.amax:
+            # widen: rebin the existing histogram onto the larger range
+            # by bin center (one-bin blur at worst)
+            centers = (np.arange(self.NUM_BINS) + 0.5) * (
+                self.amax / self.NUM_BINS)
+            new_idx = np.minimum(
+                (centers / bmax * self.NUM_BINS).astype(np.int64),
+                self.NUM_BINS - 1)
+            widened = np.zeros(self.NUM_BINS, self.hist.dtype)
+            np.add.at(widened, new_idx, self.hist)
+            self.hist = widened
+            self.amax = bmax
+        self.hist += np.histogram(
+            ab, bins=self.NUM_BINS, range=(0.0, self.amax))[0]
 
     def range(self):
-        if self.mode == "entropy":
-            t = _get_optimal_threshold(np.concatenate(self.samples))
+        if self.mode == "entropy" and self.hist is not None:
+            edges = np.linspace(0.0, self.amax, self.NUM_BINS + 1)
+            t = _optimal_threshold_from_hist(self.hist, edges)
             return -t, t
         return self.mn, self.mx
 
@@ -173,11 +214,10 @@ def _offline_quantize(name, arr, qarg_params):
     """Quantize a parameter offline; store q/min/max (ref: the reference
     stores `<param>_quantize` plus range params in qarg_params)."""
     a = arr.asnumpy() if isinstance(arr, NDArray) else np.asarray(arr)
-    r = float(np.max(np.abs(a))) or 1e-8
-    q = np.clip(np.round(a * (127.0 / r)), -127, 127).astype(np.int8)
-    qarg_params[name + "_quantize"] = nd.array(q)
-    qarg_params[name + "_min"] = nd.array(np.float32(-r).reshape(()))
-    qarg_params[name + "_max"] = nd.array(np.float32(r).reshape(()))
+    q, qmin, qmax = _np_quantize(a)
+    qarg_params[name + "_quantize"] = q
+    qarg_params[name + "_min"] = qmin
+    qarg_params[name + "_max"] = qmax
     return (sym.var(name + "_quantize"), sym.var(name + "_min"),
             sym.var(name + "_max"))
 

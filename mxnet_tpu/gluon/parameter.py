@@ -44,6 +44,8 @@ class Parameter:
         self.lr_mult = lr_mult
         self.wd_mult = wd_mult
         self.init = init
+        self.stype = stype
+        self.grad_stype = grad_stype
         self.allow_deferred_init = allow_deferred_init
         self._differentiable = differentiable
         self._data = None  # {Context: NDArray}

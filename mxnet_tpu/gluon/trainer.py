@@ -123,6 +123,14 @@ class Trainer:
             ctx0 = ctxs[0]
             w = p.data(ctx0)
             g = p.grad(ctx0)
+            if (getattr(p, "grad_stype", "default") == "row_sparse"
+                    and getattr(self._optimizer, "supports_sparse", False)):
+                # sparse_grad embeddings: route through the lazy row-wise
+                # optimizer kernels (ref: trainer.py _row_sparse_pull path);
+                # optimizers without a sparse path keep the dense grad
+                from ..ndarray import sparse as _sparse
+
+                g = _sparse.cast_storage(g, "row_sparse")
             if self._states[i] is None:
                 self._states[i] = {}
             if ctx0 not in self._states[i]:

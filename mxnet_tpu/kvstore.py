@@ -55,10 +55,16 @@ class KVStore:
 
     def init(self, key, value):
         keys, values = _normalize(key, value)
+        from .ndarray.sparse import BaseSparseNDArray
+
         for k, vlist in zip(keys, values):
             if k in self._store:
                 raise MXNetError(f"key {k} already initialized")
-            self._store[k] = vlist[0].copy()
+            v = vlist[0]
+            # canonical stored value is dense: every pull/push path reads
+            # ._data (sparse stays sparse only on the wire, ref: comm.h)
+            self._store[k] = (v.todense() if isinstance(v, BaseSparseNDArray)
+                              else v.copy())
 
     # -- push / pull --------------------------------------------------------
 
@@ -92,7 +98,34 @@ class KVStore:
         self.pull(key, out if out is not None else value, priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        self.pull(key, out=out, priority=priority)
+        """Pull only the requested rows (ref: KVStoreLocal::PullRowSparse).
+
+        `out` row_sparse → filled with the selected rows; dense out gets
+        the full value (rows outside row_ids zeroed)."""
+        if row_ids is None:
+            self.pull(key, out=out, priority=priority)
+            return
+        import numpy as np
+        import jax.numpy as jnp
+
+        from .ndarray.sparse import RowSparseNDArray
+
+        keys, outs = _normalize(key, out)
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"key {k} has not been initialized")
+            src = self._store[k]
+            for o, rid in zip(olist, rids * len(olist)):
+                ids = np.unique(np.asarray(
+                    rid.asnumpy() if isinstance(rid, NDArray) else rid
+                ).astype(np.int64))
+                rows = src._data[jnp.asarray(ids)]
+                if isinstance(o, RowSparseNDArray):
+                    o._values, o._indices = rows, jnp.asarray(ids)
+                else:
+                    dense = jnp.zeros(src.shape, src._data.dtype)
+                    o._data = dense.at[jnp.asarray(ids)].set(rows)
 
     # -- broadcast (newer API parity) --------------------------------------
 
@@ -165,6 +198,17 @@ def _reduce_sum(vlist, target_ctx):
     Eager CommDevice equivalent: gather to the target device and add —
     XLA handles the transfers; inside jit this is a psum.
     """
+    from .ndarray.sparse import BaseSparseNDArray, RowSparseNDArray
+    from .ndarray import sparse as _sparse
+
+    if all(isinstance(v, RowSparseNDArray) for v in vlist):
+        # row_sparse aggregation stays sparse (ref: comm.h ReduceRowSparse)
+        acc = vlist[0]
+        for v in vlist[1:]:
+            acc = _sparse.add(acc, v)
+        return acc.todense()
+    vlist = [v.todense() if isinstance(v, BaseSparseNDArray) else v
+             for v in vlist]
     if len(vlist) == 1:
         return vlist[0].as_in_context(target_ctx)
     acc = vlist[0].as_in_context(target_ctx)

@@ -5,6 +5,8 @@ from .ndarray import (NDArray, array, zeros, ones, full, arange, empty,  # noqa:
 from .ops import *  # noqa: F401,F403  (generated op namespace)
 from . import ops as _gen_ops
 from .. import random  # noqa: F401  (mx.nd.random.* sampling namespace)
+from . import sparse  # noqa: F401  (mx.nd.sparse storage types)
+from .sparse import cast_storage, sparse_retain  # noqa: F401
 
 # creation helpers must win over same-named registered ops: the helper
 # versions preserve the source array's device context
@@ -27,6 +29,19 @@ class _ContribNamespace:
 
 
 contrib = _ContribNamespace(_gen_ops)
+
+# module-level binary helpers accepting scalar or NDArray operands
+# (ref: python/mxnet/ndarray/ndarray.py maximum/minimum/power/hypot)
+maximum = _gen_ops.broadcast_maximum
+minimum = _gen_ops.broadcast_minimum
+power = _gen_ops.broadcast_power
+hypot = _gen_ops.broadcast_hypot
+
+# legacy flat sampling names (ref: python/mxnet/ndarray/random.py keeps
+# mx.nd.random_normal etc. as deprecated aliases of mx.nd.random.*)
+random_normal = random.normal
+random_uniform = random.uniform
+random_randint = random.randint
 
 
 def __getattr__(name):

@@ -331,9 +331,11 @@ class NDArray:
         return self
 
     def tostype(self, stype):
-        if stype != "default":
-            raise MXNetError("sparse storage types: use ndarray.sparse")
-        return self
+        if stype == "default":
+            return self
+        from . import sparse
+
+        return sparse.cast_storage(self, stype)
 
     def detach(self):
         out = _wrap(self._data)

@@ -1,0 +1,364 @@
+"""Sparse NDArray storage types — mx.nd.sparse.
+
+Ref: python/mxnet/ndarray/sparse.py (CSRNDArray / RowSparseNDArray),
+src/operator/tensor/cast_storage-inl.h, dot-inl.h (dot(csr, dense)),
+sparse_retain-inl.h, and the row_sparse branches of
+src/operator/optimizer_op.cc (lazy sgd/adam updates).
+
+TPU-native design: the MXU wants dense tiles, so sparse storage here is
+a *memory/communication* format, not a compute format — exactly how the
+reference uses row_sparse (embedding gradients, kvstore traffic).
+Values/indices live as ordinary device arrays; conversions from dense
+are host-synced (data-dependent shapes cannot live under jit — the
+reference's cast_storage kernel has the same dynamic-output property).
+Compute that stays sparse:
+  * dot(csr, dense) / dot(csr.T, dense) via jax.ops.segment_sum over
+    nnz (rides the VPU; avoids materializing the dense matrix),
+  * sparse_retain / row gather,
+  * lazy row-wise optimizer updates (w.at[rows] scatter — only touched
+    rows are read/written, the HLO is a dynamic-slice scatter).
+Everything else densifies first (tostype('default')), matching the
+reference's dense fallback paths.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from .ndarray import NDArray, _wrap, _to_jax_dtype
+
+
+def _as_jnp(x, dtype=None):
+    if isinstance(x, NDArray):
+        x = x._data
+    return jnp.asarray(x, dtype=dtype)
+
+
+class BaseSparseNDArray:
+    """Shared surface of the two sparse storage classes."""
+
+    stype = None
+
+    @property
+    def dtype(self):
+        return np.dtype(self._values.dtype)
+
+    @property
+    def context(self):
+        dev = list(self._values.devices())[0]
+        return Context("cpu" if dev.platform == "cpu" else "xla", dev.id)
+
+    ctx = context
+
+    @property
+    def data(self):
+        """The values array (ref: CSRNDArray.data / RowSparseNDArray.data)."""
+        return _wrap(self._values)
+
+    @property
+    def indices(self):
+        return _wrap(self._indices)
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape))
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+    def wait_to_read(self):
+        self._values.block_until_ready()
+
+    def tostype(self, stype):
+        if stype == self.stype:
+            return self
+        if stype == "default":
+            return self.todense()
+        return cast_storage(self.todense(), stype)
+
+    def astype(self, dtype):
+        out = self.copy()
+        out._values = self._values.astype(_to_jax_dtype(dtype))
+        return out
+
+    def copyto(self, other):
+        if isinstance(other, Context):
+            out = self.copy()
+            dev = other.jax_device()
+            out._values = jax.device_put(out._values, dev)
+            out._indices = jax.device_put(out._indices, dev)
+            return out
+        if isinstance(other, NDArray):
+            other._data = self.todense()._data
+            return other
+        if isinstance(other, BaseSparseNDArray):
+            raise MXNetError("copyto(sparse) not supported; use tostype")
+        raise MXNetError(f"cannot copyto {type(other)}")
+
+    def as_in_context(self, ctx):
+        if self.context == ctx:
+            return self
+        return self.copyto(ctx)
+
+    def __repr__(self):
+        return (f"\n<{type(self).__name__} {self.shape} "
+                f"@{self.context}>")
+
+    # dense fallbacks (ref: sparse ops fall back via cast_storage)
+    def _dense_binop(self, other, op):
+        lhs = self.todense()
+        if isinstance(other, BaseSparseNDArray):
+            other = other.todense()
+        return getattr(lhs, op)(other)
+
+    def __add__(self, o):
+        return self._dense_binop(o, "__add__")
+
+    def __sub__(self, o):
+        return self._dense_binop(o, "__sub__")
+
+    def __mul__(self, o):
+        return self._dense_binop(o, "__mul__")
+
+    def __truediv__(self, o):
+        return self._dense_binop(o, "__truediv__")
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """2-D compressed-sparse-row array (ref: kCSRStorage,
+    python/mxnet/ndarray/sparse.py CSRNDArray)."""
+
+    stype = "csr"
+
+    def __init__(self, values, indices, indptr, shape):
+        if len(shape) != 2:
+            raise MXNetError("csr storage is 2-D only")
+        self._values = _as_jnp(values)
+        self._indices = _as_jnp(indices, jnp.int32)
+        self._indptr = _as_jnp(indptr, jnp.int32)
+        self.shape = tuple(int(s) for s in shape)
+
+    @property
+    def indptr(self):
+        return _wrap(self._indptr)
+
+    def copy(self):
+        return CSRNDArray(self._values, self._indices, self._indptr,
+                          self.shape)
+
+    def todense(self):
+        n, m = self.shape
+        indptr = np.asarray(self._indptr)
+        rows = jnp.asarray(np.repeat(np.arange(n), np.diff(indptr)))
+        dense = jnp.zeros((n, m), self._values.dtype)
+        dense = dense.at[rows, self._indices].add(self._values)
+        return _wrap(dense)
+
+    def __getitem__(self, key):
+        # row-slice, returns csr (ref: CSRNDArray.__getitem__)
+        if isinstance(key, int):
+            key = slice(key, key + 1)
+        if not isinstance(key, slice) or key.step not in (None, 1):
+            raise MXNetError("csr supports contiguous row slicing only")
+        start, stop, _ = key.indices(self.shape[0])
+        indptr = np.asarray(self._indptr)
+        lo, hi = int(indptr[start]), int(indptr[stop])
+        return CSRNDArray(self._values[lo:hi], self._indices[lo:hi],
+                          indptr[start:stop + 1] - lo,
+                          (stop - start, self.shape[1]))
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """First-dim-sparse array: values[k] is row indices[k] of the dense
+    view (ref: kRowSparseStorage, RowSparseNDArray)."""
+
+    stype = "row_sparse"
+
+    def __init__(self, values, indices, shape):
+        self._values = _as_jnp(values)
+        self._indices = _as_jnp(indices, jnp.int32)
+        self.shape = tuple(int(s) for s in shape)
+        if self._values.shape[1:] != self.shape[1:]:
+            raise MXNetError(
+                f"row_sparse values shape {self._values.shape} does not "
+                f"match dense shape {self.shape}")
+
+    def copy(self):
+        return RowSparseNDArray(self._values, self._indices, self.shape)
+
+    def todense(self):
+        dense = jnp.zeros(self.shape, self._values.dtype)
+        dense = dense.at[self._indices].add(self._values)
+        return _wrap(dense)
+
+    def retain(self, row_ids):
+        return retain(self, row_ids)
+
+
+# ---------------------------------------------------------------------------
+# creation (ref: mx.nd.sparse.csr_matrix / row_sparse_array / zeros)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create a CSRNDArray from (data, indices, indptr), a dense array,
+    or a scipy.sparse matrix."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        if shape is None:
+            raise MXNetError("shape required for (data, indices, indptr)")
+        return CSRNDArray(_as_jnp(data, _to_jax_dtype(dtype)), indices,
+                          indptr, shape)
+    if hasattr(arg1, "tocsr"):  # scipy.sparse
+        sp = arg1.tocsr()
+        data = sp.data if dtype is None else sp.data.astype(dtype)
+        return CSRNDArray(data, sp.indices, sp.indptr, sp.shape)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else np.asarray(arg1)
+    if dtype is not None:
+        dense = dense.astype(dtype)
+    if dense.ndim != 2:
+        raise MXNetError("csr storage is 2-D only")
+    rows, cols = np.nonzero(dense)
+    indptr = np.zeros(dense.shape[0] + 1, np.int64)
+    np.add.at(indptr, rows + 1, 1)
+    return CSRNDArray(dense[rows, cols], cols, np.cumsum(indptr),
+                      dense.shape)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Create a RowSparseNDArray from (data, indices) or a dense array."""
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        if shape is None:
+            raise MXNetError("shape required for (data, indices)")
+        return RowSparseNDArray(_as_jnp(data, _to_jax_dtype(dtype)), indices,
+                                shape)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else np.asarray(arg1)
+    if dtype is not None:
+        dense = dense.astype(dtype)
+    nz = np.nonzero(dense.reshape(dense.shape[0], -1).any(axis=1))[0]
+    return RowSparseNDArray(dense[nz], nz, dense.shape)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    dt = _to_jax_dtype(dtype) or jnp.float32
+    if isinstance(shape, int):
+        shape = (shape,)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dt), jnp.zeros((0,), jnp.int32),
+                          jnp.zeros(shape[0] + 1, jnp.int32), shape)
+    if stype == "row_sparse":
+        return RowSparseNDArray(jnp.zeros((0,) + tuple(shape[1:]), dt),
+                                jnp.zeros((0,), jnp.int32), shape)
+    if stype == "default":
+        from . import ndarray as _nd
+
+        return _nd.zeros(shape, ctx=ctx, dtype=dtype)
+    raise MXNetError(f"unknown storage type {stype!r}")
+
+
+empty = zeros
+
+
+def array(source, ctx=None, dtype=None):
+    """Sparse-preserving array(): scipy matrices and sparse NDArrays keep
+    their storage type."""
+    if isinstance(source, BaseSparseNDArray):
+        out = source.copy()
+        if dtype is not None:
+            out = out.astype(dtype)
+        return out
+    if hasattr(source, "tocsr"):
+        return csr_matrix(source, dtype=dtype)
+    from .ndarray import array as _dense_array
+
+    return _dense_array(source, ctx=ctx, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# storage conversion + sparse compute
+
+
+def cast_storage(arr, stype):
+    """Ref: src/operator/tensor/cast_storage-inl.h."""
+    if isinstance(arr, BaseSparseNDArray):
+        return arr.tostype(stype)
+    if stype == "default":
+        return arr
+    if stype == "csr":
+        return csr_matrix(arr)
+    if stype == "row_sparse":
+        return row_sparse_array(arr)
+    raise MXNetError(f"unknown storage type {stype!r}")
+
+
+def retain(rsp, row_ids):
+    """Keep only the requested rows (ref: sparse_retain-inl.h)."""
+    if not isinstance(rsp, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
+    ids = np.asarray(row_ids.asnumpy() if isinstance(row_ids, NDArray)
+                     else row_ids).astype(np.int64)
+    have = np.asarray(rsp._indices)
+    keep = np.isin(have, ids)
+    return RowSparseNDArray(rsp._values[jnp.asarray(np.nonzero(keep)[0])],
+                            have[keep], rsp.shape)
+
+
+sparse_retain = retain
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """dot(csr, dense) / dot(csr.T, dense) without densifying lhs
+    (ref: dot-inl.h DotCsrDnsDns / DotCsrTDnsDns).
+
+    The nnz contributions are combined with jax.ops.segment_sum — a
+    sorted-segment reduction XLA lowers to vectorized adds; rhs rows are
+    gathered, so HBM traffic is O(nnz * ncols), not O(n * m)."""
+    if isinstance(lhs, CSRNDArray):
+        if transpose_b:
+            raise MXNetError("transpose_b unsupported for csr dot")
+        rhs_j = _as_jnp(rhs)
+        indptr = np.asarray(lhs._indptr)
+        rows = jnp.asarray(np.repeat(np.arange(lhs.shape[0]),
+                                     np.diff(indptr)))
+        if transpose_a:
+            out = jax.ops.segment_sum(lhs._values[:, None] * rhs_j[rows],
+                                      lhs._indices,
+                                      num_segments=lhs.shape[1])
+        else:
+            out = jax.ops.segment_sum(
+                lhs._values[:, None] * rhs_j[lhs._indices], rows,
+                num_segments=lhs.shape[0])
+        return _wrap(out)
+    if isinstance(lhs, RowSparseNDArray) or isinstance(rhs, BaseSparseNDArray):
+        lhs = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+        rhs = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    from . import ops as _ops
+
+    return _ops.dot(lhs, rhs, transpose_a=transpose_a,
+                    transpose_b=transpose_b)
+
+
+def add(lhs, rhs):
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs,
+                                                        RowSparseNDArray):
+        if lhs.shape != rhs.shape:
+            raise MXNetError("shape mismatch")
+        idx = jnp.concatenate([lhs._indices, rhs._indices])
+        vals = jnp.concatenate([lhs._values, rhs._values])
+        uniq = np.unique(np.asarray(idx))
+        dense_rows = jax.ops.segment_sum(
+            vals, jnp.searchsorted(jnp.asarray(uniq), idx),
+            num_segments=len(uniq))
+        return RowSparseNDArray(dense_rows, uniq, lhs.shape)
+    out = lhs + rhs
+    return out
+
+
+elemwise_add = add

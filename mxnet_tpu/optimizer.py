@@ -41,6 +41,55 @@ def _k_sgd_mom(w, g, mom, lr, *, momentum, rescale, clip, wd):
     return w + new_mom, new_mom
 
 
+def _pad_rows(vals, idx):
+    """Pad (vals, idx) to the next power-of-2 row count so the lazy-update
+    executable cache is keyed by bucket, not by exact nnz (compile once per
+    bucket — the BucketingModule idea applied to the update kernel).
+
+    Padding repeats entry 0, and the row kernels write with .at[].set of a
+    value computed purely from (w[idx], vals) — duplicates compute
+    identical results, so repeats are correctness-neutral."""
+    v = vals._data if isinstance(vals, NDArray) else jnp.asarray(vals)
+    i = idx._data if isinstance(idx, NDArray) else jnp.asarray(idx)
+    n = int(i.shape[0])
+    if n == 0:
+        return vals, idx
+    bucket = 8
+    while bucket < n:
+        bucket *= 2
+    if bucket > n:
+        pad = bucket - n
+        v = jnp.concatenate(
+            [v, jnp.broadcast_to(v[0], (pad,) + v.shape[1:])])
+        i = jnp.concatenate([i, jnp.broadcast_to(i[0], (pad,))])
+    return _wrap(v), _wrap(i)
+
+
+def _k_sgd_rows(w, vals, idx, lr, mom=None, *, momentum, rescale, clip, wd):
+    # lazy row_sparse update: touch only rows present in the gradient
+    # (ref: SGDUpdateRspImpl / SGDMomLazyUpdateRspImpl, optimizer_op.cc)
+    rows = w[idx]
+    g = _prep(vals, rows, rescale=rescale, clip=clip, wd=wd)
+    if mom is None:
+        return w.at[idx].set(rows - lr * g)
+    new_rows = momentum * mom[idx] - lr * g
+    return w.at[idx].set(rows + new_rows), mom.at[idx].set(new_rows)
+
+
+def _k_adam_rows(w, vals, idx, mean, var, lr, t, *, beta1, beta2, epsilon,
+                 rescale, clip, wd):
+    # lazy adam: moments decay only on touched rows
+    # (ref: AdamLazyUpdateRspImpl, optimizer_op.cc)
+    rows = w[idx]
+    g = _prep(vals, rows, rescale=rescale, clip=clip, wd=wd)
+    m = beta1 * mean[idx] + (1 - beta1) * g
+    v = beta2 * var[idx] + (1 - beta2) * jnp.square(g)
+    mhat = m / (1 - beta1 ** t)
+    vhat = v / (1 - beta2 ** t)
+    return (w.at[idx].set(rows - lr * mhat / (jnp.sqrt(vhat) + epsilon)),
+            mean.at[idx].set(m), var.at[idx].set(v))
+
+
 def _k_nag(w, g, mom, lr, *, momentum, rescale, clip, wd):
     gp = _prep(g, w, rescale=rescale, clip=clip, wd=wd)
     new_mom = momentum * mom + gp
@@ -148,6 +197,10 @@ def _k_lamb(w, g, mean, var, lr, t, *, beta1, beta2, epsilon, rescale,
 class Optimizer:
     """Base optimizer (ref: mx.optimizer.Optimizer)."""
 
+    # True only for optimizers with a lazy row_sparse update path;
+    # Trainer densifies sparse grads for everything else
+    supports_sparse = False
+
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
                  multi_precision=False, param_dict=None, begin_num_update=0,
@@ -232,6 +285,11 @@ class Optimizer:
         raise NotImplementedError
 
     def update_multi_precision(self, index, weight, grad, state):
+        from .ndarray.sparse import BaseSparseNDArray
+
+        if (isinstance(grad, BaseSparseNDArray)
+                and not self.supports_sparse):
+            grad = grad.todense()
         if self.multi_precision and weight.dtype == np.float16:
             w32, inner = state
             self.update(index, w32, grad.astype("float32"), inner)
@@ -251,9 +309,12 @@ class Optimizer:
 
 @register("sgd")
 class SGD(Optimizer):
+    supports_sparse = True
+
     def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         if self.momentum != 0.0:
@@ -265,6 +326,23 @@ class SGD(Optimizer):
         self._update_count(index)
         lr = self._scalar(self._get_lr(index), weight)
         kw = self._common(index)
+        from .ndarray.sparse import RowSparseNDArray
+
+        if isinstance(grad, RowSparseNDArray):
+            if not self.lazy_update:
+                grad = grad.todense()
+            else:
+                vals, idx = _pad_rows(grad.data, grad.indices)
+                if self.momentum == 0.0:
+                    new_w = invoke(_k_sgd_rows, weight, vals, idx, lr,
+                                   momentum=0.0, **kw)
+                else:
+                    new_w, new_mom = invoke(
+                        _k_sgd_rows, weight, vals, idx, lr, state,
+                        momentum=self.momentum, **kw)
+                    state._data = new_mom._data
+                weight._data = new_w._data
+                return
         if self.momentum == 0.0:
             new_w = invoke(_k_sgd, weight, grad, lr, **kw)
         else:
@@ -294,10 +372,13 @@ class NAG(Optimizer):
 
 @register("adam")
 class Adam(Optimizer):
+    supports_sparse = True
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         z = lambda: _nd.zeros(weight.shape, dtype=weight.dtype,
@@ -310,6 +391,20 @@ class Adam(Optimizer):
         lr = self._scalar(self._get_lr(index), weight)
         t_arr = self._scalar(float(t), weight)
         mean, var = state
+        from .ndarray.sparse import RowSparseNDArray
+
+        if isinstance(grad, RowSparseNDArray):
+            if not self.lazy_update:
+                grad = grad.todense()
+            else:
+                vals, idx = _pad_rows(grad.data, grad.indices)
+                new_w, m, v = invoke(
+                    _k_adam_rows, weight, vals, idx, mean,
+                    var, lr, t_arr, beta1=self.beta1, beta2=self.beta2,
+                    epsilon=self.epsilon, **self._common(index))
+                mean._data, var._data = m._data, v._data
+                weight._data = new_w._data
+                return
         new_w, m, v = invoke(_k_adam, weight, grad, mean, var, lr, t_arr,
                              beta1=self.beta1, beta2=self.beta2,
                              epsilon=self.epsilon, **self._common(index))
@@ -319,6 +414,8 @@ class Adam(Optimizer):
 
 @register("adamw")
 class AdamW(Adam):
+    supports_sparse = False  # decoupled-wd path has no row kernel
+
     def update(self, index, weight, grad, state):
         self._update_count(index)
         t = self._index_update_count[index]

@@ -1,0 +1,259 @@
+"""Sparse storage types (ref: tests/python/unittest/test_sparse_ndarray.py
++ test_sparse_operator.py — numpy-oracle checks)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse
+
+
+def _rand_sparse(shape, density=0.3, seed=0):
+    rng = np.random.RandomState(seed)
+    dense = rng.rand(*shape) * (rng.rand(*shape) < density)
+    return dense.astype(np.float32)
+
+
+def test_csr_roundtrip():
+    dense = _rand_sparse((6, 5))
+    csr = sparse.csr_matrix(dense)
+    assert csr.stype == "csr"
+    assert csr.shape == (6, 5)
+    np.testing.assert_allclose(csr.asnumpy(), dense)
+    # component accessors
+    assert csr.indptr.shape == (7,)
+    assert csr.data.shape == csr.indices.shape
+    # back to dense via tostype
+    np.testing.assert_allclose(csr.tostype("default").asnumpy(), dense)
+
+
+def test_csr_from_components():
+    data, indices, indptr = [1., 2., 3.], [0, 2, 1], [0, 2, 2, 3]
+    csr = sparse.csr_matrix((data, indices, indptr), shape=(3, 3))
+    expect = np.array([[1, 0, 2], [0, 0, 0], [0, 3, 0]], np.float32)
+    np.testing.assert_allclose(csr.asnumpy(), expect)
+
+
+def test_csr_row_slice():
+    dense = _rand_sparse((8, 4))
+    csr = sparse.csr_matrix(dense)
+    sl = csr[2:5]
+    assert sl.stype == "csr"
+    np.testing.assert_allclose(sl.asnumpy(), dense[2:5])
+    np.testing.assert_allclose(csr[3].asnumpy(), dense[3:4])
+
+
+def test_row_sparse_roundtrip():
+    dense = np.zeros((7, 3), np.float32)
+    dense[1] = 1.0
+    dense[4] = [1, 2, 3]
+    rsp = sparse.row_sparse_array(dense)
+    assert rsp.stype == "row_sparse"
+    np.testing.assert_allclose(np.asarray(rsp.indices.asnumpy()), [1, 4])
+    np.testing.assert_allclose(rsp.asnumpy(), dense)
+
+
+def test_row_sparse_from_components():
+    rsp = sparse.row_sparse_array(([[1., 1.], [2., 2.]], [0, 3]),
+                                  shape=(5, 2))
+    expect = np.zeros((5, 2), np.float32)
+    expect[0], expect[3] = 1, 2
+    np.testing.assert_allclose(rsp.asnumpy(), expect)
+
+
+def test_cast_storage_and_tostype():
+    dense = _rand_sparse((5, 6))
+    x = nd.array(dense)
+    csr = x.tostype("csr")
+    assert isinstance(csr, sparse.CSRNDArray)
+    rsp = x.tostype("row_sparse")
+    assert isinstance(rsp, sparse.RowSparseNDArray)
+    np.testing.assert_allclose(rsp.asnumpy(), dense)
+    np.testing.assert_allclose(
+        nd.cast_storage(x, "csr").asnumpy(), dense)
+    assert x.tostype("default") is x
+    assert x.stype == "default"
+
+
+def test_sparse_zeros():
+    z = sparse.zeros("csr", (3, 4))
+    assert z.asnumpy().sum() == 0 and z.shape == (3, 4)
+    z = sparse.zeros("row_sparse", (3, 4))
+    assert z.asnumpy().sum() == 0
+    assert sparse.zeros("default", (2, 2)).stype == "default"
+
+
+@pytest.mark.parametrize("transpose_a", [False, True])
+def test_csr_dot(transpose_a):
+    lhs = _rand_sparse((6, 5), seed=1)
+    rhs = np.random.RandomState(2).rand(6 if transpose_a else 5, 4) \
+        .astype(np.float32)
+    csr = sparse.csr_matrix(lhs)
+    out = sparse.dot(csr, nd.array(rhs), transpose_a=transpose_a)
+    expect = (lhs.T if transpose_a else lhs) @ rhs
+    np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_retain():
+    dense = np.diag(np.arange(1, 6)).astype(np.float32)
+    rsp = sparse.row_sparse_array(dense)
+    kept = sparse.retain(rsp, nd.array([1, 3], dtype="int32"))
+    expect = np.zeros_like(dense)
+    expect[1, 1], expect[3, 3] = 2, 4
+    np.testing.assert_allclose(kept.asnumpy(), expect)
+
+
+def test_row_sparse_add():
+    a = sparse.row_sparse_array(([[1., 1.]], [1]), shape=(4, 2))
+    b = sparse.row_sparse_array(([[2., 2.], [3., 3.]], [1, 3]), shape=(4, 2))
+    out = sparse.add(a, b)
+    assert out.stype == "row_sparse"
+    np.testing.assert_allclose(out.asnumpy(), a.asnumpy() + b.asnumpy())
+
+
+def test_sparse_dense_fallback_arith():
+    dense = _rand_sparse((4, 4))
+    csr = sparse.csr_matrix(dense)
+    out = csr + nd.ones((4, 4))
+    np.testing.assert_allclose(out.asnumpy(), dense + 1)
+
+
+def test_sgd_lazy_row_sparse_update():
+    opt = mx.optimizer.SGD(learning_rate=0.5, momentum=0.9)
+    w = nd.ones((6, 3))
+    state = opt.create_state(0, w)
+    grad = sparse.row_sparse_array(([[1., 1., 1.]], [2]), shape=(6, 3))
+    w_before = w.asnumpy()
+    opt.update(0, w, grad, state)
+    w_after = w.asnumpy()
+    # only row 2 moved
+    np.testing.assert_allclose(np.delete(w_after, 2, 0),
+                               np.delete(w_before, 2, 0))
+    np.testing.assert_allclose(w_after[2], w_before[2] - 0.5)
+
+
+def test_adam_lazy_vs_dense_touched_rows():
+    # on rows present in the gradient, lazy update == dense update when
+    # the gradient has only those rows and the moments start at zero
+    g_dense = np.zeros((5, 2), np.float32)
+    g_dense[1] = 0.3
+    opt1 = mx.optimizer.Adam(learning_rate=0.1)
+    opt2 = mx.optimizer.Adam(learning_rate=0.1)
+    w1, w2 = nd.ones((5, 2)), nd.ones((5, 2))
+    s1, s2 = opt1.create_state(0, w1), opt2.create_state(0, w2)
+    opt1.update(0, w1, nd.array(g_dense), s1)
+    opt2.update(0, w2, sparse.row_sparse_array(g_dense), s2)
+    np.testing.assert_allclose(w1.asnumpy()[1], w2.asnumpy()[1], rtol=1e-6)
+
+
+def test_kvstore_sparse_push_row_sparse_pull():
+    kv = mx.kv.create("local")
+    kv.init("w", nd.zeros((6, 2)))
+    g1 = sparse.row_sparse_array(([[1., 1.]], [0]), shape=(6, 2))
+    g2 = sparse.row_sparse_array(([[2., 2.]], [4]), shape=(6, 2))
+    kv.push("w", [g1, g2])
+    out = nd.zeros((6, 2))
+    kv.pull("w", out=out)
+    expect = np.zeros((6, 2), np.float32)
+    expect[0], expect[4] = 1, 2
+    np.testing.assert_allclose(out.asnumpy(), expect)
+    # row-filtered pull into a row_sparse out
+    rs_out = sparse.zeros("row_sparse", (6, 2))
+    kv.row_sparse_pull("w", out=rs_out, row_ids=nd.array([4], dtype="int32"))
+    np.testing.assert_allclose(np.asarray(rs_out.indices.asnumpy()), [4])
+    np.testing.assert_allclose(rs_out.asnumpy()[4], [2, 2])
+
+
+def test_embedding_sparse_grad_end_to_end():
+    from mxnet_tpu import gluon, autograd
+
+    net = gluon.nn.Embedding(10, 4, sparse_grad=True)
+    net.initialize(mx.init.Uniform(0.1))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 1.0})
+    x = nd.array([1, 3], dtype="int32")
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    w_before = net.weight.data().asnumpy()
+    trainer.step(1)
+    w_after = net.weight.data().asnumpy()
+    # rows 1 and 3 moved by -lr * 1; every other row untouched
+    np.testing.assert_allclose(np.delete(w_after, [1, 3], 0),
+                               np.delete(w_before, [1, 3], 0))
+    np.testing.assert_allclose(w_after[[1, 3]], w_before[[1, 3]] - 1.0,
+                               rtol=1e-6)
+
+
+def test_sparse_save_load(tmp_path):
+    dense = _rand_sparse((4, 3))
+    f = str(tmp_path / "x.params")
+    nd.save(f, {"w": nd.array(dense)})
+    loaded = nd.load(f)
+    np.testing.assert_allclose(loaded["w"].asnumpy(), dense)
+
+
+def test_kvstore_sparse_init_then_pull():
+    # regression: init with a row_sparse value must store a dense
+    # canonical copy so pull/row_sparse_pull work
+    kv = mx.kv.create("local")
+    kv.init("s", sparse.row_sparse_array(([[1., 1.]], [0]), shape=(4, 2)))
+    out = nd.zeros((4, 2))
+    kv.pull("s", out=out)
+    np.testing.assert_allclose(out.asnumpy()[0], [1, 1])
+    rs = sparse.zeros("row_sparse", (4, 2))
+    kv.row_sparse_pull("s", out=rs, row_ids=nd.array([0], dtype="int32"))
+    np.testing.assert_allclose(rs.asnumpy()[0], [1, 1])
+
+
+def test_sparse_grad_with_non_sparse_optimizer():
+    # regression: optimizers without a lazy row kernel (rmsprop) get the
+    # dense grad instead of crashing inside jit
+    from mxnet_tpu import gluon, autograd
+
+    net = gluon.nn.Embedding(10, 4, sparse_grad=True)
+    net.initialize(mx.init.Uniform(0.1))
+    tr = gluon.Trainer(net.collect_params(), "rmsprop",
+                       {"learning_rate": 0.1})
+    with autograd.record():
+        loss = net(nd.array([1, 3], dtype="int32")).sum()
+    loss.backward()
+    tr.step(1)  # must not raise
+
+
+def test_pad_rows_bucketing():
+    # lazy updates compile per power-of-2 bucket, not per exact nnz
+    from mxnet_tpu.optimizer import _pad_rows
+
+    vals = nd.array(np.ones((5, 3), np.float32))
+    idx = nd.array([0, 1, 2, 3, 4], dtype="int32")
+    v, i = _pad_rows(vals, idx)
+    assert v.shape[0] == 8 and i.shape[0] == 8
+    # padding repeats entry 0 → identical computed update, set() safe
+    np.testing.assert_allclose(i.asnumpy()[5:], [0, 0, 0])
+    # result correctness with padding: sgd on 5 rows of a 9-row weight
+    opt = mx.optimizer.SGD(learning_rate=1.0)
+    w = nd.ones((9, 3))
+    g = sparse.row_sparse_array((np.ones((5, 3), np.float32),
+                                 [0, 1, 2, 3, 4]), shape=(9, 3))
+    opt.update(0, w, g, None)
+    expect = np.ones((9, 3), np.float32)
+    expect[:5] -= 1.0
+    np.testing.assert_allclose(w.asnumpy(), expect)
+
+
+def test_entropy_calibration_incremental_hist():
+    # regression: entropy stats keep O(num_bins) memory and match the
+    # one-shot threshold on the same data
+    from mxnet_tpu.contrib.quantization import _Stats, _get_optimal_threshold
+
+    rng = np.random.RandomState(0)
+    batches = [rng.randn(1000).astype(np.float32) for _ in range(4)]
+    st = _Stats("entropy")
+    for b in batches:
+        st.update(b)
+    assert st.hist is not None and st.hist.shape == (st.NUM_BINS,)
+    lo, hi = st.range()
+    t_oneshot = _get_optimal_threshold(np.concatenate(batches))
+    assert abs(hi - t_oneshot) / t_oneshot < 0.05
+    assert lo == -hi
