@@ -38,6 +38,9 @@ _LAZY = {
     "monitor": ".monitor",
     "mon": ".monitor",
     "contrib": ".contrib",
+    "operator": ".operator",
+    "viz": ".visualization",
+    "visualization": ".visualization",
 }
 
 

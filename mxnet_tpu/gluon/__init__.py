@@ -10,7 +10,7 @@ from . import utils  # noqa: F401
 
 def __getattr__(name):
     # rnn / data / model_zoo are heavier; load lazily
-    if name in ("rnn", "data", "model_zoo"):
+    if name in ("rnn", "data", "model_zoo", "contrib"):
         import importlib
 
         mod = importlib.import_module("." + name, __name__)

@@ -1,0 +1,47 @@
+"""Contrib neural-network blocks (ref: python/mxnet/gluon/contrib/nn/
+basic_layers.py)."""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ..nn import Embedding
+
+
+class Concurrent(HybridBlock):
+    """Run children on the same input, concat outputs
+    (ref: contrib.nn.Concurrent)."""
+
+    def __init__(self, axis=-1, **kwargs):
+        super().__init__(**kwargs)
+        self.axis = axis
+        self._layers = []
+
+    def add(self, *blocks):
+        for b in blocks:
+            setattr(self, f"c{len(self._layers)}", b)
+            self._layers.append(b)
+
+    def hybrid_forward(self, F, x):
+        return F.concat(*[b(x) for b in self._layers], dim=self.axis)
+
+
+class HybridConcurrent(Concurrent):
+    """Hybridizable Concurrent (ref: contrib.nn.HybridConcurrent)."""
+
+
+class Identity(HybridBlock):
+    """Pass-through block, useful in Concurrent branches
+    (ref: contrib.nn.Identity)."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Embedding):
+    """Embedding with row_sparse gradient (ref: contrib.nn.SparseEmbedding
+    — here simply Embedding(sparse_grad=True), the lazy row-update path)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(input_dim, output_dim, dtype=dtype,
+                         weight_initializer=weight_initializer,
+                         sparse_grad=True, **kwargs)

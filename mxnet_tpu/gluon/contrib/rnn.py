@@ -1,0 +1,65 @@
+"""Contrib RNN cells (ref: python/mxnet/gluon/contrib/rnn/).
+
+VariationalDropoutCell applies the SAME dropout mask at every time step
+(Gal & Ghahramani) — implemented by sampling the mask once per unroll.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..rnn.rnn_cell import ModifierCell
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Ref: contrib.rnn.VariationalDropoutCell."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self._mask_in = None
+        self._mask_states = None
+        self._mask_out = None
+
+    def reset(self):
+        super().reset()
+        self._mask_in = None
+        self._mask_states = None
+        self._mask_out = None
+
+    def _mask(self, F, cached, p, like):
+        import mxnet_tpu.ndarray as nd
+
+        if p == 0.0:
+            return None, cached
+        if cached is None:
+            keep = 1.0 - p
+            cached = nd.random.uniform(shape=like.shape) < keep
+            cached = cached.astype(like.dtype) / keep
+        return cached, cached
+
+    def __call__(self, inputs, states):
+        from ... import autograd
+
+        F = None
+        if autograd.is_training():
+            m, self._mask_in = self._mask(F, self._mask_in,
+                                          self.drop_inputs, inputs)
+            if m is not None:
+                inputs = inputs * m
+            if self.drop_states:
+                new_states = []
+                ms, self._mask_states = self._mask(
+                    F, self._mask_states, self.drop_states, states[0])
+                new_states.append(states[0] * ms if ms is not None
+                                  else states[0])
+                new_states.extend(states[1:])
+                states = new_states
+        out, states = self.base_cell(inputs, states)
+        if autograd.is_training() and self.drop_outputs:
+            mo, self._mask_out = self._mask(F, self._mask_out,
+                                            self.drop_outputs, out)
+            if mo is not None:
+                out = out * mo
+        return out, states

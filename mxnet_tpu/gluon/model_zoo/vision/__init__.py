@@ -9,6 +9,7 @@ from .others import (alexnet, lenet, AlexNet, LeNet, VGG, get_vgg, vgg11,  # noq
                      mobilenet_v2_1_0, SqueezeNet, squeezenet1_0,
                      squeezenet1_1, DenseNet, densenet121, densenet169,
                      densenet201)
+from .inception import Inception3, inception_v3  # noqa: F401
 
 _models = {k: v for k, v in globals().items() if callable(v)
            and not k.startswith("_") and k not in

@@ -1,0 +1,127 @@
+"""Inception V3 (ref: python/mxnet/gluon/model_zoo/vision/inception.py).
+
+Same block decomposition as the reference (_make_A/B/C/D/E branches as
+HybridConcurrent-style concat blocks); convs lower to XLA `conv_general
+_dilated` on the MXU, the branch concat fuses in HLO."""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ... import nn
+
+
+def _conv(channels, kernel_size, strides=1, padding=0):
+    out = nn.HybridSequential()
+    out.add(nn.Conv2D(channels, kernel_size, strides, padding,
+                      use_bias=False),
+            nn.BatchNorm(epsilon=0.001),
+            nn.Activation("relu"))
+    return out
+
+
+class _Branches(HybridBlock):
+    """Run child branches on the same input, concat on channel axis
+    (ref: HybridConcurrent(axis=1))."""
+
+    def __init__(self, branches, **kwargs):
+        super().__init__(**kwargs)
+        self.branches = []
+        for i, b in enumerate(branches):
+            setattr(self, f"branch{i}", b)
+            self.branches.append(b)
+
+    def hybrid_forward(self, F, x):
+        return F.concat(*[b(x) for b in self.branches], dim=1)
+
+
+def _seq(*layers):
+    s = nn.HybridSequential()
+    s.add(*layers)
+    return s
+
+
+def _make_A(pool_features):
+    return _Branches([
+        _conv(64, 1),
+        _seq(_conv(48, 1), _conv(64, 5, padding=2)),
+        _seq(_conv(64, 1), _conv(96, 3, padding=1),
+             _conv(96, 3, padding=1)),
+        _seq(nn.AvgPool2D(3, 1, 1), _conv(pool_features, 1)),
+    ])
+
+
+def _make_B():
+    return _Branches([
+        _conv(384, 3, strides=2),
+        _seq(_conv(64, 1), _conv(96, 3, padding=1),
+             _conv(96, 3, strides=2)),
+        _seq(nn.MaxPool2D(3, 2)),
+    ])
+
+
+def _make_C(channels_7x7):
+    c = channels_7x7
+    return _Branches([
+        _conv(192, 1),
+        _seq(_conv(c, 1), _conv(c, (1, 7), padding=(0, 3)),
+             _conv(192, (7, 1), padding=(3, 0))),
+        _seq(_conv(c, 1), _conv(c, (7, 1), padding=(3, 0)),
+             _conv(c, (1, 7), padding=(0, 3)),
+             _conv(c, (7, 1), padding=(3, 0)),
+             _conv(192, (1, 7), padding=(0, 3))),
+        _seq(nn.AvgPool2D(3, 1, 1), _conv(192, 1)),
+    ])
+
+
+def _make_D():
+    return _Branches([
+        _seq(_conv(192, 1), _conv(320, 3, strides=2)),
+        _seq(_conv(192, 1), _conv(192, (1, 7), padding=(0, 3)),
+             _conv(192, (7, 1), padding=(3, 0)),
+             _conv(192, 3, strides=2)),
+        _seq(nn.MaxPool2D(3, 2)),
+    ])
+
+
+def _make_E():
+    return _Branches([
+        _conv(320, 1),
+        _seq(_conv(384, 1),
+             _Branches([_conv(384, (1, 3), padding=(0, 1)),
+                        _conv(384, (3, 1), padding=(1, 0))])),
+        _seq(_conv(448, 1), _conv(384, 3, padding=1),
+             _Branches([_conv(384, (1, 3), padding=(0, 1)),
+                        _conv(384, (3, 1), padding=(1, 0))])),
+        _seq(nn.AvgPool2D(3, 1, 1), _conv(192, 1)),
+    ])
+
+
+class Inception3(HybridBlock):
+    """Inception V3, 299x299 input (ref: Inception3)."""
+
+    def __init__(self, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        self.features = _seq(
+            _conv(32, 3, strides=2),
+            _conv(32, 3),
+            _conv(64, 3, padding=1),
+            nn.MaxPool2D(3, 2),
+            _conv(80, 1),
+            _conv(192, 3),
+            nn.MaxPool2D(3, 2),
+            _make_A(32), _make_A(64), _make_A(64),
+            _make_B(),
+            _make_C(128), _make_C(160), _make_C(160), _make_C(192),
+            _make_D(),
+            _make_E(), _make_E(),
+            nn.AvgPool2D(8),
+            nn.Dropout(0.5),
+        )
+        self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        x = self.features(x)
+        return self.output(F.flatten(x))
+
+
+def inception_v3(**kwargs):
+    return Inception3(**kwargs)

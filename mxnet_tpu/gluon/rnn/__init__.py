@@ -1,4 +1,5 @@
 """gluon.rnn (ref: python/mxnet/gluon/rnn/)."""
 from .rnn_layer import RNN, LSTM, GRU  # noqa: F401
 from .rnn_cell import (RecurrentCell, RNNCell, LSTMCell, GRUCell,  # noqa: F401
-                       SequentialRNNCell, DropoutCell, ResidualCell)
+                       SequentialRNNCell, DropoutCell, ResidualCell,
+                       ModifierCell, ZoneoutCell)

@@ -226,14 +226,69 @@ class DropoutCell(RecurrentCell):
         return F.Dropout(x, p=self._rate), states or []
 
 
-class ResidualCell(RecurrentCell):
+class ModifierCell(RecurrentCell):
+    """Base for cells wrapping another cell (ref: rnn_cell.ModifierCell)."""
+
     def __init__(self, base_cell, **kwargs):
         super().__init__(**kwargs)
+        base_cell._modified = True
         self.base_cell = base_cell
 
     def state_info(self, batch_size=0):
         return self.base_cell.state_info(batch_size)
 
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(batch_size, func=func, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+    def reset(self):
+        pass
+
+
+class ResidualCell(ModifierCell):
     def __call__(self, x, states=None, **kwargs):
         out, states = self.base_cell(x, states)
         return out + x, states
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout regularization: randomly keep previous states
+    (ref: rnn_cell.ZoneoutCell)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
+                 **kwargs):
+        super().__init__(base_cell, **kwargs)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def reset(self):
+        self._prev_output = None
+
+    def __call__(self, x, states=None, **kwargs):
+        from ... import autograd
+        from ... import ndarray as F
+
+        out, next_states = self.base_cell(x, states)
+        if not autograd.is_training():
+            return out, next_states
+
+        def zone(p, new, old):
+            if p == 0.0 or old is None:
+                return new
+            mask = F.random.uniform(shape=new.shape) < p
+            return F.where(mask.astype(new.dtype) > 0, old, new)
+
+        prev = self._prev_output
+        if prev is None:
+            from ...ndarray import ndarray as _nd
+
+            prev = _nd.zeros(out.shape)
+        out = zone(self.zoneout_outputs, out, prev)
+        self._prev_output = out
+        if states is not None:
+            next_states = [zone(self.zoneout_states, n, o)
+                           for n, o in zip(next_states, states)]
+        return out, next_states

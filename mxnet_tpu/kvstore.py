@@ -34,6 +34,7 @@ class KVStore:
         self._store = {}          # key -> canonical NDArray (merged value)
         self._updater = None
         self._optimizer = None
+        self._compression = None  # GradientCompression when enabled
 
     @property
     def type(self):
@@ -75,6 +76,9 @@ class KVStore:
         for k, vlist in zip(keys, values):
             if k not in self._store:
                 raise MXNetError(f"key {k} has not been initialized")
+            if self._compression is not None:
+                vlist = [self._compression.compress(k, slot, v)
+                         for slot, v in enumerate(vlist)]
             reduced = _reduce_sum(vlist, self._store[k].context)
             if self._is_dist():
                 reduced = self._dist_allreduce(k, reduced)
@@ -85,12 +89,20 @@ class KVStore:
                 self._store[k]._data = reduced._data
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        from .ndarray.sparse import BaseSparseNDArray
+
         keys, outs = _normalize(key, out)
         for k, olist in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError(f"key {k} has not been initialized")
             src = self._store[k]
             for o in olist:
+                if isinstance(o, BaseSparseNDArray):
+                    # ref: KVStoreLocal::PullImpl only serves dense outs;
+                    # sparse outs must go through row_sparse_pull
+                    raise MXNetError(
+                        "pull with a sparse out is not supported; use "
+                        "row_sparse_pull(key, out, row_ids=...)")
                 o._data = src.as_in_context(o.context)._data
 
     def pushpull(self, key, value, out=None, priority=0):
@@ -103,8 +115,8 @@ class KVStore:
         `out` row_sparse → filled with the selected rows; dense out gets
         the full value (rows outside row_ids zeroed)."""
         if row_ids is None:
-            self.pull(key, out=out, priority=priority)
-            return
+            # ref: kvstore.py asserts row_ids is not None
+            raise MXNetError("row_sparse_pull requires row_ids")
         import numpy as np
         import jax.numpy as jnp
 
@@ -120,6 +132,10 @@ class KVStore:
                 ids = np.unique(np.asarray(
                     rid.asnumpy() if isinstance(rid, NDArray) else rid
                 ).astype(np.int64))
+                if ids.size and (ids[0] < 0 or ids[-1] >= src.shape[0]):
+                    raise MXNetError(
+                        f"row_ids out of range for key {k}: "
+                        f"[{ids[0]}, {ids[-1]}] vs {src.shape[0]} rows")
                 rows = src._data[jnp.asarray(ids)]
                 if isinstance(o, RowSparseNDArray):
                     o._values, o._indices = rows, jnp.asarray(ids)
@@ -142,9 +158,23 @@ class KVStore:
         self._updater = _opt.get_updater(optimizer)
 
     def set_gradient_compression(self, compression_params):
-        # 2-bit compression is a bandwidth optimization for PCIe/ethernet;
-        # ICI all-reduce needs none. Accepted for API parity.
-        self._compression = compression_params
+        """Enable 2-bit gradient compression with error feedback
+        (ref: src/kvstore/gradient_compression.cc Quantize2BitImpl).
+
+        On TPU the ICI all-reduce needs no compression — this matters for
+        the DCN (cross-slice) path, and is kept semantically faithful:
+        each pushed gradient is quantized to {-t, 0, +t} with the
+        quantization error accumulated into a per-(key, slot) residual
+        added to the next push."""
+        params = dict(compression_params or {})
+        ctype = params.get("type", "2bit")
+        if ctype == "none":
+            self._compression = None
+            return
+        if ctype != "2bit":
+            raise MXNetError(f"unsupported compression type {ctype!r}")
+        self._compression = GradientCompression(
+            threshold=float(params.get("threshold", 0.5)))
 
     # -- dist ---------------------------------------------------------------
 
@@ -229,3 +259,40 @@ def create(name="local"):
     if name not in _VALID:
         raise MXNetError(f"unknown kvstore type {name!r}; valid: {_VALID}")
     return KVStore(name)
+
+
+# ---------------------------------------------------------------------------
+# 2-bit gradient compression (ref: src/kvstore/gradient_compression.{cc,h})
+
+
+class GradientCompression:
+    """Threshold quantization to {-t, 0, +t} with error-feedback residual
+    (ref: GradientCompression::Quantize2BitImpl + dequantize — here the
+    quantize/dequantize pair is fused since the wire format on TPU is the
+    already-dequantized ternary tensor; what matters semantically is the
+    information loss + residual accumulation, which match the reference
+    exactly)."""
+
+    def __init__(self, threshold=0.5):
+        if threshold <= 0:
+            raise MXNetError("compression threshold must be positive")
+        self.threshold = threshold
+        self._residuals = {}  # (key, slot) -> raw residual array
+
+    def get_params(self):
+        return {"type": "2bit", "threshold": self.threshold}
+
+    def compress(self, key, slot, grad):
+        import jax.numpy as jnp
+
+        from .ndarray.sparse import BaseSparseNDArray
+
+        if isinstance(grad, BaseSparseNDArray):
+            grad = grad.todense()
+        t = jnp.asarray(self.threshold, grad._data.dtype)
+        resid = self._residuals.get((key, slot))
+        g = grad._data if resid is None else grad._data + resid
+        q = jnp.where(g >= t, t, jnp.where(g <= -t, -t,
+                                           jnp.zeros_like(g)))
+        self._residuals[(key, slot)] = g - q
+        return _wrap(q)

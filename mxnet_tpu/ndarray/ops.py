@@ -17,6 +17,7 @@ from .._imperative import invoke
 from ..ops import nn as _nn_ops  # noqa: F401  (registration side effect)
 from ..ops import registry as _registry
 from ..ops import rnn as _rnn_ops  # noqa: F401
+from .. import operator as _custom_op_mod  # noqa: F401  (registers Custom)
 from ..ops import tensor as _tensor_ops  # noqa: F401
 from .ndarray import NDArray, array
 

@@ -164,6 +164,11 @@ class CSRNDArray(BaseSparseNDArray):
     def __getitem__(self, key):
         # row-slice, returns csr (ref: CSRNDArray.__getitem__)
         if isinstance(key, int):
+            if key < 0:
+                key += self.shape[0]
+            if not 0 <= key < self.shape[0]:
+                raise IndexError(
+                    f"row {key} out of range for {self.shape[0]} rows")
             key = slice(key, key + 1)
         if not isinstance(key, slice) or key.step not in (None, 1):
             raise MXNetError("csr supports contiguous row slicing only")
@@ -239,7 +244,17 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
             raise MXNetError("shape required for (data, indices)")
         return RowSparseNDArray(_as_jnp(data, _to_jax_dtype(dtype)), indices,
                                 shape)
-    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else np.asarray(arg1)
+    if isinstance(arg1, NDArray):
+        # device path: only the (nrows,)-bool row mask crosses to host to
+        # resolve the data-dependent index count; row values are gathered
+        # on device (vs syncing the full dense tensor — matters when this
+        # runs per-step for sparse_grad embeddings)
+        d = arg1._data if dtype is None else arg1._data.astype(
+            _to_jax_dtype(dtype))
+        mask = (d.reshape(d.shape[0], -1) != 0).any(axis=1)
+        nz = np.nonzero(np.asarray(mask))[0]
+        return RowSparseNDArray(d[jnp.asarray(nz)], nz, d.shape)
+    dense = np.asarray(arg1)
     if dtype is not None:
         dense = dense.astype(dtype)
     nz = np.nonzero(dense.reshape(dense.shape[0], -1).any(axis=1))[0]

@@ -1,0 +1,192 @@
+"""Custom operator escape hatch — mx.operator.
+
+Ref: src/operator/custom/custom.cc (+ custom-inl.h) and
+python/mxnet/operator.py: users subclass ``CustomOp`` (the kernel) and
+``CustomOpProp`` (shape/type inference + operator factory), register the
+prop with ``@mx.operator.register("name")``, and call the op as
+``mx.nd.Custom(..., op_type="name")`` / ``mx.sym.Custom(...)``.
+
+TPU-native design: the reference runs custom python code on a dedicated
+engine worker thread; here the host-python kernel is spliced into the
+XLA program with ``jax.pure_callback`` (forward) wrapped in
+``jax.custom_vjp`` whose backward is a second pure_callback into
+``CustomOp.backward``.  Eagerly the same function runs un-jitted, so
+NDArray-level custom ops pay no callback overhead; under ``hybridize()``
+or ``sym.bind`` the callback rides inside the compiled step — the
+compiled-substrate equivalent of the reference's engine-thread dispatch.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError
+
+_custom_registry = {}
+
+
+def register(op_type):
+    """Decorator registering a CustomOpProp subclass under ``op_type``
+    (ref: mx.operator.register)."""
+
+    def _do(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register expects a CustomOpProp subclass")
+        _custom_registry[op_type] = prop_cls
+        return prop_cls
+
+    return _do
+
+
+def get_all_registered_operators():
+    return list(_custom_registry)
+
+
+class CustomOp:
+    """Base class for the custom kernel (ref: mx.operator.CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write ``src`` into ``dst`` honoring the grad request."""
+        from .ndarray.ndarray import NDArray
+
+        if req == "null":
+            return
+        if not isinstance(src, NDArray):
+            from .ndarray.ndarray import array
+
+            src = array(np.asarray(src))
+        if req in ("write", "inplace"):
+            dst._data = src._data
+        elif req == "add":
+            dst._data = (dst + src)._data
+        else:
+            raise MXNetError(f"unknown req {req!r}")
+
+
+class CustomOpProp:
+    """Shape/type inference + factory (ref: mx.operator.CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+
+def _wrap_np(arrs):
+    from .ndarray.ndarray import _wrap
+
+    return [_wrap(jnp.asarray(a)) for a in arrs]
+
+
+def _k_custom(*arrays, op_type, _train=False, **kwargs):
+    """The op-registry kernel behind nd.Custom / sym.Custom.
+
+    Pure function of the input arrays; host python runs via
+    pure_callback so it is legal under jit/pjit tracing."""
+    prop_cls = _custom_registry.get(op_type)
+    if prop_cls is None:
+        raise MXNetError(f"custom op {op_type!r} is not registered")
+    prop = prop_cls(**{k: str(v) for k, v in kwargs.items()})
+
+    in_shapes = [tuple(a.shape) for a in arrays]
+    in_dtypes = [np.dtype(a.dtype) for a in arrays]
+    _, out_shapes, _ = prop.infer_shape([list(s) for s in in_shapes])
+    _, out_dtypes, _ = prop.infer_type(list(in_dtypes))
+    out_spec = [jax.ShapeDtypeStruct(tuple(s), np.dtype(d))
+                for s, d in zip(out_shapes, out_dtypes)]
+    n_out = len(out_spec)
+    n_in = len(arrays)
+
+    # one operator instance per call site; fwd/bwd callbacks share it so
+    # state saved on self in forward is visible in backward (matching the
+    # reference's per-node operator instance)
+    holder = {}
+
+    def _op():
+        if "op" not in holder:
+            holder["op"] = prop.create_operator(None, in_shapes, in_dtypes)
+        return holder["op"]
+
+    def _fwd_callback(*np_ins):
+        from .ndarray.ndarray import _wrap
+
+        ins = _wrap_np(np_ins)
+        outs = [_wrap(jnp.zeros(s.shape, s.dtype)) for s in out_spec]
+        _op().forward(is_train=bool(_train), req=["write"] * n_out,
+                      in_data=ins, out_data=outs, aux=[])
+        return tuple(np.asarray(o._data) for o in outs)
+
+    def _run_fwd(xs):
+        return tuple(jax.pure_callback(_fwd_callback, tuple(out_spec), *xs))
+
+    @jax.custom_vjp
+    def run(*xs):
+        return _run_fwd(xs)
+
+    def run_fwd(*xs):
+        outs = _run_fwd(xs)
+        return outs, (xs, outs)
+
+    def run_bwd(resid, cts):
+        xs, outs = resid
+        in_spec = tuple(jax.ShapeDtypeStruct(s, d)
+                        for s, d in zip(in_shapes, in_dtypes))
+
+        def _bwd_callback(*flat):
+            from .ndarray.ndarray import _wrap
+
+            ins = _wrap_np(flat[:n_in])
+            fouts = _wrap_np(flat[n_in:n_in + n_out])
+            gouts = _wrap_np(flat[n_in + n_out:])
+            gins = [_wrap(jnp.zeros(s, d))
+                    for s, d in zip(in_shapes, in_dtypes)]
+            _op().backward(req=["write"] * n_in, out_grad=gouts,
+                           in_data=ins, out_data=fouts, in_grad=gins,
+                           aux=[])
+            return tuple(np.asarray(g._data) for g in gins)
+
+        return tuple(jax.pure_callback(_bwd_callback, in_spec,
+                                       *xs, *outs, *cts))
+
+    run.defvjp(run_fwd, run_bwd)
+    out = run(*arrays)
+    return out if n_out > 1 else out[0]
+
+
+# register into the shared op registry so nd.Custom / sym.Custom exist
+from .ops import registry as _registry  # noqa: E402
+
+_registry.register("Custom", _k_custom, arg_names=("data",), variadic=True,
+                   train_aware=True, jit_compile=False)

@@ -25,6 +25,7 @@ from ..context import current_context
 from ..ndarray import ndarray as _nd
 from ..ndarray.ndarray import NDArray, _wrap
 from ..ops import registry as _registry
+from .. import operator as _custom_op_mod  # noqa: F401  (registers Custom)
 
 # aux input slots per op (variables feeding these are auxiliary states,
 # ref: FListAuxiliaryStates)
@@ -216,6 +217,13 @@ class Symbol:
     def bind(self, ctx, args, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
         return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+
+    def get_backend_symbol(self, backend="TPU"):
+        """Apply the backend's registered subgraph fusions
+        (ref: Symbol.get_backend_symbol → BuildSubgraph pass)."""
+        from ..subgraph import build_subgraph
+
+        return build_subgraph(self, backend)
 
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
                     **kwargs):
@@ -480,6 +488,13 @@ def _fill_param_shapes(n, in_shapes, solved):
             setn(i, (c,))
     elif n.op == "Embedding":
         setn(1, (a["input_dim"], a["output_dim"]))
+    elif n.op == "SoftmaxOutput":
+        # label = class indices, data shape minus the class axis
+        # (ref: SoftmaxOutputProp::InferShape label backward-fill)
+        setn(1, (x[0],) + tuple(x[2:]))
+    elif n.op in ("LinearRegressionOutput", "LogisticRegressionOutput",
+                  "MAERegressionOutput"):
+        setn(1, tuple(x))
     elif n.op == "RNN":
         from ..ops.rnn import rnn_param_size
 
@@ -773,6 +788,12 @@ _NN_PARAM_SUFFIX = {
     "Embedding": ["weight"],
     "RNN": ["parameters", "state", "state_cell"],
     "LeakyReLU": ["gamma"],
+    # loss/output heads auto-create their label variable
+    # (ref: SoftmaxOutput makes `<name>_label` implicitly)
+    "SoftmaxOutput": ["label"],
+    "LinearRegressionOutput": ["label"],
+    "LogisticRegressionOutput": ["label"],
+    "MAERegressionOutput": ["label"],
 }
 
 

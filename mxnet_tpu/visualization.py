@@ -1,0 +1,138 @@
+"""Network visualization — mx.viz (ref: python/mxnet/visualization.py).
+
+``print_summary`` renders the layer table (name, shape, params) to
+stdout; ``plot_network`` returns a graphviz Digraph when the graphviz
+package is importable, else raises with a clear message (the package is
+not a framework dependency, matching the reference's soft requirement).
+"""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+
+
+def _node_shape_map(symbol, shape=None):
+    """Infer per-node output shapes when input shapes are given."""
+    if shape is None:
+        return {}
+    try:
+        from .symbol.symbol import Group
+
+        internals = symbol.get_internals()
+        grouped = Group(list(internals))
+        _, out_shapes, _ = grouped.infer_shape(**shape)
+        return dict(zip([s.name for s in internals], out_shapes))
+    except Exception:
+        return {}
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    """Print a Keras-style layer summary (ref: mx.viz.print_summary)."""
+    graph = json.loads(symbol.tojson())
+    nodes = graph["nodes"]
+    heads = {h[0] for h in graph["heads"]}
+    positions = positions or [0.44, 0.64, 0.74, 1.0]
+    positions = [int(line_length * p) for p in positions]
+    shape_map = _node_shape_map(symbol, shape)
+
+    def prow(fields):
+        line = ""
+        for f, pos in zip(fields, positions):
+            line = (line + str(f))[:pos - 1].ljust(pos)
+        print(line.rstrip())
+
+    print("_" * line_length)
+    prow(["Layer (type)", "Output Shape", "Param #", "Previous Layer"])
+    print("=" * line_length)
+    total = 0
+
+    # parameter counts: variables feeding an op node count toward it
+    arg_shapes = {}
+    if shape is not None:
+        try:
+            arg_s, _, _ = symbol.infer_shape_partial(**shape)
+            arg_shapes = dict(zip(symbol.list_arguments(), arg_s))
+        except Exception:
+            pass
+
+    import numpy as np
+
+    for i, node in enumerate(nodes):
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            continue
+        inputs = [nodes[j[0]]["name"] for j in node.get("inputs", [])]
+        prev_layers = [n for n in inputs
+                       if not any(n.endswith(s) for s in
+                                  ("_weight", "_bias", "_gamma", "_beta",
+                                   "_moving_mean", "_moving_var"))]
+        params = 0
+        for n in inputs:
+            if (n in arg_shapes and n not in shape
+                    and not n.endswith("_label")):
+                s = arg_shapes[n]
+                if s:
+                    params += int(np.prod(s))
+        total += params
+        out_shape = shape_map.get(name, "")
+        prow([f"{name} ({op})", out_shape, params,
+              ", ".join(prev_layers)])
+        print(("=" if i == len(nodes) - 1 else "_") * line_length)
+    print(f"Total params: {total}")
+    print("_" * line_length)
+    return total
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Build a graphviz Digraph of the network (ref: mx.viz.plot_network).
+
+    Requires the optional ``graphviz`` package, like the reference."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise MXNetError(
+            "plot_network requires the graphviz python package") from e
+
+    graph = json.loads(symbol.tojson())
+    nodes = graph["nodes"]
+    dot = Digraph(name=title, format=save_format)
+    dot.attr("node", shape="box", style="rounded,filled",
+             **(node_attrs or {}))
+
+    def is_weight(n):
+        return hide_weights and any(
+            n["name"].endswith(s) for s in
+            ("_weight", "_bias", "_gamma", "_beta", "_moving_mean",
+             "_moving_var"))
+
+    palette = {"Convolution": "#fb8072", "FullyConnected": "#fb8072",
+               "BatchNorm": "#bebada", "Activation": "#ffffb3",
+               "Pooling": "#80b1d3", "Concat": "#fdb462",
+               "softmax": "#fccde5"}
+    for i, node in enumerate(nodes):
+        if node["op"] == "null":
+            if not is_weight(node) and not any(
+                    node["name"].endswith(s) for s in
+                    ("_weight", "_bias", "_gamma", "_beta",
+                     "_moving_mean", "_moving_var")):
+                dot.node(str(i), node["name"], fillcolor="#8dd3c7")
+            continue
+        label = f"{node['name']}\n{node['op']}"
+        dot.node(str(i), label,
+                 fillcolor=palette.get(node["op"], "#b3de69"))
+    for i, node in enumerate(nodes):
+        if node["op"] == "null":
+            continue
+        for j, _, *_rest in [tuple(x) for x in node.get("inputs", [])]:
+            if is_weight(nodes[j]):
+                continue
+            if nodes[j]["op"] == "null" and any(
+                    nodes[j]["name"].endswith(s) for s in
+                    ("_weight", "_bias", "_gamma", "_beta",
+                     "_moving_mean", "_moving_var")):
+                continue
+            dot.edge(str(j), str(i))
+    return dot

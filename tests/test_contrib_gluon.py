@@ -1,0 +1,91 @@
+"""gluon.contrib blocks + viz (ref: tests/python/unittest/test_gluon_contrib.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+
+def test_hybrid_concurrent_and_identity():
+    net = gluon.contrib.nn.HybridConcurrent(axis=1)
+    net.add(gluon.nn.Dense(4), gluon.contrib.nn.Identity())
+    net.initialize(mx.init.Xavier())
+    x = nd.ones((2, 3))
+    out = net(x)
+    assert out.shape == (2, 7)
+    net.hybridize()
+    np.testing.assert_allclose(out.asnumpy(), net(x).asnumpy(), rtol=1e-6)
+
+
+def test_sparse_embedding_grad_stype():
+    se = gluon.contrib.nn.SparseEmbedding(10, 4)
+    assert se.weight.grad_stype == "row_sparse"
+
+
+def test_variational_dropout_same_mask_across_steps():
+    cell = gluon.contrib.rnn.VariationalDropoutCell(
+        gluon.rnn.RNNCell(6), drop_inputs=0.5)
+    cell.base_cell.initialize(mx.init.One())
+    mx.random.seed(7)
+    x = nd.ones((2, 3))
+    with autograd.record():
+        cell(x, cell.begin_state(2))
+        mask1 = cell._mask_in.asnumpy()
+        cell(x, cell.begin_state(2))
+        mask2 = cell._mask_in.asnumpy()
+    np.testing.assert_allclose(mask1, mask2)  # cached until reset
+    cell.reset()
+    assert cell._mask_in is None
+
+
+def test_variational_dropout_inference_identity():
+    base = gluon.rnn.RNNCell(5)
+    cell = gluon.contrib.rnn.VariationalDropoutCell(base, drop_inputs=0.9)
+    base.initialize(mx.init.Xavier())
+    x = nd.ones((2, 4))
+    s = cell.begin_state(2)
+    o1, _ = cell(x, s)
+    o2, _ = base(x, s)
+    np.testing.assert_allclose(o1.asnumpy(), o2.asnumpy(), rtol=1e-6)
+
+
+def test_zoneout_cell_trains():
+    zc = gluon.rnn.ZoneoutCell(gluon.rnn.GRUCell(5), zoneout_states=0.3)
+    zc.base_cell.initialize(mx.init.Xavier())
+    with autograd.record():
+        o, s = zc(nd.ones((2, 3)))
+        o2, _ = zc(nd.ones((2, 3)), s)
+    assert o2.shape == (2, 5)
+    # inference passes straight through
+    o_inf, _ = zc(nd.ones((2, 3)))
+    assert np.isfinite(o_inf.asnumpy()).all()
+
+
+def test_modifier_cell_state_info():
+    rc = gluon.rnn.ResidualCell(gluon.rnn.LSTMCell(4))
+    assert rc.state_info(2) == rc.base_cell.state_info(2)
+    assert rc.base_cell._modified
+
+
+def test_viz_print_summary(capsys):
+    import mxnet_tpu.symbol as sym
+
+    data = sym.var("data")
+    c1 = sym.Convolution(data, num_filter=8, kernel=(3, 3), name="conv1")
+    a1 = sym.Activation(c1, act_type="relu", name="relu1")
+    fc = sym.FullyConnected(a1, num_hidden=10, name="fc1")
+    out = sym.SoftmaxOutput(fc, name="softmax")
+    total = mx.viz.print_summary(out, shape={"data": (1, 1, 28, 28)})
+    assert total == 8 * 9 + 8 + 10 * 8 * 26 * 26 + 10
+    cap = capsys.readouterr().out
+    assert "conv1 (Convolution)" in cap and "(1, 8, 26, 26)" in cap
+
+
+def test_viz_plot_network_soft_dependency():
+    import mxnet_tpu.symbol as sym
+
+    out = sym.FullyConnected(sym.var("data"), num_hidden=4, name="fc")
+    try:
+        g = mx.viz.plot_network(out)
+        assert g is not None
+    except mx.MXNetError as e:
+        assert "graphviz" in str(e)

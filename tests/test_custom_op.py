@@ -1,0 +1,103 @@
+"""Custom operator escape hatch (ref: tests/python/unittest/
+test_operator.py test_custom_op)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+
+class _Sigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        self.assign(out_data[0], req[0], nd.array(1 / (1 + np.exp(-x))))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0].asnumpy()
+        g = out_grad[0].asnumpy()
+        self.assign(in_grad[0], req[0], nd.array(g * y * (1 - y)))
+
+
+@mx.operator.register("test_sigmoid")
+class _SigmoidProp(mx.operator.CustomOpProp):
+    def create_operator(self, ctx, shapes, dtypes):
+        return _Sigmoid()
+
+
+class _Scale2(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0] * 2)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], out_grad[0] * 2)
+
+
+@mx.operator.register("test_scale2")
+class _Scale2Prop(mx.operator.CustomOpProp):
+    def create_operator(self, ctx, shapes, dtypes):
+        return _Scale2()
+
+
+def test_custom_eager_forward_backward():
+    x = nd.array(np.array([0.0, 1.0, -1.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="test_sigmoid")
+    y.backward(nd.ones((3,)))
+    expect = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(y.asnumpy(), expect, rtol=1e-6)
+    np.testing.assert_allclose(x.grad.asnumpy(), expect * (1 - expect),
+                               rtol=1e-5)
+
+
+def test_custom_in_hybridized_block():
+    class Net(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.dense = gluon.nn.Dense(4)
+
+        def hybrid_forward(self, F, x):
+            return F.Custom(self.dense(x), op_type="test_scale2")
+
+    net = Net()
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(0).rand(2, 3).astype(np.float32))
+    out_eager = net(x).asnumpy()
+    net.hybridize()
+    np.testing.assert_allclose(out_eager, net(x).asnumpy(), rtol=1e-5)
+    x.attach_grad()
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    assert x.grad.shape == (2, 3)
+
+
+def test_custom_symbolic():
+    import mxnet_tpu.symbol as sym
+
+    s = sym.Custom(sym.var("data"), op_type="test_scale2")
+    ex = s.simple_bind(mx.cpu(), data=(2, 3))
+    out = ex.forward(is_train=False,
+                     data=nd.array(np.ones((2, 3), np.float32)))
+    np.testing.assert_allclose(out[0].asnumpy(), 2 * np.ones((2, 3)))
+
+
+def test_custom_assign_add_req():
+    op = _Scale2()
+    dst = nd.ones((2,))
+    op.assign(dst, "add", nd.ones((2,)))
+    np.testing.assert_allclose(dst.asnumpy(), [2, 2])
+    op.assign(dst, "null", nd.zeros((2,)))
+    np.testing.assert_allclose(dst.asnumpy(), [2, 2])
+
+
+def test_custom_unregistered_raises():
+    with pytest.raises(mx.MXNetError):
+        nd.Custom(nd.ones((2,)), op_type="definitely_not_registered")
+
+
+def test_custom_prop_inference_defaults():
+    p = mx.operator.CustomOpProp()
+    ins, outs, aux = p.infer_shape([[2, 3]])
+    assert outs == [[2, 3]] and aux == []
+    assert "test_sigmoid" in mx.operator.get_all_registered_operators()

@@ -123,3 +123,35 @@ def test_spmd_tp_sharded_params():
     # the big Dense weight must actually be sharded over tp
     big = [r for r in tr._params if r.shape == (64, 8)][0]
     assert len(big.sharding.device_set) >= 2
+
+
+def test_gradient_compression_2bit():
+    """2-bit threshold quantization with error feedback
+    (ref: tests/nightly/dist_sync_kvstore.py --gc-type 2bit)."""
+    import numpy as np
+
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("w", nd.zeros((4,)))
+    g = nd.array(np.array([0.3, 0.7, -0.9, 0.0], np.float32))
+    kv.push("w", [g])
+    out = nd.zeros((4,))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), [0, 0.5, -0.5, 0])
+    # error feedback: accumulated residual pushes 0.3+0.3 over threshold
+    kv.push("w", [g])
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), [0.5, 0.5, -0.5, 0])
+    # per-slot residuals are independent
+    assert len(kv._compression._residuals) == 1
+    assert kv._compression.get_params()["threshold"] == 0.5
+
+
+def test_gradient_compression_validation():
+    kv = mx.kv.create("device")
+    with pytest.raises(mx.MXNetError):
+        kv.set_gradient_compression({"type": "1bit"})
+    with pytest.raises(mx.MXNetError):
+        kv.set_gradient_compression({"type": "2bit", "threshold": -1})
+    kv.set_gradient_compression({"type": "none"})
+    assert kv._compression is None

@@ -257,3 +257,34 @@ def test_entropy_calibration_incremental_hist():
     t_oneshot = _get_optimal_threshold(np.concatenate(batches))
     assert abs(hi - t_oneshot) / t_oneshot < 0.05
     assert lo == -hi
+
+
+def test_csr_negative_and_oob_index():
+    dense = _rand_sparse((4, 3))
+    csr = sparse.csr_matrix(dense)
+    np.testing.assert_allclose(csr[-1].asnumpy(), dense[3:4])
+    with pytest.raises(IndexError):
+        csr[4]
+    with pytest.raises(IndexError):
+        csr[-5]
+
+
+def test_kvstore_pull_sparse_out_raises():
+    kv = mx.kv.create("local")
+    kv.init("w", nd.zeros((4, 2)))
+    with pytest.raises(mx.MXNetError):
+        kv.pull("w", out=sparse.zeros("row_sparse", (4, 2)))
+    with pytest.raises(mx.MXNetError):
+        kv.row_sparse_pull("w", out=sparse.zeros("row_sparse", (4, 2)))
+    with pytest.raises(mx.MXNetError):
+        kv.row_sparse_pull("w", out=sparse.zeros("row_sparse", (4, 2)),
+                           row_ids=nd.array([100], dtype="int32"))
+
+
+def test_row_sparse_array_device_path_matches_numpy():
+    dense = _rand_sparse((8, 3), density=0.4, seed=3)
+    via_nd = sparse.row_sparse_array(nd.array(dense))
+    via_np = sparse.row_sparse_array(dense)
+    np.testing.assert_allclose(via_nd.asnumpy(), via_np.asnumpy())
+    np.testing.assert_array_equal(np.asarray(via_nd.indices.asnumpy()),
+                                  np.asarray(via_np.indices.asnumpy()))

@@ -1,0 +1,99 @@
+"""Subgraph fusion API + parse_log tool + inception_v3
+(ref: tests/python/mkl/test_subgraph.py)."""
+import io
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+import mxnet_tpu.symbol as sym
+from mxnet_tpu import nd
+from mxnet_tpu.symbol.symbol import _topo_order
+
+sys.path.insert(0, "/root/repo/tools")
+
+
+def _fc_act_graph():
+    data = sym.var("data")
+    fc = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = sym.Activation(fc, act_type="relu")
+    return data, fc, act, sym.FullyConnected(act, num_hidden=3, name="fc2")
+
+
+def test_fc_act_fusion_and_equivalence():
+    _, _, _, out = _fc_act_graph()
+    fused = out.get_backend_symbol("TPU")
+    ops_after = [n.op for n in _topo_order([fused._node]) if n.op]
+    assert "_sg_tpu_fully_connected_act" in ops_after
+    assert "Activation" not in ops_after
+
+    rng = np.random.RandomState(0)
+    args = {"data": nd.array(rng.rand(4, 5).astype(np.float32)),
+            "fc1_weight": nd.array(rng.randn(8, 5).astype(np.float32)),
+            "fc1_bias": nd.array(np.zeros(8, np.float32)),
+            "fc2_weight": nd.array(rng.randn(3, 8).astype(np.float32)),
+            "fc2_bias": nd.array(np.zeros(3, np.float32))}
+    o1 = out.bind(mx.cpu(), args).forward(is_train=False)[0].asnumpy()
+    o2 = fused.bind(mx.cpu(), args).forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(o1, o2, rtol=1e-5)
+
+
+def test_no_fusion_when_intermediate_escapes():
+    # fc output consumed by BOTH the activation and a second head — the
+    # chain intermediate escapes, so fusion must not fire
+    from mxnet_tpu.symbol.symbol import Group
+
+    data = sym.var("data")
+    fc = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = sym.Activation(fc, act_type="relu")
+    grouped = Group([act, fc])
+    from mxnet_tpu.subgraph import build_subgraph
+
+    fused = build_subgraph(grouped, "TPU")
+    ops_after = [n.op for n in _topo_order([fused._node]) if n.op]
+    assert "_sg_tpu_fully_connected_act" not in ops_after
+
+
+def test_unknown_backend_is_identity():
+    _, _, _, out = _fc_act_graph()
+    assert out.get_backend_symbol("NOSUCH") is out
+
+
+def test_custom_property_registration():
+    from mxnet_tpu import subgraph as sg
+
+    class P(sg.SubgraphProperty):
+        pattern = ("FullyConnected", "Activation")
+        fused_op = "_sg_tpu_fully_connected_act"
+
+    sg.register_subgraph_property("TESTBK", P())
+    assert len(sg.get_subgraph_properties("TESTBK")) == 1
+
+
+def test_parse_log():
+    import parse_log
+
+    log = """\
+INFO Epoch[0] Batch [20]\tSpeed: 1000.00 samples/sec\taccuracy=0.500000
+INFO Epoch[0] Batch [40]\tSpeed: 1200.00 samples/sec\taccuracy=0.600000
+INFO Epoch[0] Validation-accuracy=0.650000
+INFO Epoch[0] Time cost=12.3
+INFO Epoch[1] Batch [20]\tSpeed: 1100.00 samples/sec\taccuracy=0.700000
+INFO Epoch[1] Validation-accuracy=0.710000
+"""
+    epochs = parse_log.parse(log.splitlines())
+    assert epochs[0]["speed"] == [1000.0, 1200.0]
+    assert epochs[0]["val"] == 0.65 and epochs[0]["time"] == 12.3
+    assert epochs[1]["train"] == 0.7
+    buf = io.StringIO()
+    parse_log.render(epochs, "md", out=buf)
+    assert "| 0 |" in buf.getvalue()
+
+
+def test_inception_v3_shape():
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.get_model("inception_v3", classes=7)
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.rand(1, 3, 299, 299).astype(np.float32))
+    assert net(x).shape == (1, 7)

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Parse training logs into a per-epoch table (ref: tools/parse_log.py).
+
+Consumes the Speedometer/validation log lines this framework (and the
+reference) emit:
+
+    Epoch[3] Batch [20]  Speed: 1234.56 samples/sec  accuracy=0.912
+    Epoch[3] Validation-accuracy=0.901
+    Epoch[3] Time cost=42.1
+
+and prints one row per epoch: train metric, validation metric, mean
+speed, time cost.  Output is TSV (or markdown with --format md).
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from collections import defaultdict
+
+_RE_SPEED = re.compile(
+    r"Epoch\[(\d+)\]\s+Batch\s*\[\d+\]\s+Speed:\s*([\d.]+)\s*samples/sec"
+    r"(?:\s+(\S+)=([\d.eE+-]+))?")
+_RE_VAL = re.compile(r"Epoch\[(\d+)\]\s+Validation-(\S+)=([\d.eE+-]+)")
+_RE_TIME = re.compile(r"Epoch\[(\d+)\]\s+Time cost=([\d.]+)")
+_RE_TRAIN = re.compile(r"Epoch\[(\d+)\]\s+Train-(\S+)=([\d.eE+-]+)")
+
+
+def parse(lines):
+    """Return {epoch: {"speed": [..], "train": x, "val": x, "time": x}}."""
+    epochs = defaultdict(lambda: {"speed": [], "train": None, "val": None,
+                                  "time": None, "metric": None})
+    for line in lines:
+        m = _RE_SPEED.search(line)
+        if m:
+            e = epochs[int(m.group(1))]
+            e["speed"].append(float(m.group(2)))
+            if m.group(3):
+                e["train"] = float(m.group(4))
+                e["metric"] = m.group(3)
+            continue
+        m = _RE_TRAIN.search(line)
+        if m:
+            e = epochs[int(m.group(1))]
+            e["train"] = float(m.group(3))
+            e["metric"] = m.group(2)
+            continue
+        m = _RE_VAL.search(line)
+        if m:
+            epochs[int(m.group(1))]["val"] = float(m.group(3))
+            continue
+        m = _RE_TIME.search(line)
+        if m:
+            epochs[int(m.group(1))]["time"] = float(m.group(2))
+    return dict(epochs)
+
+
+def render(epochs, fmt="tsv", out=sys.stdout):
+    header = ["epoch", "train", "val", "speed(samples/s)", "time(s)"]
+    rows = []
+    for ep in sorted(epochs):
+        e = epochs[ep]
+        speed = (sum(e["speed"]) / len(e["speed"])) if e["speed"] else None
+        fmtv = lambda v: "-" if v is None else (f"{v:.4f}"
+                                                if isinstance(v, float)
+                                                else str(v))
+        rows.append([str(ep), fmtv(e["train"]), fmtv(e["val"]),
+                     fmtv(speed), fmtv(e["time"])])
+    if fmt == "md":
+        out.write("| " + " | ".join(header) + " |\n")
+        out.write("|" + "|".join(["---"] * len(header)) + "|\n")
+        for r in rows:
+            out.write("| " + " | ".join(r) + " |\n")
+    else:
+        out.write("\t".join(header) + "\n")
+        for r in rows:
+            out.write("\t".join(r) + "\n")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("logfile", help="training log file (- for stdin)")
+    ap.add_argument("--format", choices=("tsv", "md"), default="tsv")
+    args = ap.parse_args(argv)
+    lines = (sys.stdin if args.logfile == "-"
+             else open(args.logfile)).readlines()
+    render(parse(lines), args.format)
+
+
+if __name__ == "__main__":
+    main()
