@@ -6,8 +6,13 @@ LDFLAGS ?= -shared -ljpeg
 
 LIB := lib/libmxtpu_io.so
 ENGINE_LIB := lib/libmxtpu_engine.so
+STORAGE_LIB := lib/libmxtpu_storage.so
 
-all: $(LIB) $(ENGINE_LIB)
+all: $(LIB) $(ENGINE_LIB) $(STORAGE_LIB)
+
+$(STORAGE_LIB): src/storage.cc
+	@mkdir -p lib
+	$(CXX) $(CXXFLAGS) $< -o $@ -shared
 
 $(LIB): src/recordio.cc
 	@mkdir -p lib

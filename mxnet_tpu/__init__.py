@@ -39,6 +39,8 @@ _LAZY = {
     "mon": ".monitor",
     "contrib": ".contrib",
     "operator": ".operator",
+    "storage": ".storage",
+    "rnn": ".rnn",
     "viz": ".visualization",
     "visualization": ".visualization",
 }

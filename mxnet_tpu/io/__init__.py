@@ -1,4 +1,5 @@
 """IO subsystem (ref: src/io/ + python/mxnet/io/)."""
 from .io import (DataBatch, DataDesc, DataIter, NDArrayIter, MNISTIter,  # noqa: F401
-                 CSVIter, ImageRecordIter, PrefetchingIter, ResizeIter)
+                 CSVIter, LibSVMIter, ImageRecordIter, PrefetchingIter,
+                 ResizeIter)
 from . import recordio  # noqa: F401

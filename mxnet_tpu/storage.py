@@ -1,0 +1,146 @@
+"""Host-staging storage manager — mx.storage.
+
+Ref: include/mxnet/storage.h (`Storage::Get()->Alloc/Free/DirectFree`)
++ src/storage/pooled_storage_manager.h.  Native pool in src/storage.cc
+(size-class free-lists over 64-byte-aligned host memory — the staging
+tier for decode buffers / batch assembly / checkpoint IO; device HBM is
+owned by PjRt and needs no framework pool).  Pure-Python fallback when
+the native lib is unavailable.
+
+Pool policy via MXTPU_MEM_POOL_TYPE: Pooled (default) | RoundedMany |
+Unpooled (ref: MXNET_GPU_MEM_POOL_TYPE naive/round).
+"""
+from __future__ import annotations
+
+import ctypes
+import threading
+
+import numpy as np
+
+from .base import MXNetError, getenv
+from .utils.libloader import load_native_lib
+
+_POOL_TYPES = {"Pooled": 0, "Round": 0, "RoundedMany": 1, "Naive": 0,
+               "Unpooled": 2}
+_sigs_done = False
+
+
+def _load_native():
+    global _sigs_done
+    lib = load_native_lib("libmxtpu_storage.so", "lib/libmxtpu_storage.so")
+    if lib is None or _sigs_done:
+        return lib
+    _sigs_done = True
+    lib.MXTPUStorageCreate.restype = ctypes.c_void_p
+    lib.MXTPUStorageCreate.argtypes = [ctypes.c_int]
+    lib.MXTPUStorageAlloc.restype = ctypes.c_void_p
+    lib.MXTPUStorageAlloc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    for name in ("MXTPUStorageFree", "MXTPUStorageDirectFree"):
+        getattr(lib, name).restype = None
+        getattr(lib, name).argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.MXTPUStorageReleaseAll.restype = None
+    lib.MXTPUStorageReleaseAll.argtypes = [ctypes.c_void_p]
+    lib.MXTPUStorageDestroy.restype = None
+    lib.MXTPUStorageDestroy.argtypes = [ctypes.c_void_p]
+    for name in ("MXTPUStorageUsedBytes", "MXTPUStoragePoolBytes",
+                 "MXTPUStorageHits", "MXTPUStorageMisses"):
+        getattr(lib, name).restype = ctypes.c_uint64
+        getattr(lib, name).argtypes = [ctypes.c_void_p]
+    return lib
+
+
+class Handle:
+    """An allocation handle (ref: Storage::Handle)."""
+
+    __slots__ = ("ptr", "size", "_owner")
+
+    def __init__(self, ptr, size, owner):
+        self.ptr = ptr
+        self.size = size
+        self._owner = owner
+
+    def as_numpy(self, dtype=np.uint8):
+        """Zero-copy numpy view over the staged buffer."""
+        dt = np.dtype(dtype)
+        count = self.size // dt.itemsize
+        buf = (ctypes.c_uint8 * self.size).from_address(self.ptr)
+        return np.frombuffer(buf, dtype=dt, count=count)
+
+
+class Storage:
+    """Singleton staging allocator (ref: Storage::Get())."""
+
+    _instance = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self._lib = _load_native()
+        pool_name = getenv("MEM_POOL_TYPE", "Pooled")
+        if pool_name not in _POOL_TYPES:
+            raise MXNetError(
+                f"unknown MXTPU_MEM_POOL_TYPE {pool_name!r}; "
+                f"one of {sorted(_POOL_TYPES)}")
+        self._pool_type = _POOL_TYPES[pool_name]
+        self._handle = (self._lib.MXTPUStorageCreate(self._pool_type)
+                        if self._lib is not None else None)
+        self._py_live = {}  # fallback: id -> np buffer
+
+    @classmethod
+    def get(cls):
+        # first callers are concurrent prefetch workers — double-checked
+        # lock so only one native pool ever exists
+        if cls._instance is None:
+            with cls._instance_lock:
+                if cls._instance is None:
+                    cls._instance = cls()
+        return cls._instance
+
+    @property
+    def native(self):
+        return self._handle is not None
+
+    def alloc(self, nbytes):
+        if nbytes < 0:
+            raise MXNetError("negative allocation size")
+        if self._handle is not None:
+            p = self._lib.MXTPUStorageAlloc(self._handle, nbytes)
+            if not p and nbytes:
+                raise MXNetError(f"staging allocation of {nbytes}B failed")
+            return Handle(p, nbytes, self)
+        buf = np.empty(nbytes, np.uint8)
+        h = Handle(buf.ctypes.data, nbytes, self)
+        self._py_live[h.ptr] = buf
+        return h
+
+    def free(self, handle):
+        """Return to the pool (ref: Storage::Free)."""
+        if self._handle is not None:
+            self._lib.MXTPUStorageFree(self._handle, handle.ptr)
+        else:
+            self._py_live.pop(handle.ptr, None)
+        handle.ptr = None
+
+    def direct_free(self, handle):
+        """Bypass the pool (ref: Storage::DirectFree)."""
+        if self._handle is not None:
+            self._lib.MXTPUStorageDirectFree(self._handle, handle.ptr)
+        else:
+            self._py_live.pop(handle.ptr, None)
+        handle.ptr = None
+
+    def release_all(self):
+        if self._handle is not None:
+            self._lib.MXTPUStorageReleaseAll(self._handle)
+
+    def stats(self):
+        if self._handle is None:
+            return {"native": False,
+                    "used_bytes": sum(b.nbytes
+                                      for b in self._py_live.values())}
+        return {
+            "native": True,
+            "used_bytes": self._lib.MXTPUStorageUsedBytes(self._handle),
+            "pool_bytes": self._lib.MXTPUStoragePoolBytes(self._handle),
+            "hits": self._lib.MXTPUStorageHits(self._handle),
+            "misses": self._lib.MXTPUStorageMisses(self._handle),
+        }

@@ -1,0 +1,47 @@
+"""Shared native-library loader (ref: python/mxnet/base.py _load_lib).
+
+One cached find-so / auto-make / CDLL path for every native component
+(libmxtpu_io, libmxtpu_engine, libmxtpu_storage)."""
+from __future__ import annotations
+
+import ctypes
+import os
+import shutil
+import subprocess
+
+from ..base import getenv
+
+_cache = {}  # so_name -> CDLL | None (None = tried and unavailable)
+
+
+def repo_root():
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def load_native_lib(so_name, make_target=None):
+    """Return the CDLL for lib/<so_name> (building it via make on first
+    miss), or None when native is unavailable/disabled."""
+    if getenv("NO_NATIVE", False, bool):
+        return None  # env wins over the cache (tests toggle it)
+    if so_name in _cache:
+        return _cache[so_name]
+    _cache[so_name] = None
+    root = repo_root()
+    so = os.path.join(root, "lib", so_name)
+    if not os.path.exists(so) and shutil.which("g++"):
+        try:
+            cmd = ["make", "-C", root]
+            if make_target:
+                cmd.append(make_target)
+            subprocess.run(cmd, check=True, capture_output=True,
+                           timeout=120)
+        except Exception:
+            return None
+    if not os.path.exists(so):
+        return None
+    try:
+        _cache[so_name] = ctypes.CDLL(so)
+    except OSError:
+        return None
+    return _cache[so_name]

@@ -32,20 +32,10 @@ def load():
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    if getenv("NO_NATIVE", False, bool):
-        return None
-    so = os.path.join(_repo_root(), "lib", "libmxtpu_io.so")
-    if not os.path.exists(so) and shutil.which("g++"):
-        try:
-            subprocess.run(["make", "-C", _repo_root()], check=True,
-                           capture_output=True, timeout=120)
-        except Exception:
-            return None
-    if not os.path.exists(so):
-        return None
-    try:
-        lib = ctypes.CDLL(so)
-    except OSError:
+    from .libloader import load_native_lib
+
+    lib = load_native_lib("libmxtpu_io.so")
+    if lib is None:
         return None
     # signatures
     lib.MXTPURecordIOWriterCreate.restype = ctypes.c_void_p

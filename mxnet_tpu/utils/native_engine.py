@@ -36,23 +36,12 @@ def load():
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    if getenv("NO_NATIVE", False, bool):
-        return None
-    so = os.path.join(_repo_root(), "lib", "libmxtpu_engine.so")
-    if not os.path.exists(so) and shutil.which("g++"):
-        try:
-            # build just this target: the IO lib needs libjpeg and must
-            # not block the engine (which has no external deps)
-            subprocess.run(
-                ["make", "-C", _repo_root(), "lib/libmxtpu_engine.so"],
-                check=True, capture_output=True, timeout=120)
-        except Exception:
-            return None
-    if not os.path.exists(so):
-        return None
-    try:
-        lib = ctypes.CDLL(so)
-    except OSError:
+    from .libloader import load_native_lib
+
+    # build just this target: the IO lib needs libjpeg and must not
+    # block the engine (which has no external deps)
+    lib = load_native_lib("libmxtpu_engine.so", "lib/libmxtpu_engine.so")
+    if lib is None:
         return None
     lib.MXTPUEngineCreate.restype = ctypes.c_void_p
     lib.MXTPUEngineCreate.argtypes = [ctypes.c_int, ctypes.c_int]

@@ -1,0 +1,188 @@
+// Pooled host-staging storage manager.
+//
+// Ref: src/storage/storage.cc + pooled_storage_manager.h — the
+// reference pools GPU/pinned-host memory to avoid cudaMalloc/cudaFree
+// on the hot path.  The TPU runtime owns HBM through PjRt, so what the
+// framework still allocates at high frequency is HOST staging memory:
+// decode buffers, batch assembly, checkpoint scatter/gather.  This
+// manager provides the same pooling policies for those buffers:
+//
+//   * kPooled (default, ref: GPUPooledStorageManager): size-class
+//     free-lists, sizes rounded up to the next power of two; freed
+//     blocks are recycled, released only on ReleaseAll.
+//   * kRoundedMany (ref: GPUPooledRoundedStorageManager): same but
+//     keeps at most kMaxPerClass blocks per class to bound waste.
+//   * kUnpooled (ref: NaiveStorageManager): malloc/free passthrough,
+//     selected with MXTPU_MEM_POOL_TYPE=Unpooled for debugging.
+//
+// Exposed through a flat C ABI (ref: the MX* C API convention) and
+// bound via ctypes in python/mxnet_tpu/storage.py.  Buffers are
+// 64-byte aligned so numpy views vectorize and DMA into PjRt
+// host-to-device transfers stays aligned.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <new>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr size_t kAlign = 64;
+constexpr size_t kMaxPerClass = 32;
+
+enum PoolType { kPooled = 0, kRoundedMany = 1, kUnpooled = 2 };
+
+size_t RoundPow2(size_t n) {
+  if (n < kAlign) return kAlign;
+  size_t p = 1;
+  while (p < n) p <<= 1;
+  return p;
+}
+
+struct Pool {
+  explicit Pool(int type) : type_(static_cast<PoolType>(type)) {}
+
+  ~Pool() { ReleaseAll(); }
+
+  void* Alloc(size_t nbytes) {
+    if (nbytes == 0) return nullptr;
+    const size_t rounded =
+        type_ == kUnpooled ? nbytes : RoundPow2(nbytes);
+    if (type_ != kUnpooled) {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = free_.find(rounded);
+      if (it != free_.end() && !it->second.empty()) {
+        void* p = it->second.back();
+        it->second.pop_back();
+        pool_bytes_ -= rounded;
+        used_bytes_ += rounded;
+        hits_++;
+        sizes_[p] = rounded;
+        return p;
+      }
+    }
+    void* p = nullptr;
+    if (posix_memalign(&p, kAlign, rounded) != 0) {
+      // one reclaim attempt before giving up (ref: DirectFreeAll on OOM)
+      ReleaseAll();
+      if (posix_memalign(&p, kAlign, rounded) != 0) return nullptr;
+    }
+    std::lock_guard<std::mutex> lk(mu_);
+    misses_++;
+    used_bytes_ += rounded;
+    sizes_[p] = rounded;
+    return p;
+  }
+
+  void Free(void* p) {
+    if (p == nullptr) return;
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = sizes_.find(p);
+    if (it == sizes_.end()) return;  // not ours; ignore
+    const size_t rounded = it->second;
+    sizes_.erase(it);
+    used_bytes_ -= rounded;
+    if (type_ == kUnpooled) {
+      free(p);
+      return;
+    }
+    auto& bucket = free_[rounded];
+    if (type_ == kRoundedMany && bucket.size() >= kMaxPerClass) {
+      free(p);
+      return;
+    }
+    bucket.push_back(p);
+    pool_bytes_ += rounded;
+  }
+
+  void DirectFree(void* p) {
+    if (p == nullptr) return;
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = sizes_.find(p);
+    if (it != sizes_.end()) {
+      used_bytes_ -= it->second;
+      sizes_.erase(it);
+    }
+    free(p);
+  }
+
+  void ReleaseAll() {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& kv : free_) {
+      for (void* p : kv.second) free(p);
+    }
+    free_.clear();
+    pool_bytes_ = 0;
+  }
+
+  uint64_t used_bytes() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return used_bytes_;
+  }
+  uint64_t pool_bytes() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return pool_bytes_;
+  }
+  uint64_t hits() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return hits_;
+  }
+  uint64_t misses() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return misses_;
+  }
+
+ private:
+  PoolType type_;
+  std::mutex mu_;
+  std::map<size_t, std::vector<void*>> free_;   // size class -> blocks
+  std::unordered_map<void*, size_t> sizes_;     // live ptr -> rounded size
+  uint64_t used_bytes_ = 0;
+  uint64_t pool_bytes_ = 0;
+  uint64_t hits_ = 0;
+  uint64_t misses_ = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* MXTPUStorageCreate(int pool_type) {
+  return new (std::nothrow) Pool(pool_type);
+}
+
+void MXTPUStorageDestroy(void* h) { delete static_cast<Pool*>(h); }
+
+void* MXTPUStorageAlloc(void* h, uint64_t nbytes) {
+  return static_cast<Pool*>(h)->Alloc(nbytes);
+}
+
+void MXTPUStorageFree(void* h, void* p) { static_cast<Pool*>(h)->Free(p); }
+
+void MXTPUStorageDirectFree(void* h, void* p) {
+  static_cast<Pool*>(h)->DirectFree(p);
+}
+
+void MXTPUStorageReleaseAll(void* h) {
+  static_cast<Pool*>(h)->ReleaseAll();
+}
+
+uint64_t MXTPUStorageUsedBytes(void* h) {
+  return static_cast<Pool*>(h)->used_bytes();
+}
+
+uint64_t MXTPUStoragePoolBytes(void* h) {
+  return static_cast<Pool*>(h)->pool_bytes();
+}
+
+uint64_t MXTPUStorageHits(void* h) { return static_cast<Pool*>(h)->hits(); }
+
+uint64_t MXTPUStorageMisses(void* h) {
+  return static_cast<Pool*>(h)->misses();
+}
+
+}  // extern "C"

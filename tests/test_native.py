@@ -101,3 +101,67 @@ def test_native_pipeline_augment_shapes(tmp_path):
     assert batches[0].data[0].shape == (4, 3, 32, 32)
     labels = np.concatenate([b.label[0].asnumpy() for b in batches])
     assert ((labels >= 0) & (labels <= 3)).all()
+
+
+def test_storage_pool_reuse():
+    """Size-class reuse (ref: tests/cpp/storage/storage_test.cc)."""
+    import numpy as np
+
+    from mxnet_tpu import storage
+
+    st = storage.Storage.get()
+    h1 = st.alloc(1000)
+    arr = h1.as_numpy(np.float32)
+    arr[:] = 1.5
+    assert arr.shape == (250,)
+    p1 = h1.ptr
+    st.free(h1)
+    if st.native:
+        assert p1 % 64 == 0
+        h2 = st.alloc(900)  # same 1024-byte class -> pooled block
+        assert h2.ptr == p1
+        assert st.stats()["hits"] >= 1
+        st.direct_free(h2)
+        st.release_all()
+        assert st.stats()["pool_bytes"] == 0
+    else:
+        h2 = st.alloc(900)
+        st.free(h2)
+
+
+def test_storage_unpooled_mode(monkeypatch):
+    monkeypatch.setenv("MXTPU_MEM_POOL_TYPE", "Unpooled")
+    from mxnet_tpu import storage
+
+    st = storage.Storage()  # fresh instance, not the singleton
+    h1 = st.alloc(512)
+    p1 = h1.ptr
+    st.free(h1)
+    h2 = st.alloc(512)
+    st.free(h2)  # no pooling guarantees; just must not crash
+    assert st.stats()["used_bytes"] == 0 or not st.native
+    del p1
+
+
+def test_storage_python_fallback(monkeypatch):
+    monkeypatch.setenv("MXTPU_NO_NATIVE", "1")
+    from mxnet_tpu import storage
+
+    st = storage.Storage()
+    assert not st.native
+    h = st.alloc(256)
+    v = h.as_numpy()
+    v[:] = 7
+    st.free(h)
+    assert st.stats()["used_bytes"] == 0
+
+
+def test_storage_bad_pool_type(monkeypatch):
+    import pytest
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import storage
+
+    monkeypatch.setenv("MXTPU_MEM_POOL_TYPE", "Bogus")
+    with pytest.raises(mx.MXNetError):
+        storage.Storage()

@@ -1,0 +1,167 @@
+"""mx.rnn legacy module: BucketSentenceIter (+ LibSVMIter)
+(ref: tests/python/unittest/test_io.py + test_bucketing.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _sentences():
+    rng = np.random.RandomState(0)
+    return [list(rng.randint(1, 20, rng.randint(3, 15)))
+            for _ in range(200)]
+
+
+def test_bucket_sentence_iter_shapes():
+    it = mx.rnn.BucketSentenceIter(_sentences(), batch_size=8,
+                                   buckets=[5, 10, 15])
+    assert it.default_bucket_key == 15
+    seen_keys = set()
+    n_batches = 0
+    for batch in it:
+        assert batch.bucket_key in (5, 10, 15)
+        seen_keys.add(batch.bucket_key)
+        assert batch.data[0].shape == (8, batch.bucket_key)
+        assert batch.label[0].shape == (8, batch.bucket_key)
+        # label is data shifted by one
+        d = batch.data[0].asnumpy()
+        l = batch.label[0].asnumpy()
+        np.testing.assert_allclose(l[:, :-1], d[:, 1:])
+        n_batches += 1
+    assert n_batches > 0 and len(seen_keys) >= 2
+    it.reset()
+    assert next(iter(it)) is not None
+
+
+def test_bucket_sentence_iter_tn_layout():
+    it = mx.rnn.BucketSentenceIter(_sentences(), batch_size=4,
+                                   buckets=[10, 15], layout="TN")
+    b = next(iter(it))
+    assert b.data[0].shape == (b.bucket_key, 4)
+    with pytest.raises(mx.MXNetError):
+        mx.rnn.BucketSentenceIter(_sentences(), 4, buckets=[10],
+                                  layout="XY")
+
+
+def test_bucket_iter_with_bucketing_module():
+    import mxnet_tpu.symbol as sym
+
+    def sym_gen(seq_len):
+        data = sym.var("data")
+        label = sym.var("softmax_label")
+        emb = sym.Embedding(data, input_dim=25, output_dim=8, name="emb")
+        fc = sym.FullyConnected(
+            sym.reshape(emb, shape=(-1, 8)), num_hidden=25, name="fc")
+        out = sym.SoftmaxOutput(fc, sym.reshape(label, shape=(-1,)),
+                                name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    it = mx.rnn.BucketSentenceIter(_sentences(), batch_size=8,
+                                   buckets=[5, 10, 15])
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=it.default_bucket_key)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    for i, batch in enumerate(it):
+        mod.forward_backward(batch)
+        mod.update()
+        if i >= 3:
+            break
+    out = mod.get_outputs()[0]
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_legacy_cell_names():
+    assert mx.rnn.LSTMCell is mx.gluon.rnn.LSTMCell
+    assert mx.rnn.GRUCell is mx.gluon.rnn.GRUCell
+
+
+def test_libsvm_iter(tmp_path):
+    p = tmp_path / "data.libsvm"
+    p.write_text("1 0:1.5 3:2.0\n0 1:0.5\n1 2:3.0 4:1.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(p), data_shape=(5,), batch_size=2)
+    b1 = it.next()
+    assert b1.data[0].stype == "csr"
+    np.testing.assert_allclose(b1.data[0].asnumpy(),
+                               [[1.5, 0, 0, 2, 0], [0, 0.5, 0, 0, 0]])
+    np.testing.assert_allclose(b1.label[0].asnumpy(), [1, 0])
+    b2 = it.next()
+    assert b2.pad == 1
+    with pytest.raises(StopIteration):
+        it.next()
+    it.reset()
+    assert it.next() is not None
+
+
+def test_libsvm_iter_tiny_dataset_wraps_modulo(tmp_path):
+    # regression: batch_size > 2x dataset size must wrap, not IndexError
+    p = tmp_path / "one.libsvm"
+    p.write_text("1 0:2.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(p), data_shape=(3,), batch_size=4)
+    b = it.next()
+    np.testing.assert_allclose(b.data[0].asnumpy(),
+                               [[2, 0, 0]] * 4)
+    assert b.pad == 3
+
+
+def test_libsvm_iter_label_shape(tmp_path):
+    p = tmp_path / "ml.libsvm"
+    p.write_text("1 0 1 0:1.0\n0 1 0 1:2.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(p), data_shape=(3,),
+                          label_shape=(3,), batch_size=2)
+    assert it.provide_label[0].shape == (2, 3)
+    b = it.next()
+    np.testing.assert_allclose(b.label[0].asnumpy(), [[1, 0, 1], [0, 1, 0]])
+    # wrong label count raises
+    bad = tmp_path / "bad.libsvm"
+    bad.write_text("1 0:1.0\n")
+    with pytest.raises(mx.MXNetError):
+        mx.io.LibSVMIter(data_libsvm=str(bad), data_shape=(3,),
+                         label_shape=(2,), batch_size=1)
+
+
+def test_image_record_iter_reset_frees_staging(tmp_path, monkeypatch):
+    # regression: multi-epoch loops must not leak staging buffers
+    import io as pyio
+
+    from PIL import Image
+
+    import mxnet_tpu.io.recordio as rio
+    from mxnet_tpu.storage import Storage
+
+    rng = np.random.RandomState(0)
+    rec_path, idx_path = str(tmp_path / "t.rec"), str(tmp_path / "t.idx")
+    rec = rio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(6):
+        img = Image.fromarray((rng.rand(40, 40, 3) * 255).astype(np.uint8))
+        buf = pyio.BytesIO()
+        img.save(buf, format="PNG")
+        rec.write_idx(i, rio.pack(rio.IRHeader(0, float(i % 2), i, 0),
+                                  buf.getvalue()))
+    rec.close()
+    # isolate from other tests' iterators: fresh pool for this test only
+    monkeypatch.setattr(Storage, "_instance", Storage())
+    st = Storage.get()
+    it = mx.io.ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 32, 32),
+                               batch_size=2, use_native=False)
+    for _b in it:
+        pass
+    it.reset()
+    baseline = st.stats().get("used_bytes", 0)
+    for _ in range(4):  # epochs; reset drains in-flight decodes
+        for _b in it:
+            pass
+        it.reset()
+    stats = st.stats()
+    # in-flight prefetch holds a constant working set; epochs add nothing
+    if st.native:
+        assert stats["used_bytes"] <= baseline, (baseline, stats)
+    it._drain_prefetch()
+    if st.native:
+        # draining releases the iterator's whole working set
+        assert st.stats()["used_bytes"] < baseline
