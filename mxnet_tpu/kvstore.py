@@ -123,12 +123,32 @@ class KVStore:
         from .ndarray.sparse import RowSparseNDArray
 
         keys, outs = _normalize(key, out)
-        rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
-        for k, olist in zip(keys, outs):
+        rids = list(row_ids) if isinstance(row_ids, (list, tuple)) \
+            else [row_ids]
+        # row_ids align with outs the same way the reference's
+        # kvstore.py zips them: either one rid per flattened out, one rid
+        # per key (broadcast over that key's outs), or a single rid for
+        # everything. (Round-1 bug: `rids * len(olist)` restarted at
+        # rids[0] for every key, silently pulling key 0's rows.)
+        n_flat = sum(len(olist) for olist in outs)
+        if len(rids) == n_flat:
+            per_key, off = [], 0
+            for olist in outs:
+                per_key.append(rids[off:off + len(olist)])
+                off += len(olist)
+        elif len(rids) == len(keys):
+            per_key = [[r] * len(olist) for r, olist in zip(rids, outs)]
+        elif len(rids) == 1:
+            per_key = [rids * len(olist) for olist in outs]
+        else:
+            raise MXNetError(
+                f"row_ids length {len(rids)} matches neither the number "
+                f"of outs ({n_flat}) nor the number of keys ({len(keys)})")
+        for k, olist, krids in zip(keys, outs, per_key):
             if k not in self._store:
                 raise MXNetError(f"key {k} has not been initialized")
             src = self._store[k]
-            for o, rid in zip(olist, rids * len(olist)):
+            for o, rid in zip(olist, krids):
                 ids = np.unique(np.asarray(
                     rid.asnumpy() if isinstance(rid, NDArray) else rid
                 ).astype(np.int64))

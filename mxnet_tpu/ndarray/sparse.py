@@ -96,7 +96,19 @@ class BaseSparseNDArray:
             out._indices = jax.device_put(out._indices, dev)
             return out
         if isinstance(other, NDArray):
-            other._data = self.todense()._data
+            # ref: CopyFromTo checks shape, casts to the destination's
+            # dtype, and keeps the destination on its own device
+            if tuple(other.shape) != tuple(self.shape):
+                raise MXNetError(
+                    f"copyto shape mismatch: source {self.shape} vs "
+                    f"destination {other.shape}")
+            from .. import engine
+
+            dense = self.todense()._data
+            if dense.dtype != other._data.dtype:
+                dense = dense.astype(other._data.dtype)
+            other._data = engine.track(
+                jax.device_put(dense, list(other._data.devices())[0]))
             return other
         if isinstance(other, BaseSparseNDArray):
             raise MXNetError("copyto(sparse) not supported; use tostype")

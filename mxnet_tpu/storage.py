@@ -60,7 +60,15 @@ class Handle:
         self._owner = owner
 
     def as_numpy(self, dtype=np.uint8):
-        """Zero-copy numpy view over the staged buffer."""
+        """Zero-copy numpy view over the staged buffer.
+
+        The view aliases pooled memory: it must NOT outlive
+        ``Storage.free(handle)`` — after free the pool may recycle the
+        block for a concurrent prefetch worker. Copy
+        (``.copy()``) before freeing if the data must persist.
+        """
+        if self.ptr is None:
+            raise MXNetError("as_numpy on a freed storage handle")
         dt = np.dtype(dtype)
         count = self.size // dt.itemsize
         buf = (ctypes.c_uint8 * self.size).from_address(self.ptr)
@@ -112,21 +120,28 @@ class Storage:
         self._py_live[h.ptr] = buf
         return h
 
+    @staticmethod
+    def _free_impl(handle, native_fn):
+        # Always frees into the handle's OWNING pool — a handle may
+        # outlive a Storage-instance swap (tests, reconfiguration), and
+        # freeing a foreign pointer into another pool corrupts both
+        # pools' accounting. Double-free is a no-op.
+        owner = handle._owner
+        if handle.ptr is None:
+            return
+        if owner._handle is not None:
+            getattr(owner._lib, native_fn)(owner._handle, handle.ptr)
+        else:
+            owner._py_live.pop(handle.ptr, None)
+        handle.ptr = None
+
     def free(self, handle):
         """Return to the pool (ref: Storage::Free)."""
-        if self._handle is not None:
-            self._lib.MXTPUStorageFree(self._handle, handle.ptr)
-        else:
-            self._py_live.pop(handle.ptr, None)
-        handle.ptr = None
+        self._free_impl(handle, "MXTPUStorageFree")
 
     def direct_free(self, handle):
         """Bypass the pool (ref: Storage::DirectFree)."""
-        if self._handle is not None:
-            self._lib.MXTPUStorageDirectFree(self._handle, handle.ptr)
-        else:
-            self._py_live.pop(handle.ptr, None)
-        handle.ptr = None
+        self._free_impl(handle, "MXTPUStorageDirectFree")
 
     def release_all(self):
         if self._handle is not None:

@@ -114,10 +114,7 @@ def plot_network(symbol, title="plot", save_format="pdf", shape=None,
                "softmax": "#fccde5"}
     for i, node in enumerate(nodes):
         if node["op"] == "null":
-            if not is_weight(node) and not any(
-                    node["name"].endswith(s) for s in
-                    ("_weight", "_bias", "_gamma", "_beta",
-                     "_moving_mean", "_moving_var")):
+            if not is_weight(node):
                 dot.node(str(i), node["name"], fillcolor="#8dd3c7")
             continue
         label = f"{node['name']}\n{node['op']}"
@@ -128,11 +125,6 @@ def plot_network(symbol, title="plot", save_format="pdf", shape=None,
             continue
         for j, _, *_rest in [tuple(x) for x in node.get("inputs", [])]:
             if is_weight(nodes[j]):
-                continue
-            if nodes[j]["op"] == "null" and any(
-                    nodes[j]["name"].endswith(s) for s in
-                    ("_weight", "_bias", "_gamma", "_beta",
-                     "_moving_mean", "_moving_var")):
                 continue
             dot.edge(str(j), str(i))
     return dot

@@ -2,7 +2,7 @@
 launched via `tools/launch.py -n 2 --launcher local` — the
 multi-node-without-a-cluster mechanism, SURVEY §4).
 
-Asserts the reference's核 invariant: gradients pushed from N workers
+Asserts the reference's core invariant: gradients pushed from N workers
 pull back as the N-worker sum.
 """
 import os

@@ -152,16 +152,18 @@ def test_image_record_iter_reset_frees_staging(tmp_path, monkeypatch):
     for _b in it:
         pass
     it.reset()
+    # reset() re-enqueues prefetch whose decode allocs land asynchronously;
+    # measure only with the pipeline fully drained so the reading is
+    # deterministic under the full suite
+    it._drain_prefetch()
     baseline = st.stats().get("used_bytes", 0)
+    it.reset()  # re-arm after drain
     for _ in range(4):  # epochs; reset drains in-flight decodes
         for _b in it:
             pass
         it.reset()
-    stats = st.stats()
-    # in-flight prefetch holds a constant working set; epochs add nothing
-    if st.native:
-        assert stats["used_bytes"] <= baseline, (baseline, stats)
     it._drain_prefetch()
+    stats = st.stats()
     if st.native:
-        # draining releases the iterator's whole working set
-        assert st.stats()["used_bytes"] < baseline
+        # a drained iterator holds no staging memory: epochs leak nothing
+        assert stats["used_bytes"] == 0, (baseline, stats)

@@ -164,6 +164,40 @@ def test_kvstore_sparse_push_row_sparse_pull():
     np.testing.assert_allclose(rs_out.asnumpy()[4], [2, 2])
 
 
+def test_kvstore_multi_key_row_sparse_pull():
+    # regression: per-key row_ids must align with keys (round-1 bug pulled
+    # key 0's rows for every key)
+    kv = mx.kv.create("local")
+    kv.init("a", nd.array(np.arange(12, dtype=np.float32).reshape(6, 2)))
+    kv.init("b", nd.array(100 + np.arange(12,
+                                          dtype=np.float32).reshape(6, 2)))
+    oa = sparse.zeros("row_sparse", (6, 2))
+    ob = sparse.zeros("row_sparse", (6, 2))
+    kv.row_sparse_pull(["a", "b"], out=[oa, ob],
+                       row_ids=[nd.array([1], dtype="int32"),
+                                nd.array([2], dtype="int32")])
+    np.testing.assert_allclose(np.asarray(oa.indices.asnumpy()), [1])
+    np.testing.assert_allclose(oa.asnumpy()[1], [2, 3])
+    np.testing.assert_allclose(np.asarray(ob.indices.asnumpy()), [2])
+    np.testing.assert_allclose(ob.asnumpy()[2], [104, 105])
+    # mismatched rid count errors instead of silently recycling
+    with pytest.raises(mx.MXNetError):
+        kv.row_sparse_pull(["a", "b"], out=[oa, ob],
+                           row_ids=[nd.array([0]), nd.array([1]),
+                                    nd.array([2])])
+
+
+def test_sparse_copyto_shape_mismatch_errors():
+    src = sparse.row_sparse_array(([[1., 1.]], [0]), shape=(6, 2))
+    with pytest.raises(mx.MXNetError):
+        src.copyto(nd.zeros((4, 2)))
+    # dtype casts to the destination's dtype
+    dst = nd.zeros((6, 2), dtype="float16")
+    src.copyto(dst)
+    assert dst.dtype == np.float16
+    np.testing.assert_allclose(dst.asnumpy()[0], [1, 1])
+
+
 def test_embedding_sparse_grad_end_to_end():
     from mxnet_tpu import gluon, autograd
 
