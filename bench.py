@@ -1,67 +1,250 @@
 """Benchmark entry point — prints ONE JSON line.
 
-Runs the flagship training step (compiled SPMD path: forward + backward
-+ optimizer fused into one XLA computation) on the available device(s)
-and reports training throughput.
+Flagship: ResNet-50 v1 (BASELINE.json config #2) trained with the
+compiled SPMD step (forward + backward + grad reduce + SGD fused into
+one XLA computation, parameter donation) on synthetic ImageNet-shaped
+data. Reports images/sec and MFU (step FLOPs from XLA cost analysis /
+chip peak bf16 FLOPs).
 
-vs_baseline: BASELINE.json carries no published reference numbers
-(`published: {}` — see BASELINE.md provenance); the ratio is reported
-against the first recorded value of this bench (BENCH_BASELINE_VALUE),
-so cross-round progress is visible.
+Robustness (round-1 failure: the axon TPU backend hung for 9+ minutes
+and the driver recorded rc=1 with no parseable output):
+- the parent process NEVER imports jax; all device work happens in
+  subprocesses with hard timeouts
+- the TPU backend is health-probed first (devices + tiny matmul),
+  with one retry after backoff
+- on TPU failure the bench falls back to CPU so a parseable JSON line
+  with a real measurement is always printed, with the TPU failure cause
+  recorded in the "note" field
+
+vs_baseline: fraction of the BASELINE.json north-star target (>=50% MFU
+on the real chip). On the CPU fallback there is no MFU target, so
+vs_baseline reports 0.0 and the note explains why.
 """
 import json
 import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
 
-# first-round recorded value (samples/sec, TPU v5e, 2026-07-29);
-# update when re-baselining
-BENCH_BASELINE_VALUE = 14524.0
+MFU_TARGET = 0.50  # BASELINE.json north star: >=50% MFU
+
+# peak dense bf16 FLOP/s by TPU generation (public spec sheets)
+_PEAK_BF16 = (
+    ("v5 lite", 197e12), ("v5litepod", 197e12), ("v5e", 197e12),
+    ("v5p", 459e12), ("v5", 459e12),
+    ("v6", 918e12), ("trillium", 918e12),
+    ("v4", 275e12),
+    ("v3", 123e12), ("v2", 45e12),
+)
 
 
-def main():
+def _peak_flops(device_kind):
+    kind = device_kind.lower()
+    for key, peak in _PEAK_BF16:
+        if key in kind:
+            return peak
+    return None
+
+
+# ---------------------------------------------------------------------------
+# leaf: the actual measurement (runs in a subprocess)
+# ---------------------------------------------------------------------------
+
+def _leaf(platform):
+    if platform == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        bs, iters, image = 16, 4, 112
+    else:
+        import jax
+
+        bs, iters, image = 64, 20, 224
+
     import numpy as np
 
     import mxnet_tpu as mx
     from mxnet_tpu import gluon
-    from mxnet_tpu.parallel import data_parallel, mesh as mesh_mod
-    from __graft_entry__ import _flagship_net
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel import data_parallel
 
+    dev = jax.devices()[0]
     mx.random.seed(0)
     np.random.seed(0)
 
-    bs = 256
-    x = np.random.rand(bs, 1, 28, 28).astype(np.float32)
-    y = np.random.randint(0, 10, bs).astype(np.float32)
-
-    net = _flagship_net()
+    net = vision.resnet50_v1()
     net.initialize(mx.init.Xavier())
     trainer = data_parallel.DataParallelTrainer(
-        net, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
-        {"learning_rate": 1e-3})
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9})
+
+    x = np.random.rand(bs, 3, image, image).astype(np.float32)
+    y = np.random.randint(0, 1000, bs).astype(np.float32)
 
     # warmup / compile
     trainer.step(x, y).wait_to_read()
     trainer.step(x, y).wait_to_read()
 
-    iters = 30
+    # step FLOPs from the lowered computation's own cost analysis
+    # (Lowered.cost_analysis is HLO-level — no second backend compile;
+    # the warmup above already built the executable the timed loop uses)
+    flops_per_step = None
+    try:
+        import jax.numpy as jnp
+
+        from mxnet_tpu import random as _random
+
+        lowered = trainer._step_fn.lower(
+            trainer._params, trainer._states,
+            jnp.asarray(x), jnp.asarray(y), _random.next_key(),
+            jnp.asarray(0.1, jnp.float32), jnp.asarray(3.0, jnp.float32))
+        cost = lowered.cost_analysis()
+        if cost:
+            c = cost[0] if isinstance(cost, (list, tuple)) else cost
+            flops_per_step = float(c.get("flops", 0.0)) or None
+    except Exception:
+        pass
+    if flops_per_step is None:
+        # analytic fallback: ResNet-50 fwd ~= 4.09 GFLOP/img at 224^2,
+        # scaled by image area; training ~= 3x forward
+        flops_per_step = 3 * 4.089e9 * (image / 224.0) ** 2 * bs
+
     t0 = time.perf_counter()
     for _ in range(iters):
         loss = trainer.step(x, y)
     loss.wait_to_read()
     dt = time.perf_counter() - t0
-    sps = iters * bs / dt
+    ips = iters * bs / dt
 
-    vs = sps / BENCH_BASELINE_VALUE if BENCH_BASELINE_VALUE else 1.0
+    # flops_per_step covers the GLOBAL batch over the whole dp mesh, so
+    # peak must be the aggregate of every chip the step ran on
+    chip_peak = _peak_flops(dev.device_kind) \
+        if dev.platform != "cpu" else None
+    n_chips = len(trainer.mesh.devices.flat)
+    peak = chip_peak * n_chips if chip_peak else None
+    mfu = (flops_per_step * iters / dt / peak) if peak else None
+
+    # eager per-op dispatch overhead (SURVEY §3.1 hot-loop risk)
+    from mxnet_tpu import nd
+
+    a = nd.ones((8, 8))
+    b = nd.ones((8, 8))
+    (a + b).wait_to_read()  # compile/cache
+    n_ops = 300
+    t0 = time.perf_counter()
+    for _ in range(n_ops):
+        c = a + b
+    c.wait_to_read()
+    eager_us = (time.perf_counter() - t0) / n_ops * 1e6
+
     print(json.dumps({
-        "metric": "flagship_cnn_train_throughput",
-        "value": round(sps, 2),
-        "unit": "samples/sec",
-        "vs_baseline": round(vs, 3),
+        "metric": "resnet50_train_throughput",
+        "value": round(ips, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(mfu / MFU_TARGET, 4) if mfu else 0.0,
+        "mfu": round(mfu, 4) if mfu else None,
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "batch_size": bs,
+        "image_size": image,
+        "flops_per_step": flops_per_step,
+        "eager_us_per_op": round(eager_us, 1),
+        "final_loss": round(float(loss.asscalar()), 4),
     }))
 
 
+# ---------------------------------------------------------------------------
+# probe: cheap backend health check (runs in a subprocess)
+# ---------------------------------------------------------------------------
+
+def _probe():
+    import jax
+
+    ds = jax.devices()
+    import jax.numpy as jnp
+
+    y = (jnp.ones((256, 256)) @ jnp.ones((256, 256))).block_until_ready()
+    assert float(y[0, 0]) == 256.0
+    print(f"PROBE_OK {ds[0].platform} {ds[0].device_kind}")
+
+
+# ---------------------------------------------------------------------------
+# parent orchestration (never imports jax)
+# ---------------------------------------------------------------------------
+
+def _run(args, timeout):
+    """Run a bench subprocess; returns (rc, stdout, stderr-tail)."""
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)] + args,
+            capture_output=True, text=True, timeout=timeout, cwd=REPO)
+        return p.returncode, p.stdout, p.stderr[-2000:]
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout.decode() if isinstance(e.stdout, bytes) else \
+            (e.stdout or "")
+        return -1, out, f"timeout after {timeout}s"
+
+
+def _last_json_line(out):
+    for line in reversed(out.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def main():
+    note = []
+    # 1. health-probe the default (TPU) backend, one retry with backoff
+    tpu_ok = False
+    for attempt in range(2):
+        rc, out, err = _run(["--probe"], timeout=180)
+        if rc == 0 and "PROBE_OK" in out:
+            tpu_ok = "cpu" not in out.split("PROBE_OK", 1)[1].split()[0]
+            if not tpu_ok:
+                note.append("probe came up on CPU (no TPU registered)")
+            break
+        note.append(f"probe attempt {attempt + 1} failed "
+                    f"(rc={rc}): {err.strip().splitlines()[-1][:200] if err.strip() else 'no output'}")
+        if attempt == 0:
+            time.sleep(20)
+
+    # 2. run the leaf bench on the healthy backend (TPU first, CPU fallback)
+    result = None
+    if tpu_ok:
+        rc, out, err = _run(["--leaf", "tpu"], timeout=900)
+        result = _last_json_line(out)
+        if result is None:
+            note.append(f"tpu leaf failed (rc={rc}): "
+                        f"{err.strip().splitlines()[-1][:200] if err.strip() else 'no output'}")
+    if result is None:
+        note.append("falling back to CPU" if not tpu_ok else
+                    "tpu measurement failed; falling back to CPU")
+        rc, out, err = _run(["--leaf", "cpu"], timeout=900)
+        result = _last_json_line(out)
+        if result is None:
+            note.append(f"cpu leaf failed (rc={rc}): "
+                        f"{err.strip().splitlines()[-1][:300] if err.strip() else 'no output'}")
+
+    if result is None:
+        # total failure: still print a parseable record with the cause
+        result = {"metric": "resnet50_train_throughput", "value": 0.0,
+                  "unit": "images/sec", "vs_baseline": 0.0}
+    if note:
+        result["note"] = "; ".join(note)
+    print(json.dumps(result))
+
+
 if __name__ == "__main__":
-    main()
+    if "--probe" in sys.argv:
+        _probe()
+    elif "--leaf" in sys.argv:
+        _leaf(sys.argv[sys.argv.index("--leaf") + 1])
+    else:
+        main()

@@ -1,0 +1,112 @@
+"""BERT-base MLM pretraining — BASELINE config #3.
+
+Ref: GluonNLP's scripts/bert/run_pretraining.py shape: masked-LM +
+next-sentence-prediction over the kvstore all-reduce. Here the whole
+step (fwd + bwd + grad psum over the 'dp' mesh axis + AdamW) is ONE
+compiled XLA computation. Synthetic corpus by default so the script is
+runnable without data; --seq-len and --model pick the config.
+
+  python examples/bert/pretrain_bert.py --model tiny --steps 20
+  python examples/bert/pretrain_bert.py --model base --batch-size 64
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.gluon import HybridBlock
+from mxnet_tpu.models import bert
+
+
+class BERTForPretrain(HybridBlock):
+    """MLM + NSP loss head over the backbone, one scalar loss out."""
+
+    def __init__(self, model, vocab_size, **kwargs):
+        super().__init__(**kwargs)
+        self.model = model
+        self._vocab = vocab_size
+
+    def hybrid_forward(self, F, inputs, token_types, mlm_targets,
+                       nsp_labels, mask_weight):
+        mlm_scores, nsp_scores = self.model(inputs, token_types)
+        mlm_log = F.log_softmax(mlm_scores)
+        mlm_ll = F.pick(mlm_log, mlm_targets, axis=-1)
+        mlm_loss = -F.sum(mlm_ll * mask_weight) / (F.sum(mask_weight) + 1)
+        nsp_log = F.log_softmax(nsp_scores)
+        nsp_loss = -F.mean(F.pick(nsp_log, nsp_labels, axis=-1))
+        return mlm_loss + nsp_loss
+
+
+def synthetic_batch(rng, bs, seq_len, vocab, mask_frac=0.15):
+    tokens = rng.randint(4, vocab, (bs, seq_len))
+    types = np.zeros((bs, seq_len), np.int32)
+    half = seq_len // 2
+    types[:, half:] = 1
+    mask = (rng.rand(bs, seq_len) < mask_frac).astype(np.float32)
+    targets = tokens.copy()
+    inputs = np.where(mask > 0, 3, tokens)  # 3 = [MASK]
+    nsp = rng.randint(0, 2, (bs,))
+    return (inputs.astype(np.int32), types, targets.astype(np.int32),
+            nsp.astype(np.int32), mask)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="base",
+                   choices=["tiny", "base", "large"])
+    p.add_argument("--vocab-size", type=int, default=30522)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--disp", type=int, default=10)
+    args = p.parse_args()
+    if args.model == "tiny":
+        args.vocab_size = min(args.vocab_size, 1000)
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    backbone = getattr(bert, f"bert_{args.model}")(
+        vocab_size=args.vocab_size)
+    net = BERTForPretrain(backbone, args.vocab_size)
+    net.initialize(mx.init.TruncNorm(stdev=0.02))
+
+    from mxnet_tpu.parallel import data_parallel
+
+    class _Identity(gluon.loss.Loss):
+        # the model already returns the scalar loss
+        def __init__(self, **kwargs):
+            super().__init__(None, 0, **kwargs)
+
+        def hybrid_forward(self, F, pred, label):
+            return pred
+
+    trainer = data_parallel.DataParallelTrainer(
+        net, _Identity(), "adamw",
+        {"learning_rate": args.lr, "wd": 0.01})
+
+    tic, tic_n = time.time(), 0
+    for step in range(args.steps):
+        inputs, types, targets, nsp, mask = synthetic_batch(
+            rng, args.batch_size, args.seq_len, args.vocab_size)
+        loss = trainer.step((inputs, types, targets, nsp, mask),
+                            np.zeros((args.batch_size,), np.float32))
+        tic_n += args.batch_size * args.seq_len
+        if step % args.disp == 0 and step:
+            loss.wait_to_read()
+            tps = tic_n / (time.time() - tic)
+            print(f"step {step} loss {float(loss.asscalar()):.4f} "
+                  f"{tps:.0f} tokens/s")
+            tic, tic_n = time.time(), 0
+    loss.wait_to_read()
+    print(f"done: final loss {float(loss.asscalar()):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""DeepAR probabilistic forecasting — BASELINE config #5.
+
+Ref: GluonTS DeepAREstimator shape (2x40 LSTM, Student-t head,
+ancestral-sampling prediction). Trains one-step-ahead NLL on synthetic
+seasonal series; the LSTM runs through the fused scan kernel
+(ops/rnn.py — Pallas on TPU).
+
+  python examples/forecasting/train_deepar.py --steps 50
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu import models
+
+
+def synthetic_series(rng, bs, length):
+    """Seasonal + trend + noise, GluonTS-demo style."""
+    t = np.arange(length, dtype=np.float32)
+    season = np.sin(2 * np.pi * t / 24)[None, :]
+    level = rng.rand(bs, 1).astype(np.float32) * 2 + 1
+    noise = rng.randn(bs, length).astype(np.float32) * 0.1
+    return level * (1 + 0.5 * season) + noise
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-cells", type=int, default=40)
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--context-length", type=int, default=72)
+    p.add_argument("--prediction-length", type=int, default=24)
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--disp", type=int, default=10)
+    p.add_argument("--predict", action="store_true",
+                   help="sample forecasts after training")
+    args = p.parse_args()
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    net = models.deepar(args.num_cells, args.num_layers)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    T = args.context_length + args.prediction_length
+    tic = time.time()
+    for step in range(args.steps):
+        series = nd.array(synthetic_series(rng, args.batch_size, T))
+        with autograd.record():
+            nll = net(series)
+        nll.backward()
+        trainer.step(args.batch_size)
+        if step % args.disp == 0 and step:
+            print(f"step {step} nll {float(nll.asscalar()):.4f} "
+                  f"{args.batch_size * step / (time.time() - tic):.0f} "
+                  f"series/s")
+    print(f"done: final nll {float(nll.asscalar()):.4f}")
+
+    if args.predict:
+        ctx_series = nd.array(
+            synthetic_series(rng, 4, args.context_length))
+        samples = net.predict(ctx_series,
+                              prediction_length=args.prediction_length,
+                              num_samples=50)
+        p50 = np.median(samples, axis=1)
+        p90 = np.percentile(samples, 90, axis=1)
+        print(f"forecast p50[0, :6] = {np.round(p50[0, :6], 3)}")
+        print(f"forecast p90[0, :6] = {np.round(p90[0, :6], 3)}")
+
+
+if __name__ == "__main__":
+    main()

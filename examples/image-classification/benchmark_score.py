@@ -1,0 +1,58 @@
+"""Synthetic-data inference throughput — ref:
+example/image-classification/benchmark_score.py.
+
+Scores model-zoo networks with hybridized (single-XLA-computation)
+forward passes on device-resident synthetic batches, sweeping batch
+size like the reference.
+
+  python examples/image-classification/benchmark_score.py \
+      --network resnet50 --batch-sizes 1,16,64
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+def score(net_name, batch_size, image_shape, iters=30):
+    net = getattr(vision, net_name)()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = nd.array(np.random.rand(batch_size, *image_shape)
+                 .astype(np.float32))
+    net(x).wait_to_read()  # compile
+    net(x).wait_to_read()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = net(x)
+    out.wait_to_read()
+    dt = time.perf_counter() - t0
+    return iters * batch_size / dt
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--network", default="resnet50_v1",
+                   help="comma list of model_zoo.vision builders")
+    p.add_argument("--batch-sizes", default="1,16,64")
+    p.add_argument("--image-shape", default="3,224,224")
+    args = p.parse_args()
+    shape = tuple(int(v) for v in args.image_shape.split(","))
+
+    for name in args.network.split(","):
+        for bs in (int(b) for b in args.batch_sizes.split(",")):
+            ips = score(name, bs, shape)
+            print(f"network: {name} batch: {bs:4d} "
+                  f"images/sec: {ips:.1f}")
+
+
+if __name__ == "__main__":
+    main()

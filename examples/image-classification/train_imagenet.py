@@ -1,0 +1,126 @@
+"""ResNet-50 on ImageNet — BASELINE config #2.
+
+Ref: example/image-classification/train_imagenet.py +
+benchmark_score.py --benchmark 1. Data comes from a RecordIO pack
+(tools/im2rec.py) through ImageRecordIter's threaded decode pipeline;
+--benchmark 1 switches to synthetic device-resident data to isolate
+compute, exactly like the reference's benchmark mode.
+
+Training runs on the compiled SPMD path (DataParallelTrainer): ONE XLA
+computation per step containing forward, backward, the gradient
+all-reduce over the ICI mesh ('dp' axis) and the SGD update with
+parameter donation — the north-star translation of
+kvstore('device') push/pull.
+
+  # synthetic compute benchmark (single host, all local devices):
+  python examples/image-classification/train_imagenet.py --benchmark 1
+
+  # real data:
+  python examples/image-classification/train_imagenet.py \
+      --data-train ~/imagenet_train.rec --epochs 90
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon.model_zoo import vision
+from mxnet_tpu.parallel import data_parallel, mesh as mesh_mod
+
+
+def build_net(args):
+    builder = {"resnet18": vision.resnet18_v1,
+               "resnet34": vision.resnet34_v1,
+               "resnet50": vision.resnet50_v1,
+               "resnet101": vision.resnet101_v1,
+               "resnet50_v2": vision.resnet50_v2}[args.network]
+    net = builder(classes=args.num_classes)
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def data_source(args):
+    """Yields (x, y) numpy batches; synthetic or ImageRecordIter."""
+    c, h, w = (int(v) for v in args.image_shape.split(","))
+    if args.benchmark:
+        rng = np.random.RandomState(0)
+        x = rng.rand(args.batch_size, c, h, w).astype(np.float32)
+        y = rng.randint(0, args.num_classes,
+                        args.batch_size).astype(np.float32)
+        while True:
+            yield x, y
+    else:
+        it = mx.io.ImageRecordIter(
+            path_imgrec=args.data_train, data_shape=(c, h, w),
+            batch_size=args.batch_size, shuffle=True, rand_crop=True,
+            rand_mirror=True, resize=256,
+            mean_r=123.68, mean_g=116.779, mean_b=103.939,
+            std_r=58.393, std_g=57.12, std_b=57.375,
+            preprocess_threads=args.data_nthreads)
+        while True:
+            it.reset()
+            for batch in it:
+                yield batch.data[0], batch.label[0]
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--network", default="resnet50")
+    p.add_argument("--data-train", default="")
+    p.add_argument("--benchmark", type=int, default=0)
+    p.add_argument("--batch-size", type=int, default=256,
+                   help="global batch (split over the dp mesh axis)")
+    p.add_argument("--image-shape", default="3,224,224")
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--steps-per-epoch", type=int, default=100)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--lr-step-epochs", default="30,60,80")
+    p.add_argument("--data-nthreads", type=int, default=8)
+    p.add_argument("--disp-batches", type=int, default=20)
+    p.add_argument("--model-prefix", default="")
+    args = p.parse_args()
+    if not args.benchmark and not args.data_train:
+        p.error("--data-train is required unless --benchmark 1")
+
+    mx.random.seed(0)
+    mesh = mesh_mod.make_mesh()  # all local devices on the 'dp' axis
+    net = build_net(args)
+    trainer = data_parallel.DataParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": args.lr, "momentum": 0.9, "wd": 1e-4},
+        mesh=mesh)
+    lr_steps = [int(e) for e in args.lr_step_epochs.split(",") if e]
+
+    src = data_source(args)
+    step = 0
+    for epoch in range(args.epochs):
+        if epoch in lr_steps:
+            trainer.set_learning_rate(trainer.learning_rate * 0.1)
+        tic, tic_n = time.time(), 0
+        for i in range(args.steps_per_epoch):
+            x, y = next(src)
+            loss = trainer.step(x, y)
+            step += 1
+            tic_n += args.batch_size
+            if i % args.disp_batches == 0 and i:
+                loss.wait_to_read()
+                ips = tic_n / (time.time() - tic)
+                print(f"epoch {epoch} batch {i} loss "
+                      f"{float(loss.asscalar()):.4f} {ips:.1f} images/s")
+                tic, tic_n = time.time(), 0
+        if args.model_prefix:
+            trainer.sync_to_block()
+            net.export(args.model_prefix, epoch=epoch)
+    loss.wait_to_read()
+    print(f"done: {step} steps, final loss {float(loss.asscalar()):.4f}")
+
+
+if __name__ == "__main__":
+    main()

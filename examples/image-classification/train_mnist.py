@@ -1,0 +1,118 @@
+"""LeNet on MNIST — BASELINE config #1.
+
+Ref: example/image-classification/train_mnist.py. Uses MNISTIter when
+the idx-ubyte files are on disk, else a synthetic drop-in so the script
+is runnable anywhere (the reference's --benchmark idea).
+
+  python examples/image-classification/train_mnist.py \
+      --data-dir ~/mnist --epochs 2
+  python examples/image-classification/train_mnist.py --synthetic
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+def lenet():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(20, kernel_size=5, activation="tanh"),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(50, kernel_size=5, activation="tanh"),
+            nn.MaxPool2D(2, 2),
+            nn.Flatten(),
+            nn.Dense(500, activation="tanh"),
+            nn.Dense(10))
+    return net
+
+
+def get_iters(args):
+    if not args.synthetic and args.data_dir:
+        train = mx.io.MNISTIter(
+            image=os.path.join(args.data_dir, "train-images-idx3-ubyte"),
+            label=os.path.join(args.data_dir, "train-labels-idx1-ubyte"),
+            batch_size=args.batch_size, shuffle=True)
+        val = mx.io.MNISTIter(
+            image=os.path.join(args.data_dir, "t10k-images-idx3-ubyte"),
+            label=os.path.join(args.data_dir, "t10k-labels-idx1-ubyte"),
+            batch_size=args.batch_size, shuffle=False)
+        return train, val
+    rng = np.random.RandomState(0)
+    n = 2048
+    X = rng.rand(n, 1, 28, 28).astype(np.float32) * 0.1
+    y = rng.randint(0, 10, n)
+    for k in range(10):  # separable synthetic digits
+        X[y == k, :, (k * 2):(k * 2 + 6), :] += 0.9
+    train = mx.io.NDArrayIter(X[:1792], y[:1792].astype(np.float32),
+                              batch_size=args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(X[1792:], y[1792:].astype(np.float32),
+                            batch_size=args.batch_size)
+    return train, val
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--data-dir", default="")
+    p.add_argument("--synthetic", action="store_true")
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--hybridize", type=int, default=1)
+    args = p.parse_args()
+
+    mx.random.seed(42)
+    train_iter, val_iter = get_iters(args)
+
+    net = lenet()
+    net.initialize(mx.init.Xavier())
+    if args.hybridize:
+        net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        metric.reset()
+        train_iter.reset()
+        tic = time.time()
+        n_samples = 0
+        for i, batch in enumerate(train_iter):
+            x, y = batch.data[0], batch.label[0]
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(x.shape[0])
+            metric.update([y], [out])
+            n_samples += x.shape[0]
+            if i % 50 == 0 and i:
+                print(f"epoch {epoch} batch {i} "
+                      f"acc {metric.get()[1]:.4f} "
+                      f"{n_samples / (time.time() - tic):.0f} samples/s")
+        name, acc = metric.get()
+        print(f"epoch {epoch}: train {name} {acc:.4f} "
+              f"({n_samples / (time.time() - tic):.0f} samples/s)")
+
+        metric.reset()
+        val_iter.reset()
+        for batch in val_iter:
+            out = net(batch.data[0])
+            metric.update([batch.label[0]], [out])
+        print(f"epoch {epoch}: validation {metric.get()[0]} "
+              f"{metric.get()[1]:.4f}")
+
+    net.export("lenet")  # model-symbol.json + params checkpoint
+    print("exported to lenet-symbol.json / lenet-0000.params")
+
+
+if __name__ == "__main__":
+    main()

@@ -108,6 +108,26 @@ class Normal(Initializer):
 
 
 @register()
+class TruncNorm(Initializer):
+    """Truncated normal in [mean - 2*stdev, mean + 2*stdev]
+    (ref: python/mxnet/initializer.py TruncNorm; the BERT init)."""
+
+    def __init__(self, mean=0.0, stdev=0.01):
+        super().__init__(mean=mean, stdev=stdev)
+        self.mean = mean
+        self.stdev = stdev
+
+    def _init_weight(self, name, arr):
+        lo, hi = -2.0, 2.0
+        vals = np.random.normal(0, 1, arr.shape)
+        bad = (vals < lo) | (vals > hi)
+        while bad.any():  # resample the tails (truncation, not clipping)
+            vals[bad] = np.random.normal(0, 1, int(bad.sum()))
+            bad = (vals < lo) | (vals > hi)
+        _fill(arr, self.mean + self.stdev * vals)
+
+
+@register()
 class Orthogonal(Initializer):
     def __init__(self, scale=1.414, rand_type="uniform"):
         super().__init__(scale=scale, rand_type=rand_type)

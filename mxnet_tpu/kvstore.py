@@ -35,6 +35,7 @@ class KVStore:
         self._updater = None
         self._optimizer = None
         self._compression = None  # GradientCompression when enabled
+        self._ps = None           # PSClient for the dist_async transport
 
     @property
     def type(self):
@@ -66,6 +67,10 @@ class KVStore:
             # ._data (sparse stays sparse only on the wire, ref: comm.h)
             self._store[k] = (v.todense() if isinstance(v, BaseSparseNDArray)
                               else v.copy())
+            if self._is_async():
+                # set-if-absent on the server: every worker sends, first
+                # one wins (ref: KVStoreDist::InitImpl push to servers)
+                self._ps_client().init(str(k), self._store[k].asnumpy())
 
     # -- push / pull --------------------------------------------------------
 
@@ -80,6 +85,11 @@ class KVStore:
                 vlist = [self._compression.compress(k, slot, v)
                          for slot, v in enumerate(vlist)]
             reduced = _reduce_sum(vlist, self._store[k].context)
+            if self._is_async():
+                # no barrier, no cross-worker reduce: the server merges
+                # (or optimizer-updates) THIS worker's push immediately
+                self._ps_client().push(str(k), reduced.asnumpy())
+                continue
             if self._is_dist():
                 reduced = self._dist_allreduce(k, reduced)
             if self._updater is not None:
@@ -95,6 +105,13 @@ class KVStore:
         for k, olist in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError(f"key {k} has not been initialized")
+            if self._is_async():
+                # fetch the server's CURRENT value — may not yet include
+                # other workers' in-flight pushes (async semantics)
+                import jax.numpy as jnp
+
+                self._store[k]._data = jnp.asarray(
+                    self._ps_client().pull(str(k)))
             src = self._store[k]
             for o in olist:
                 if isinstance(o, BaseSparseNDArray):
@@ -175,6 +192,16 @@ class KVStore:
         """Run the optimizer on the (reduced) push'ed grads —
         ref: kvstore_dist_server.h set_optimizer."""
         self._optimizer = optimizer
+        if self._is_async():
+            # serialized to the server; updates happen per-push there.
+            # Only rank 0 sends (ref: python/mxnet/kvstore.py — a late
+            # worker re-sending would wipe server-side Adam state
+            # accrued from earlier pushes)
+            from .parallel import dist
+
+            if dist.rank() == 0:
+                self._ps_client().set_optimizer(optimizer)
+            return
         self._updater = _opt.get_updater(optimizer)
 
     def set_gradient_compression(self, compression_params):
@@ -200,6 +227,36 @@ class KVStore:
 
     def _is_dist(self):
         return self._type.startswith("dist")
+
+    def _is_async(self):
+        """dist_async rides the PS transport: per-push server update, no
+        barrier (ref: kvstore_dist_server.h sync_mode_=false)."""
+        from .parallel import dist
+
+        return self._type == "dist_async" and dist.is_multiprocess()
+
+    def _ps_client(self):
+        if self._ps is None:
+            import os
+            import time
+
+            from .parallel import dist, ps
+
+            if dist.rank() == 0 and "DMLC_PS_SERVER_PORT" not in os.environ:
+                ps.ensure_local_server()
+            endpoints = ps.server_endpoints()
+            last = None
+            for _ in range(60):  # servers may still be starting
+                try:
+                    self._ps = ps.PSClient(endpoints)
+                    break
+                except OSError as e:
+                    last = e
+                    time.sleep(0.25)
+            else:
+                raise MXNetError(
+                    f"cannot reach parameter servers {endpoints}: {last}")
+        return self._ps
 
     def _dist_allreduce(self, key, value):
         from .parallel import dist

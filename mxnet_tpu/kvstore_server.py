@@ -1,0 +1,18 @@
+"""Standalone parameter-server process (ref: python/mxnet/
+kvstore_server.py — the MXKVStoreRunServer role).
+
+Launched by tools/launch.py -s N with DMLC_ROLE=server; serves the
+dist_async transport (parallel/ps.py). Blocks until a worker sends
+("stop",).
+
+  python -m mxnet_tpu.kvstore_server
+"""
+from .parallel import ps
+
+
+def main():
+    ps.run_server()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,4 +1,4 @@
-"""Flash attention Pallas kernel for TPU.
+"""Flash attention Pallas kernels (forward + backward) for TPU.
 
 Ref capability: the reference has NO fused attention op (SURVEY §2.2
 "no fused attention op in this era") — transformers are composed from
@@ -6,10 +6,11 @@ batch_dot + softmax, materializing the (S,S) score matrix in HBM.  This
 kernel is the capability upgrade the survey prescribes: online-softmax
 blockwise attention that keeps scores in VMEM, MXU-aligned 128-tiles.
 
-Forward = Pallas kernel; backward = recompute via the XLA reference
-(jax.custom_vjp) — the standard memory/flops trade (flash bwd kernel is
-a later optimization; the VJP recompute is already O(S) memory because
-XLA fuses the recomputation blockwise under remat).
+Both directions are Pallas kernels. Forward saves the per-row
+log-sum-exp; backward recomputes P blockwise from (q, k, lse) — the
+standard flash-attention-2 scheme: one kernel accumulates dQ over
+k-blocks, a second accumulates dK/dV over q-blocks, with
+delta = rowsum(dO * O) precomputed in XLA.
 
 Falls back transparently when seq/head dims don't tile (caller guards).
 """
@@ -26,8 +27,8 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e9
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal,
-                      scale, seq_k):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
+                      causal, scale, seq_k):
     # refs carry a leading block dim of 1: (1, block_q, d) / (1, seq_k, d)
     block_q = q_ref.shape[1]
     d = q_ref.shape[2]
@@ -67,7 +68,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal,
         m, l, acc = jax.lax.fori_loop(0, max_kb, body, (m0, l0, acc0))
     else:
         m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l))[:, 0]
 
 
 def _flash_forward(q, k, v, *, causal, scale, block_q=128, block_k=128):
@@ -79,10 +82,13 @@ def _flash_forward(q, k, v, *, causal, scale, block_q=128, block_k=128):
     v3 = v.reshape(bh, sk, d)
 
     grid = (bh, sq // block_q)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_flash_fwd_kernel, block_k=block_k,
                           causal=causal, scale=scale, seq_k=sk),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d),
@@ -93,10 +99,159 @@ def _flash_forward(q, k, v, *, causal, scale, block_q=128, block_k=128):
             pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+        ),
+    )(q3, k3, v3)
+    return out.reshape(b, h, sq, d), lse
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dq_ref, *, block_k, causal, scale, seq_k):
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    qi = pl.program_id(1)
+
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]
+    delta = delta_ref[0][:, None]
+    dq0 = jnp.zeros((block_q, d), jnp.float32)
+    num_kb = seq_k // block_k
+
+    def body(kb, dq):
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = scale * jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    if causal:
+        max_kb = jnp.minimum(((qi + 1) * block_q + block_k - 1) // block_k,
+                             num_kb)
+        dq = jax.lax.fori_loop(0, max_kb, body, dq0)
+    else:
+        dq = jax.lax.fori_loop(0, num_kb, body, dq0)
+    dq_ref[0] = (scale * dq).astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref, *, block_q, causal, scale, seq_q):
+    block_k = k_ref.shape[1]
+    d = k_ref.shape[2]
+    ki = pl.program_id(1)
+
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    num_qb = seq_q // block_q
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q)][:, None]
+        delta = delta_ref[0, pl.ds(qb * block_q, block_q)][:, None]
+        s = scale * jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    if causal:
+        # q-blocks strictly before this k-block see nothing
+        min_qb = (ki * block_k) // block_q
+        dk, dv = jax.lax.fori_loop(min_qb, num_qb, body, (dk0, dv0))
+    else:
+        dk, dv = jax.lax.fori_loop(0, num_qb, body, (dk0, dv0))
+    dk_ref[0] = (scale * dk).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, do, *, causal, scale,
+                    block_q=128, block_k=128):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bh = b * h
+    q3, k3, v3 = (t.reshape(bh, -1, d) for t in (q, k, v))
+    o3 = o.reshape(bh, sq, d)
+    do3 = do.reshape(bh, sq, d)
+    # delta = rowsum(dO * O): one fused XLA elementwise+reduce
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1)
+
+    full_q = lambda i, j: (i, 0, 0)  # noqa: E731
+    full_r = lambda i, j: (i, 0)     # noqa: E731
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, block_k=block_k,
+                          causal=causal, scale=scale, seq_k=sk),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        grid=(bh, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, sk, d), full_q, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, sk, d), full_q, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+        ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
                                memory_space=pltpu.VMEM),
-    )(q3, k3, v3)
-    return out.reshape(b, h, sq, d)
+    )(q3, k3, v3, do3, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_dkv_kernel, block_q=block_q,
+                          causal=causal, scale=scale, seq_q=sq),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ),
+        grid=(bh, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), full_q, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, sq, d), full_q, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, sq), full_r, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, sq), full_r, memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+    )(q3, k3, v3, do3, lse, delta)
+
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
 
 
 def _tiles_ok(q, k, block_q=128, block_k=128):
@@ -108,24 +263,18 @@ def _tiles_ok(q, k, block_q=128, block_k=128):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash_sdpa(q, k, v, causal, scale):
-    return _flash_forward(q, k, v, causal=causal, scale=scale)
+    out, _ = _flash_forward(q, k, v, causal=causal, scale=scale)
+    return out
 
 
 def _flash_sdpa_fwd(q, k, v, causal, scale):
-    return _flash_forward(q, k, v, causal=causal, scale=scale), (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal=causal, scale=scale)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_sdpa_bwd(causal, scale, res, g):
-    from ..attention import sdpa_reference
-
-    q, k, v = res
-    # recompute-based VJP through the XLA reference (numerically matches
-    # the kernel; scores never fully materialized thanks to XLA blocking
-    # under remat)
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: sdpa_reference(q_, k_, v_, None, scale=scale,
-                                          causal=causal), q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    return _flash_backward(q, k, v, o, lse, g, causal=causal, scale=scale)
 
 
 _flash_sdpa.defvjp(_flash_sdpa_fwd, _flash_sdpa_bwd)

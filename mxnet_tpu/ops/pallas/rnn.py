@@ -1,0 +1,279 @@
+"""Pallas fused LSTM/GRU recurrence kernels for TPU.
+
+Ref: src/operator/rnn.{cc,cu}, nn/cudnn/cudnn_rnn-inl.h — the cuDNN
+fused RNN. The BASELINE north star names this explicitly ("LSTM cell
+kernels → Pallas").
+
+TPU-native split (the same one cuDNN uses): the input projection
+``x @ Wi.T + bi + bh`` is a single big batched GEMM over all timesteps
+— left to XLA, which tiles it perfectly onto the MXU. What the compiler
+CANNOT fuse well is the sequential recurrence; that is the Pallas
+kernel here:
+
+- forward: grid over T; per step one (N,H)x(H,4H) MXU matmul + VPU
+  gate math, hidden/cell state living in VMEM scratch across grid
+  steps (Mosaic double-buffers the x_proj block DMAs automatically).
+- backward: a second Pallas kernel running the grid in reverse
+  (index_map ``T-1-t``), accumulating dWh in VMEM scratch and
+  producing per-step dgates for the XLA-side input-GEMM VJP.
+
+Forward saves post-activation gates + cell states (the cuDNN
+"reserveSpace" trick) so backward needs no recompute.
+
+Parity contract: `lstm_layer(x_proj, wh, h0, c0)` == the lax.scan
+reference in ops/rnn.py for the same flat-parameter layout; tested in
+interpret mode on CPU (tests/test_pallas_rnn.py).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _lstm_fwd_kernel(xp_ref, wh_ref, h0_ref, c0_ref,
+                     ys_ref, hn_ref, cn_ref, gates_ref, cs_ref,
+                     h_scr, c_scr):
+    # gate-axis layout: xp (1,N,4,H), wh (4,H,H), gates (1,N,4,H).
+    # The 4 gates live on their own (sublane-side) axis, so no op ever
+    # slices or concatenates at a non-128 offset of the lane axis — the
+    # kernel is Mosaic-tileable for ANY H (DeepAR's H=40 included).
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[:] = h0_ref[:].astype(jnp.float32)
+        c_scr[:] = c0_ref[:].astype(jnp.float32)
+
+    h = h_scr[:]
+    c = c_scr[:]
+    # (N,H) x (4,H,H) -> (N,4,H): contract h's H with wh's LAST axis
+    # (wh[g] maps h -> gate g pre-activation, i.e. h @ wh[g].T)
+    gp = xp_ref[0].astype(jnp.float32) + jax.lax.dot_general(
+        h, wh_ref[:],
+        dimension_numbers=(((1,), (2,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    i = jax.nn.sigmoid(gp[:, 0, :])
+    f = jax.nn.sigmoid(gp[:, 1, :])
+    g = jnp.tanh(gp[:, 2, :])
+    o = jax.nn.sigmoid(gp[:, 3, :])
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+
+    h_scr[:] = h_new
+    c_scr[:] = c_new
+    ys_ref[0] = h_new.astype(ys_ref.dtype)
+    cs_ref[0] = c_new.astype(cs_ref.dtype)
+    gates_ref[0] = jnp.stack([i, f, g, o], axis=1).astype(gates_ref.dtype)
+    hn_ref[:] = h_new.astype(hn_ref.dtype)
+    cn_ref[:] = c_new.astype(cn_ref.dtype)
+
+
+def _lstm_forward(x_proj, wh, h0, c0):
+    T, N, G4 = x_proj.shape
+    H = wh.shape[1]
+    xp4 = x_proj.reshape(T, N, 4, H)
+    wh4 = wh.reshape(4, H, H)
+    outs = pl.pallas_call(
+        _lstm_fwd_kernel,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, N, 4, H), lambda t: (t, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((4, H, H), lambda t: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((N, H), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((N, H), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=(
+            jax.ShapeDtypeStruct((T, N, H), x_proj.dtype),    # ys
+            jax.ShapeDtypeStruct((N, H), x_proj.dtype),       # h_n
+            jax.ShapeDtypeStruct((N, H), x_proj.dtype),       # c_n
+            jax.ShapeDtypeStruct((T, N, 4, H), jnp.float32),  # gates ifgo
+            jax.ShapeDtypeStruct((T, N, H), jnp.float32),     # c states
+        ),
+        out_specs=(
+            pl.BlockSpec((1, N, H), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((N, H), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((N, H), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, N, 4, H), lambda t: (t, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, N, H), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((N, H), jnp.float32),
+            pltpu.VMEM((N, H), jnp.float32),
+        ],
+    )(xp4, wh4, h0, c0)
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _lstm_bwd_kernel(dy_ref, gates_ref, cs_ref, cprev_ref, hprev_ref,
+                     wh_ref, dhn_ref, dcn_ref,
+                     dxp_ref, dwh_ref, dh0_ref, dc0_ref,
+                     dh_scr, dc_scr, dwh_scr):
+    # grid index runs 0..T-1 but index_maps feed step t = T-1-idx
+    idx = pl.program_id(0)
+
+    @pl.when(idx == 0)
+    def _():
+        dh_scr[:] = dhn_ref[:].astype(jnp.float32)
+        dc_scr[:] = dcn_ref[:].astype(jnp.float32)
+        dwh_scr[:] = jnp.zeros_like(dwh_scr)
+
+    dh = dh_scr[:] + dy_ref[0].astype(jnp.float32)
+    gates = gates_ref[0]                      # (N, 4, H) post-activation
+    i = gates[:, 0, :]
+    f = gates[:, 1, :]
+    g = gates[:, 2, :]
+    o = gates[:, 3, :]
+    c_t = cs_ref[0]
+    c_prev = cprev_ref[0]
+    tc = jnp.tanh(c_t)
+
+    do = dh * tc
+    dc = dh * o * (1.0 - tc * tc) + dc_scr[:]
+    di = dc * g
+    dg = dc * i
+    df = dc * c_prev
+    dgp = jnp.stack([
+        di * i * (1.0 - i),
+        df * f * (1.0 - f),
+        dg * (1.0 - g * g),
+        do * o * (1.0 - o),
+    ], axis=1)                                # (N, 4, H) pre-act grads
+
+    # param grads: dWh[g] += dgp[:,g,:].T @ h_prev -> (4, H, H)
+    dwh_scr[:] += jax.lax.dot_general(
+        dgp, hprev_ref[0].astype(jnp.float32),
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    # dh_prev = sum_g dgp[:,g,:] @ wh[g] : contract (gate, lane) pairs
+    dh_scr[:] = jax.lax.dot_general(
+        dgp, wh_ref[:],
+        dimension_numbers=(((1, 2), (0, 1)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dc_scr[:] = dc * f
+
+    dxp_ref[0] = dgp.astype(dxp_ref.dtype)
+    dwh_ref[:] = dwh_scr[:].astype(dwh_ref.dtype)
+    dh0_ref[:] = dh_scr[:].astype(dh0_ref.dtype)
+    dc0_ref[:] = dc_scr[:].astype(dc0_ref.dtype)
+
+
+def _lstm_backward(wh, h0, c0, ys, gates, cs, dys, dhn, dcn):
+    T, N = gates.shape[0], gates.shape[1]
+    H = wh.shape[1]
+    wh4 = wh.reshape(4, H, H)
+    f32 = jnp.float32
+    # h_prev / c_prev sequences (cuDNN reserve-space equivalents)
+    h_prev = jnp.concatenate([h0[None].astype(f32), ys[:-1].astype(f32)], 0)
+    c_prev = jnp.concatenate([c0[None].astype(f32), cs[:-1]], 0)
+
+    rev3 = lambda t: (T - 1 - t, 0, 0)     # noqa: E731
+    rev4 = lambda t: (T - 1 - t, 0, 0, 0)  # noqa: E731
+    outs = pl.pallas_call(
+        _lstm_bwd_kernel,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, N, H), rev3, memory_space=pltpu.VMEM),  # dy
+            pl.BlockSpec((1, N, 4, H), rev4,
+                         memory_space=pltpu.VMEM),                   # gates
+            pl.BlockSpec((1, N, H), rev3, memory_space=pltpu.VMEM),  # c_t
+            pl.BlockSpec((1, N, H), rev3,
+                         memory_space=pltpu.VMEM),                   # c_prev
+            pl.BlockSpec((1, N, H), rev3,
+                         memory_space=pltpu.VMEM),                   # h_prev
+            pl.BlockSpec((4, H, H), lambda t: (0, 0, 0),
+                         memory_space=pltpu.VMEM),                   # wh
+            pl.BlockSpec((N, H), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),                   # dh_n
+            pl.BlockSpec((N, H), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),                   # dc_n
+        ],
+        out_shape=(
+            jax.ShapeDtypeStruct((T, N, 4, H), jnp.float32),  # dx_proj
+            jax.ShapeDtypeStruct((4, H, H), jnp.float32),     # dwh
+            jax.ShapeDtypeStruct((N, H), jnp.float32),        # dh0
+            jax.ShapeDtypeStruct((N, H), jnp.float32),        # dc0
+        ),
+        out_specs=(
+            pl.BlockSpec((1, N, 4, H), rev4, memory_space=pltpu.VMEM),
+            pl.BlockSpec((4, H, H), lambda t: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((N, H), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((N, H), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((N, H), jnp.float32),
+            pltpu.VMEM((N, H), jnp.float32),
+            pltpu.VMEM((4, H, H), jnp.float32),
+        ],
+    )(dys, gates, cs, c_prev, h_prev, wh4, dhn, dcn)
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# public op with custom VJP
+# ---------------------------------------------------------------------------
+
+@jax.custom_vjp
+def lstm_layer(x_proj, wh, h0, c0):
+    """One LSTM layer/direction over time.
+
+    x_proj: (T, N, 4H) input projection ``x @ Wi.T + bi + bh`` (both
+    biases folded — they are additive constants in the pre-activation).
+    wh: (4H, H); h0, c0: (N, H). Gate order i, f, g, o (the reference's
+    canonical LSTM layout). Returns (ys (T,N,H), h_n, c_n).
+    """
+    ys, hn, cn, _, _ = _lstm_forward(x_proj, wh, h0, c0)
+    return ys, hn, cn
+
+
+def _lstm_fwd_rule(x_proj, wh, h0, c0):
+    ys, hn, cn, gates, cs = _lstm_forward(x_proj, wh, h0, c0)
+    return (ys, hn, cn), (wh, h0, c0, ys, gates, cs)
+
+
+def _lstm_bwd_rule(res, cotangents):
+    wh, h0, c0, ys, gates, cs = res
+    dys, dhn, dcn = cotangents
+    dys = jnp.zeros_like(ys) if _is_zero(dys) else dys
+    dhn = jnp.zeros_like(h0) if _is_zero(dhn) else dhn
+    dcn = jnp.zeros_like(c0) if _is_zero(dcn) else dcn
+    dxp, dwh, dh0, dc0 = _lstm_backward(
+        wh, h0, c0, ys, gates, cs,
+        dys.astype(jnp.float32), dhn, dcn)
+    T, N = dxp.shape[0], dxp.shape[1]
+    H = wh.shape[1]
+    # back to the packed (T,N,4H) / (4H,H) caller layout
+    return (dxp.reshape(T, N, 4 * H).astype(ys.dtype),
+            dwh.reshape(4 * H, H).astype(wh.dtype),
+            dh0.astype(h0.dtype), dc0.astype(c0.dtype))
+
+
+def _is_zero(x):
+    return x is None or isinstance(
+        x, jax.custom_derivatives.SymbolicZero)
+
+
+lstm_layer.defvjp(_lstm_fwd_rule, _lstm_bwd_rule)

@@ -104,6 +104,47 @@ def _scan_dir(step, xs, init, wi, wh, bi, bh, reverse):
     return carry, ys
 
 
+def _use_pallas_lstm():
+    """Pallas recurrence kernel on TPU (MXTPU_RNN_IMPL=auto|pallas|scan)."""
+    from ..base import getenv
+
+    impl = getenv("RNN_IMPL", "auto").lower()
+    if impl == "scan":
+        return False
+    if impl == "pallas":
+        return True
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _pallas_lstm_fits(N, H, G=4):
+    """Static VMEM guard: the kernel holds Wh (G*H,H) + an x_proj block
+    (N,G*H) + states/gates, double-buffered by Mosaic. Stay well under
+    the ~16 MB/core VMEM or fall back to lax.scan (same guard idea as
+    flash-attention's _tiles_ok)."""
+    est = 4 * (G * H * H          # Wh
+               + 3 * N * G * H    # x_proj block + gates out + dgates
+               + 6 * N * H)       # h/c scratch + ys/cs blocks
+    return 2 * est < 12 * 1024 * 1024
+
+
+def _pallas_lstm_dir(xs, init, wi, wh, bi, bh, reverse):
+    """cuDNN-style split: time-batched input GEMM in XLA (MXU-tiled),
+    sequential recurrence in the Pallas kernel (ops/pallas/rnn.py)."""
+    from .pallas.rnn import lstm_layer
+
+    if reverse:
+        xs = jnp.flip(xs, axis=0)
+    x_proj = xs @ wi.T + (bi + bh)
+    h0, c0 = init
+    ys, hn, cn = lstm_layer(x_proj, wh, h0, c0)
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    return (hn, cn), ys
+
+
 def _k_rnn(data, parameters, state, state_cell=None, key=None, *,
            state_size, num_layers, mode="lstm", bidirectional=False,
            p=0.0, state_outputs=False, projection_size=None,
@@ -117,6 +158,7 @@ def _k_rnn(data, parameters, state, state_cell=None, key=None, *,
     step = _step_fn(mode)
     is_lstm = mode == "lstm"
 
+    pallas_lstm = is_lstm and _use_pallas_lstm()
     x = data
     h_states, c_states = [], []
     for layer in range(num_layers):
@@ -126,8 +168,13 @@ def _k_rnn(data, parameters, state, state_cell=None, key=None, *,
             idx = layer * d + dd
             h0 = state[idx]
             init = (h0, state_cell[idx]) if is_lstm else (h0,)
-            carry, ys = _scan_dir(step, x, init, wi, wh, bi, bh,
-                                  reverse=(dd == 1))
+            if pallas_lstm and _pallas_lstm_fits(N, H):
+                # kernel takes Wh as (4H, H); its step does dgp @ Wh
+                carry, ys = _pallas_lstm_dir(x, init, wi, wh, bi, bh,
+                                             reverse=(dd == 1))
+            else:
+                carry, ys = _scan_dir(step, x, init, wi, wh, bi, bh,
+                                      reverse=(dd == 1))
             outs.append(ys)
             h_states.append(carry[0])
             if is_lstm:

@@ -1,0 +1,246 @@
+"""Parameter-server transport for dist_async (ref: 3rdparty/ps-lite
+Van/KVWorker/KVServer + src/kvstore/kvstore_dist_server.h).
+
+The reference's dist_async semantics: each worker's push triggers a
+server-side merge/update IMMEDIATELY (no barrier, no waiting for the
+other workers); pulls return whatever the server holds right now.
+Synchronous collectives cannot express that, so — like the reference —
+async rides a real transport: a threaded TCP KV server. dist_sync stays
+on the in-graph DCN collective path (parallel/dist.py), which is the
+right shape for TPU pods; this server is the DCN-async escape hatch and
+runs anywhere (the nightly tests drive it multi-process on CPU).
+
+Protocol: length-prefixed pickled tuples, trusted-cluster only (same
+trust model as ps-lite's raw ZMQ). Ops:
+  ("init", key, array)      -> set-if-absent (idempotent)
+  ("push", key, array)      -> merge: optimizer(key, grad, weight) if a
+                               server-side optimizer is set (the
+                               update_on_kvstore semantic), else +=
+  ("pull", key)             -> current value
+  ("set_optimizer", bytes)  -> install pickled optimizer (worker 0)
+  ("stop",)                 -> shut down
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+
+import numpy as np
+
+from ..base import MXNetError
+
+
+def _send_frame(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock):
+    (n,) = struct.unpack("<Q", _recv_exact(sock, 8))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class PSServer:
+    """The KVServer role (ref: KVStoreDistServer::Run DataHandleEx)."""
+
+    def __init__(self, port, host="0.0.0.0"):
+        self._store = {}           # key -> np.ndarray (weights)
+        self._updater = None       # server-side optimizer updater
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        msg = _recv_frame(self.request)
+                        reply = outer._handle(msg)
+                        _send_frame(self.request, reply)
+                        if msg[0] == "stop":
+                            # shutdown() from this handler thread is safe
+                            # (serve_forever runs in its own thread) and
+                            # unblocks run_server's join
+                            threading.Thread(target=outer.stop,
+                                             daemon=True).start()
+                            return
+                except (ConnectionError, OSError):
+                    return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+    def _handle(self, msg):
+        op = msg[0]
+        with self._lock:
+            if op == "init":
+                _, key, arr = msg
+                self._store.setdefault(key, np.array(arr, copy=True))
+                return ("ok",)
+            if op == "push":
+                _, key, grad = msg
+                if key not in self._store:
+                    return ("err", f"key {key} not initialized")
+                if self._updater is not None:
+                    # per-push server-side optimizer: THE async semantic
+                    # (ref: kvstore_dist_server.h DataHandleDefault,
+                    # sync_mode_=false branch)
+                    from ..ndarray import ndarray as _nd
+
+                    w = _nd.array(self._store[key])
+                    self._updater(_ps_key_index(key), _nd.array(grad), w)
+                    self._store[key] = np.asarray(w.asnumpy())
+                else:
+                    self._store[key] = self._store[key] + np.asarray(grad)
+                return ("ok",)
+            if op == "pull":
+                _, key = msg
+                if key not in self._store:
+                    return ("err", f"key {key} not initialized")
+                return ("ok", self._store[key])
+            if op == "set_optimizer":
+                from .. import optimizer as _opt
+
+                self._updater = _opt.get_updater(pickle.loads(msg[1]))
+                return ("ok",)
+            if op == "stop":
+                return ("ok",)
+        return ("err", f"unknown op {op!r}")
+
+
+def _ps_key_index(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k
+
+
+class PSClient:
+    """The KVWorker role (ref: ps::KVWorker push/pull).
+
+    Keys are sharded over the server group by hash (ref: ps-lite's
+    key→server range partitioning); optimizer installs broadcast to
+    every server."""
+
+    def __init__(self, endpoints, timeout=60):
+        if isinstance(endpoints, tuple) and isinstance(endpoints[0], str):
+            endpoints = [endpoints]
+        self._socks = [socket.create_connection((h, p), timeout=timeout)
+                       for h, p in endpoints]
+        self._locks = [threading.Lock() for _ in self._socks]
+
+    def _server_of(self, key):
+        import zlib
+
+        return zlib.crc32(str(key).encode()) % len(self._socks)
+
+    def _call_on(self, i, *msg):
+        with self._locks[i]:
+            _send_frame(self._socks[i], msg)
+            reply = _recv_frame(self._socks[i])
+        if reply[0] != "ok":
+            raise MXNetError(f"ps server error: {reply[1:]}")
+        return reply[1] if len(reply) > 1 else None
+
+    def _call(self, op, key, *rest):
+        return self._call_on(self._server_of(key), op, key, *rest)
+
+    def init(self, key, arr):
+        self._call("init", key, np.asarray(arr))
+
+    def push(self, key, grad):
+        self._call("push", key, np.asarray(grad))
+
+    def pull(self, key):
+        return self._call("pull", key)
+
+    def set_optimizer(self, optimizer):
+        blob = pickle.dumps(optimizer)
+        for i in range(len(self._socks)):
+            self._call_on(i, "set_optimizer", blob)
+
+    def stop_server(self):
+        for i in range(len(self._socks)):
+            self._call_on(i, "stop")
+
+    def close(self):
+        for s in self._socks:
+            s.close()
+
+
+_server_singleton = None
+
+
+def server_endpoints():
+    """[(host, port), ...] of the PS group for this job.
+
+    Dedicated server roles if tools/launch.py spawned them
+    (DMLC_PS_SERVER_PORT base + DMLC_NUM_SERVER consecutive ports);
+    otherwise worker 0 hosts one in-process server thread on
+    root_port+1 — the local-launcher degenerate mode.
+    """
+    host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+    base = int(os.environ.get(
+        "DMLC_PS_SERVER_PORT",
+        int(os.environ.get("DMLC_PS_ROOT_PORT", "9099")) + 1))
+    n = max(1, int(os.environ.get("DMLC_NUM_SERVER", "0") or 0))
+    if "DMLC_PS_SERVER_PORT" not in os.environ:
+        n = 1  # embedded single-server mode
+    return [(host, base + i) for i in range(n)]
+
+
+def ensure_local_server():
+    """Start the in-process server on worker 0 when no dedicated server
+    role exists. Idempotent."""
+    global _server_singleton
+    if _server_singleton is None:
+        (_, port), = server_endpoints()
+        _server_singleton = PSServer(port).start()
+    return _server_singleton
+
+
+def run_server():
+    """Blocking server loop for a dedicated DMLC_ROLE=server process
+    (ref: MXKVStoreRunServer / kvstore_server.py).
+
+    The PS is a host-side role: its optimizer updates run on XLA:CPU.
+    Pinning the platform here also keeps the server off the TPU tunnel
+    (a server process must come up even when the accelerator is wedged).
+    """
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # backend already initialized by the embedding process
+    host, base = server_endpoints()[0]
+    my_id = int(os.environ.get("DMLC_SERVER_ID", "0"))
+    srv = PSServer(base + my_id, host="0.0.0.0").start()
+    srv._thread.join()

@@ -1,0 +1,80 @@
+"""dist_async kvstore test (ref: tests/nightly/dist_async_kvstore.py).
+
+Asserts the ASYNC semantics that distinguish it from dist_sync:
+a worker's push is merged by the server immediately and a pull right
+after sees it WITHOUT waiting for other workers (no barrier). A
+file-based handshake makes the interleaving deterministic:
+
+  worker 0: push(+1) -> pull -> must see ONLY its own push -> marker
+  worker 1: wait for marker -> push(+2) -> pull -> sees both pushes
+  both:     final barrier -> pull -> eventual sum
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from mxnet_tpu.parallel import dist  # noqa: E402
+
+dist.init()
+
+import numpy as np  # noqa: E402
+
+from mxnet_tpu import kvstore, nd  # noqa: E402
+
+kv = kvstore.create("dist_async")
+rank, size = kv.rank, kv.num_workers
+assert size == 2, f"this test is written for 2 workers, got {size}"
+marker = os.path.join(os.environ.get("MXTPU_TEST_TMPDIR", "/tmp"),
+                      f"dist_async_marker_{os.environ['DMLC_PS_ROOT_PORT']}")
+
+kv.init("w", nd.zeros((4,)))
+kv.barrier()  # only to make init-before-push deterministic
+
+if rank == 0:
+    kv.push("w", [nd.ones((4,))])
+    out = nd.zeros((4,))
+    kv.pull("w", out=out)
+    # no barrier happened: worker 1 has not pushed yet (it waits on the
+    # marker), so the server value is exactly our own contribution
+    assert np.allclose(out.asnumpy(), 1.0), out.asnumpy()
+    with open(marker, "w") as f:
+        f.write("go")
+else:
+    for _ in range(200):
+        if os.path.exists(marker):
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError("worker 0 never wrote the marker")
+    kv.push("w", [nd.ones((4,)) * 2])
+    out = nd.zeros((4,))
+    kv.pull("w", out=out)
+    # server already merged worker 0's earlier push
+    assert np.allclose(out.asnumpy(), 3.0), out.asnumpy()
+
+kv.barrier()
+final = nd.zeros((4,))
+kv.pull("w", out=final)
+assert np.allclose(final.asnumpy(), 3.0), final.asnumpy()
+
+# server-side optimizer: each push applies SGD immediately on the server
+# (ref: kvstore_dist_server.h DataHandleDefault async branch)
+import mxnet_tpu as mx  # noqa: E402
+
+kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0))
+kv.barrier()
+kv.push("w", [nd.ones((4,)) * (0.1 * (rank + 1))])
+kv.barrier()
+final2 = nd.zeros((4,))
+kv.pull("w", out=final2)
+# w = 3 - 1.0*(0.1 + 0.2)
+assert np.allclose(final2.asnumpy(), 2.7, atol=1e-5), final2.asnumpy()
+print(f"worker {rank}/{size}: dist_async kvstore OK (per-push merge, "
+      f"no barrier, server-side optimizer)")

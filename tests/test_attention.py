@@ -32,8 +32,9 @@ def test_flash_kernel_interpret_matches_reference():
     orig = pl.pallas_call
     try:
         pl.pallas_call = functools.partial(orig, interpret=True)
-        out = fa._flash_forward(q, k, v, causal=False, scale=scale)
-        out_causal = fa._flash_forward(q, k, v, causal=True, scale=scale)
+        out, _ = fa._flash_forward(q, k, v, causal=False, scale=scale)
+        out_causal, _ = fa._flash_forward(q, k, v, causal=True,
+                                          scale=scale)
     finally:
         pl.pallas_call = orig
 
@@ -43,6 +44,42 @@ def test_flash_kernel_interpret_matches_reference():
     ref_causal = sdpa_reference(q, k, v, causal=True)
     assert np.allclose(np.asarray(out_causal), np.asarray(ref_causal),
                        atol=2e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_backward_kernel_matches_reference(causal):
+    """The Pallas dQ/dK/dV kernels == XLA-autodiff oracle grads."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from mxnet_tpu.ops.attention import sdpa_reference
+    from mxnet_tpu.ops.pallas import flash_attention as fa
+
+    q, k, v = _qkv(s=256, d=128, seed=3)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    g = jnp.asarray(np.random.RandomState(4).rand(*q.shape), jnp.float32)
+
+    orig = pl.pallas_call
+    try:
+        pl.pallas_call = functools.partial(orig, interpret=True)
+        out, vjp = jax.vjp(
+            lambda q_, k_, v_: fa._flash_sdpa(q_, k_, v_, causal, scale),
+            q, k, v)
+        dq, dk, dv = vjp(g)
+    finally:
+        pl.pallas_call = orig
+
+    ref_out, ref_vjp = jax.vjp(
+        lambda q_, k_, v_: sdpa_reference(q_, k_, v_, None, scale=scale,
+                                          causal=causal), q, k, v)
+    rq, rk, rv = ref_vjp(g)
+    assert np.allclose(np.asarray(out), np.asarray(ref_out), atol=2e-3)
+    for a, b, name in [(dq, rq, "dq"), (dk, rk, "dk"), (dv, rv, "dv")]:
+        assert np.allclose(np.asarray(a), np.asarray(b), atol=5e-3), \
+            (name, np.abs(np.asarray(a) - np.asarray(b)).max())
 
 
 def test_flash_attention_fallback_unaligned():

@@ -22,3 +22,23 @@ def test_dist_sync_kvstore_two_workers():
     assert res.returncode == 0, out[-3000:]
     assert "worker 0/2: dist_sync kvstore OK" in out
     assert "worker 1/2: dist_sync kvstore OK" in out
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("num_servers", [0, 1])
+def test_dist_async_kvstore_two_workers(tmp_path, num_servers):
+    """num_servers=0: worker 0 hosts the PS thread; =1: dedicated
+    DMLC_ROLE=server process (ref: tools/launch.py -s)."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["MXTPU_TEST_TMPDIR"] = str(tmp_path)
+    res = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+         "-n", "2", "-s", str(num_servers), "--launcher", "local",
+         sys.executable,
+         os.path.join(_ROOT, "tests", "nightly", "dist_async_kvstore.py")],
+        capture_output=True, text=True, timeout=240, env=env, cwd=_ROOT)
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out[-3000:]
+    for r in (0, 1):
+        assert f"worker {r}/2: dist_async kvstore OK" in out

@@ -125,6 +125,105 @@ def test_spmd_tp_sharded_params():
     assert len(big.sharding.device_set) >= 2
 
 
+def test_spmd_zero_sharded_opt_states():
+    """shard_opt_states=True: Adam m/v live dp-sharded (ZeRO-1) and the
+    loss trajectory matches the replicated-state trainer exactly."""
+    def run(shard):
+        np.random.seed(5)
+        mx.random.seed(5)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(64, activation="relu"), nn.Dense(2))
+        net.initialize(mx.init.Xavier())
+        trainer = data_parallel.DataParallelTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+            {"learning_rate": 0.05}, shard_opt_states=shard)
+        X = np.random.RandomState(0).rand(64, 16).astype(np.float32)
+        Y = (X.sum(1) > 8).astype(np.float32)
+        losses = [float(trainer.step(X, Y).asscalar()) for _ in range(8)]
+        return trainer, losses
+
+    t_sharded, l_sharded = run(True)
+    t_repl, l_repl = run(False)
+    np.testing.assert_allclose(l_sharded, l_repl, rtol=1e-4)
+
+    # the big states must actually be partitioned over dp
+    dp = t_sharded.mesh.shape["dp"]
+    assert dp > 1
+    found_sharded = False
+    for st in t_sharded._states:
+        if st is None:
+            continue
+        m, v = st
+        if any(d % dp == 0 and d >= dp for d in m.shape):
+            assert "dp" in tuple(m.sharding.spec), m.sharding
+            nshards = len({s.device for s in m.addressable_shards})
+            assert nshards == dp
+            found_sharded = True
+    assert found_sharded
+    for st in t_repl._states:
+        if st is not None:
+            assert tuple(st[0].sharding.spec) in ((), (None,), (None, None))
+
+
+def test_spmd_checkpoint_resume(tmp_path):
+    """Kill-and-resume: save sharded params+opt state mid-training,
+    rebuild a fresh trainer, load, and reproduce the exact loss
+    trajectory of uninterrupted training (VERDICT §Next 6)."""
+    X = np.random.RandomState(7).rand(64, 16).astype(np.float32)
+    Y = (X.sum(1) > 8).astype(np.float32)
+
+    def fresh():
+        from mxnet_tpu.gluon.block import _BlockScope
+
+        # a resumed PROCESS restarts auto-prefix counters at zero; do the
+        # same here so checkpoint param names line up across instances
+        _BlockScope._counters.clear()
+        np.random.seed(9)
+        mx.random.seed(9)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(32, activation="relu"), nn.Dense(2))
+        net.initialize(mx.init.Xavier())
+        return data_parallel.DataParallelTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+            {"learning_rate": 0.05}, shard_opt_states=True)
+
+    # uninterrupted run: 8 steps
+    t0 = fresh()
+    ref = [float(t0.step(X, Y).asscalar()) for _ in range(8)]
+
+    # interrupted run: 5 steps, checkpoint, "crash", resume, 3 steps
+    t1 = fresh()
+    part1 = [float(t1.step(X, Y).asscalar()) for _ in range(5)]
+    prefix = str(tmp_path / "ckpt")
+    t1.save_states(prefix)
+    del t1
+
+    t2 = fresh()           # new process stand-in: fresh params
+    t2.build(X)
+    t2.load_states(prefix)
+    assert t2._t == 5
+    part2 = [float(t2.step(X, Y).asscalar()) for _ in range(3)]
+    np.testing.assert_allclose(part1 + part2, ref, rtol=1e-5)
+
+    # opt-state sharding survives the round trip
+    for st in t2._states:
+        if st is not None and any(d % 8 == 0 and d >= 8
+                                  for d in st[0].shape):
+            assert "dp" in tuple(st[0].sharding.spec)
+
+    # mesh-mismatch guard
+    import jax as _jax
+    from mxnet_tpu.parallel import mesh as mesh_mod
+
+    small = mesh_mod.make_mesh({"dp": 2}, devices=_jax.devices()[:2])
+    t3 = data_parallel.DataParallelTrainer(
+        fresh().block, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 0.05}, mesh=small)
+    t3.build(X)
+    with pytest.raises(mx.MXNetError):
+        t3.load_states(prefix)
+
+
 def test_gradient_compression_2bit():
     """2-bit threshold quantization with error feedback
     (ref: tests/nightly/dist_sync_kvstore.py --gc-type 2bit)."""

@@ -20,27 +20,51 @@ import subprocess
 import sys
 
 
-def launch_local(n, cmd, port):
-    procs = []
+def launch_local(n, cmd, port, num_servers=0):
+    common = {
+        "MXTPU_COORDINATOR": f"127.0.0.1:{port}",
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "MXTPU_NUM_WORKER": str(n), "DMLC_NUM_WORKER": str(n),
+        "DMLC_NUM_SERVER": str(num_servers),
+    }
+    if num_servers:
+        common["DMLC_PS_SERVER_PORT"] = str(port + 1)
+    servers, procs = [], []
+    for sid in range(num_servers):
+        # dedicated PS role (ref: dmlc-tracker server procs); serves the
+        # dist_async transport (mxnet_tpu/parallel/ps.py). Each server
+        # binds its own port (base + DMLC_SERVER_ID); clients shard keys
+        # across the group.
+        env = dict(os.environ)
+        env.update(common)
+        env["DMLC_ROLE"] = "server"
+        env["DMLC_SERVER_ID"] = str(sid)
+        servers.append(subprocess.Popen(
+            [sys.executable, "-m", "mxnet_tpu.kvstore_server"], env=env))
     for i in range(n):
         env = dict(os.environ)
-        env.update({
-            "MXTPU_COORDINATOR": f"127.0.0.1:{port}",
-            "DMLC_PS_ROOT_URI": "127.0.0.1",
-            "DMLC_PS_ROOT_PORT": str(port),
-            "MXTPU_NUM_WORKER": str(n), "DMLC_NUM_WORKER": str(n),
-            "MXTPU_WORKER_ID": str(i), "DMLC_WORKER_ID": str(i),
-            "DMLC_ROLE": "worker",
-        })
+        env.update(common)
+        env.update({"MXTPU_WORKER_ID": str(i), "DMLC_WORKER_ID": str(i),
+                    "DMLC_ROLE": "worker"})
         procs.append(subprocess.Popen(cmd, env=env))
     code = 0
     try:
         for p in procs:
             code |= p.wait()
+        for s in servers:
+            # a server that died mid-job (port clash, crash) fails the
+            # job even if workers limped through
+            if s.poll() is not None and s.returncode not in (0, -15):
+                code |= 1
     except KeyboardInterrupt:
         for p in procs:
             p.send_signal(signal.SIGTERM)
         code = 1
+    finally:
+        for s in servers:
+            if s.poll() is None:
+                s.send_signal(signal.SIGTERM)
     return code
 
 
@@ -76,8 +100,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("-n", "--num-workers", type=int, required=True)
     ap.add_argument("-s", "--num-servers", type=int, default=0,
-                    help="accepted for reference-CLI parity; the TPU "
-                         "build has no parameter servers (all-reduce)")
+                    help="dedicated parameter-server processes for the "
+                         "dist_async transport (dist_sync uses in-graph "
+                         "DCN all-reduce and needs none)")
     ap.add_argument("--launcher", choices=["local", "ssh"], default="local")
     ap.add_argument("-H", "--hostfile", default=None)
     ap.add_argument("-p", "--port", type=int, default=9099)
@@ -89,7 +114,8 @@ def main():
     if not cmd:
         ap.error("no command given")
     if args.launcher == "local":
-        sys.exit(launch_local(args.num_workers, cmd, args.port))
+        sys.exit(launch_local(args.num_workers, cmd, args.port,
+                              args.num_servers))
     hosts = [h.strip() for h in open(args.hostfile) if h.strip()]
     sys.exit(launch_ssh(hosts, args.num_workers, cmd, args.port))
 
