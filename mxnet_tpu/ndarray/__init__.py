@@ -14,13 +14,19 @@ from .ndarray import zeros_like, ones_like  # noqa: F401,E402
 
 
 class _ContribNamespace:
-    """mx.nd.contrib.X → the op registered as `_contrib_X`
-    (ref: python/mxnet generates the contrib submodule the same way)."""
+    """mx.nd.contrib.X → the op registered as `_contrib_X`, plus the
+    python-level control-flow operators (foreach/while_loop/cond take
+    callables, so they bypass the array-op registry — same split as
+    python/mxnet/ndarray/contrib.py)."""
 
     def __init__(self, mod):
         self._mod = mod
 
     def __getattr__(self, name):
+        if name in ("foreach", "while_loop", "cond"):
+            from . import control_flow
+
+            return getattr(control_flow, name)
         try:
             return getattr(self._mod, "_contrib_" + name)
         except AttributeError:

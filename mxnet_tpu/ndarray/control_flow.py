@@ -1,0 +1,131 @@
+"""Control-flow operators: foreach / while_loop / cond.
+
+Ref: src/operator/control_flow.cc (_foreach/_while_loop/_cond) +
+python/mxnet/ndarray/contrib.py wrappers. The reference runs subgraphs
+through the executor; TPU-native, the bodies lower onto
+``lax.scan`` / ``lax.while_loop`` / ``lax.cond`` so a hybridized block
+containing them compiles to ONE XLA while/conditional instead of a
+Python loop — exactly the "no data-dependent Python control flow under
+jit" rule.
+
+Bodies must be pure functions of their NDArray arguments (the same
+contract the reference's subgraph capture imposes).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .ndarray import NDArray, _wrap
+
+
+def _unwrap(x):
+    if isinstance(x, NDArray):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return [_unwrap(v) for v in x]
+    return x
+
+
+def _rewrap(x):
+    if isinstance(x, (list, tuple)):
+        return [_rewrap(v) for v in x]
+    return _wrap(x)
+
+
+def foreach(body, data, init_states):
+    """Iterate `body(data_t, states) -> (out_t, new_states)` over axis 0
+    of `data`; returns (stacked outs, final states).
+    Ref: mx.nd.contrib.foreach."""
+    single_data = isinstance(data, NDArray)
+    single_state = isinstance(init_states, NDArray)
+    xs = _unwrap(data if not single_data else [data])
+    states0 = _unwrap(init_states if not single_state else [init_states])
+
+    def scan_body(states, x_t):
+        xs_nd = [_wrap(v) for v in x_t]
+        st_nd = [_wrap(v) for v in states]
+        out, new_states = body(xs_nd[0] if single_data else xs_nd,
+                               st_nd[0] if single_state else st_nd)
+        out_raw = _unwrap(out if isinstance(out, (list, tuple)) else [out])
+        ns_raw = _unwrap(new_states
+                         if isinstance(new_states, (list, tuple))
+                         else [new_states])
+        return ns_raw, out_raw
+
+    final_states, outs = jax.lax.scan(scan_body, states0, xs)
+    outs_nd = [_wrap(o) for o in outs]
+    states_nd = [_wrap(s) for s in final_states]
+    return (outs_nd[0] if len(outs_nd) == 1 else outs_nd,
+            states_nd[0] if single_state else states_nd)
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Run `func(*loop_vars) -> (step_outputs, new_loop_vars)` while
+    `cond(*loop_vars)` holds, up to max_iterations. Returns (outputs
+    stacked over the iteration axis sized max_iterations — trailing
+    steps hold zeros, matching the reference's padded semantics — and
+    the final loop_vars). Ref: mx.nd.contrib.while_loop."""
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations (static "
+                         "bound; XLA while loops have no dynamic shape)")
+    single_var = isinstance(loop_vars, NDArray)
+    vars0 = _unwrap([loop_vars] if single_var else loop_vars)
+
+    # probe one application to size the output buffers
+    probe_out, _ = func(*[_wrap(v) for v in vars0]) \
+        if not single_var else func(_wrap(vars0[0]))
+    probe_list = probe_out if isinstance(probe_out, (list, tuple)) \
+        else [probe_out]
+    bufs0 = [jnp.zeros((int(max_iterations),) + tuple(p.shape),
+                       p._data.dtype) for p in probe_list]
+
+    def step(carry, _):
+        i, alive, vars_, bufs = carry
+        vars_nd = [_wrap(v) for v in vars_]
+        keep_going = jnp.logical_and(
+            alive, jnp.asarray(
+                cond(*vars_nd)._data if not single_var
+                else cond(vars_nd[0])._data, bool).reshape(()))
+        out, new_vars = (func(*vars_nd) if not single_var
+                         else func(vars_nd[0]))
+        out_list = _unwrap(out if isinstance(out, (list, tuple))
+                           else [out])
+        nv = _unwrap(new_vars if isinstance(new_vars, (list, tuple))
+                     else [new_vars])
+        vars_next = [jnp.where(keep_going, n, v)
+                     for n, v in zip(nv, vars_)]
+        bufs_next = [
+            jnp.where(keep_going, b.at[i].set(o), b)
+            for b, o in zip(bufs, out_list)]
+        return (i + 1, keep_going, vars_next, bufs_next), None
+
+    carry0 = (jnp.asarray(0), jnp.asarray(True), vars0, bufs0)
+    (n_steps, _, final_vars, bufs), _ = jax.lax.scan(
+        step, carry0, None, length=int(max_iterations))
+    outs_nd = [_wrap(b) for b in bufs]
+    vars_nd = [_wrap(v) for v in final_vars]
+    return (outs_nd[0] if len(outs_nd) == 1 else outs_nd,
+            vars_nd[0] if single_var else vars_nd)
+
+
+def cond(pred, then_func, else_func):
+    """lax.cond with NDArray branches: both branches trace; one
+    executes. Ref: mx.nd.contrib.cond."""
+    p = pred._data if isinstance(pred, NDArray) else jnp.asarray(pred)
+    p = jnp.asarray(p, bool).reshape(())
+
+    then_out = then_func()
+    else_out = else_func()
+    t_list = then_out if isinstance(then_out, (list, tuple)) \
+        else [then_out]
+    e_list = else_out if isinstance(else_out, (list, tuple)) \
+        else [else_out]
+    if len(t_list) != len(e_list):
+        raise MXNetError("cond branches must return the same structure")
+    outs = [jnp.where(p, t._data, e._data)
+            for t, e in zip(t_list, e_list)]
+    outs_nd = [_wrap(o) for o in outs]
+    return outs_nd[0] if not isinstance(then_out, (list, tuple)) \
+        else outs_nd

@@ -19,6 +19,10 @@ from ..ops import registry as _registry
 from ..ops import rnn as _rnn_ops  # noqa: F401
 from .. import operator as _custom_op_mod  # noqa: F401  (registers Custom)
 from ..ops import tensor as _tensor_ops  # noqa: F401
+from ..ops import linalg as _linalg_ops  # noqa: F401
+from ..ops import vision as _vision_ops  # noqa: F401
+from ..ops import multi as _multi_ops  # noqa: F401
+from ..ops import descriptors as _descriptors  # noqa: F401 (param docs)
 from .ndarray import NDArray, array
 
 __all__ = []
@@ -77,6 +81,7 @@ def make_op_wrapper(entry):
             attrs[k] = _norm_attr(v)
         if entry.train_aware:
             attrs.setdefault("_train", autograd.is_training())
+        entry.validate_attrs(attrs)
         if entry.validator is not None:
             entry.validator(arrays, attrs)
         if entry.needs_rng:
@@ -104,7 +109,7 @@ def make_op_wrapper(entry):
 
     wrapper.__name__ = entry.name
     wrapper.__qualname__ = entry.name
-    wrapper.__doc__ = entry.doc
+    wrapper.__doc__ = entry.build_doc()
     return wrapper
 
 

@@ -1,0 +1,150 @@
+"""Rich parameter descriptors for the headline ops.
+
+Ref: the DMLC_DECLARE_FIELD blocks in src/operator/nn/*.cc parameter
+structs (ConvolutionParam, PoolingParam, BatchNormParam, ...) — the
+defaults/ranges/docs that make `help(mx.nd.Convolution)`
+self-documenting. Ops without an explicit block here derive typed
+descriptors from their kernel signatures (registry.param_descriptors).
+"""
+from __future__ import annotations
+
+from .registry import Param, get
+
+
+def _attach(op, *params):
+    entry = get(op)
+    entry.params = {p.name: p for p in params}
+    entry._doc_cache = None
+
+
+def install():
+    _attach(
+        "Convolution",
+        Param("kernel", tuple, required=True,
+              doc="Convolution kernel size (h, w) or (d, h, w)."),
+        Param("stride", tuple, (), doc="Stride; defaults to 1 per dim."),
+        Param("dilate", tuple, (), doc="Dilation; defaults to 1 per dim."),
+        Param("pad", tuple, (), doc="Zero padding; defaults to 0 per dim."),
+        Param("num_filter", int, 0, low=0,
+              doc="Number of output channels."),
+        Param("num_group", int, 1, low=1,
+              doc="Grouped convolution group count."),
+        Param("no_bias", bool, True, doc="Skip the bias term."),
+        Param("layout", str, None,
+              choices=(None, "NCHW", "NCDHW", "NCW"),
+              doc="Data layout (channels-first only, the TPU-native "
+                  "canonical layout)."),
+        Param("cudnn_tune", str, None,
+              choices=(None, "off", "limited_workspace", "fastest"),
+              doc="Accepted for reference compatibility; XLA owns "
+                  "algorithm choice."),
+        Param("cudnn_off", bool, False,
+              doc="Accepted for reference compatibility."),
+        Param("workspace", int, 1024,
+              doc="Accepted for reference compatibility (MB)."),
+    )
+    _attach(
+        "FullyConnected",
+        Param("num_hidden", int, 0, low=1, required=True,
+              doc="Output feature size."),
+        Param("no_bias", bool, False, doc="Skip the bias term."),
+        Param("flatten", bool, True,
+              doc="Flatten trailing input dims; False applies the layer "
+                  "to the last axis only."),
+    )
+    _attach(
+        "Pooling",
+        Param("kernel", tuple, (), doc="Pooling window."),
+        Param("pool_type", str, "max",
+              choices=("max", "avg", "sum", "lp"),
+              doc="Pooling function."),
+        Param("global_pool", bool, False,
+              doc="Pool over the full spatial extent."),
+        Param("stride", tuple, (), doc="Stride; defaults to kernel."),
+        Param("pad", tuple, (), doc="Padding; defaults to 0."),
+        Param("pooling_convention", str, "valid",
+              choices=("valid", "full", "same"),
+              doc="Output-shape rounding convention."),
+        Param("count_include_pad", bool, True,
+              doc="avg pool: include padding positions in the divisor."),
+        Param("p_value", int, 2, low=1, doc="lp pool exponent."),
+    )
+    _attach(
+        "BatchNorm",
+        Param("eps", float, 1e-3, low=0.0, doc="Variance epsilon."),
+        Param("momentum", float, 0.9, low=0.0, high=1.0,
+              doc="Moving-average momentum."),
+        Param("fix_gamma", bool, True, doc="Hold gamma at 1."),
+        Param("use_global_stats", bool, False,
+              doc="Use moving stats in training too."),
+        Param("output_mean_var", bool, False,
+              doc="Also return (mean, var)."),
+        Param("axis", int, 1, doc="Channel axis."),
+    )
+    _attach(
+        "Activation",
+        Param("act_type", str, None, required=True,
+              choices=("relu", "sigmoid", "tanh", "softrelu",
+                       "softsign"),
+              doc="Nonlinearity to apply."),
+    )
+    _attach(
+        "LeakyReLU",
+        Param("act_type", str, "leaky",
+              choices=("leaky", "elu", "gelu", "selu", "prelu",
+                       "rrelu"),
+              doc="Leaky-family nonlinearity."),
+        Param("slope", float, 0.25, doc="Negative-half slope."),
+        Param("lower_bound", float, 0.125, doc="rrelu lower bound."),
+        Param("upper_bound", float, 0.334, doc="rrelu upper bound."),
+    )
+    _attach(
+        "Dropout",
+        Param("p", float, 0.5, low=0.0, high=1.0,
+              doc="Fraction of units dropped during training."),
+        Param("mode", str, "training", choices=("training", "always"),
+              doc="'always' applies dropout at inference too."),
+        Param("axes", tuple, (), doc="Broadcast-dropout axes."),
+    )
+    _attach(
+        "softmax",
+        Param("axis", int, -1, doc="Axis to normalize over."),
+        Param("temperature", float, None, doc="Logit divisor."),
+        Param("dtype", str, None, doc="Output dtype override."),
+    )
+    _attach(
+        "Embedding",
+        Param("input_dim", int, 0, low=1, required=True,
+              doc="Vocabulary size."),
+        Param("output_dim", int, 0, low=1, required=True,
+              doc="Embedding width."),
+        Param("dtype", str, "float32", doc="Embedding dtype."),
+        Param("sparse_grad", bool, False,
+              doc="Return a row_sparse gradient."),
+    )
+    _attach(
+        "LayerNorm",
+        Param("axis", int, -1, doc="Axis to normalize."),
+        Param("eps", float, 1e-5, low=0.0, doc="Variance epsilon."),
+        Param("output_mean_std", bool, False,
+              doc="Also return (mean, std)."),
+    )
+    _attach(
+        "RNN",
+        Param("state_size", int, 0, low=1, required=True,
+              doc="Hidden state width."),
+        Param("num_layers", int, 0, low=1, required=True,
+              doc="Stacked layer count."),
+        Param("mode", str, None, required=True,
+              choices=("rnn_relu", "rnn_tanh", "lstm", "gru"),
+              doc="Cell type (fused over the whole sequence; LSTM uses "
+                  "the Pallas recurrence kernel on TPU)."),
+        Param("bidirectional", bool, False, doc="Bidirectional stack."),
+        Param("p", float, 0.0, low=0.0, high=1.0,
+              doc="Inter-layer dropout."),
+        Param("state_outputs", bool, False,
+              doc="Also return final states."),
+    )
+
+
+install()

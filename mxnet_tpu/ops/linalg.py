@@ -1,0 +1,174 @@
+"""Linear-algebra operator family.
+
+Ref: src/operator/tensor/la_op.{cc,cu,-inl.h} — the linalg_* ops
+(BLAS3/LAPACK on mshadow streams). TPU-native: jnp.linalg/lax.linalg
+primitives; XLA lowers to MXU matmuls and vendored LAPACK-style
+routines, and every op is differentiable through jax autodiff (the
+reference hand-writes each backward in la_op-inl.h).
+
+Conventions follow the reference: matrices live in the last two axes,
+leading axes broadcast/batch; `transpose` flags swap the last two axes;
+triangular ops take `lower` (default True).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _t(x, flag):
+    return jnp.swapaxes(x, -1, -2) if flag else x
+
+
+def _k_gemm(A, B, C, *, transpose_a=False, transpose_b=False, alpha=1.0,
+            beta=1.0, axis=-2):
+    """C <- alpha * op(A) @ op(B) + beta * C (ref: linalg_gemm)."""
+    out = alpha * jnp.matmul(_t(A, transpose_a), _t(B, transpose_b))
+    return out + beta * C
+
+
+def _k_gemm2(A, B, *, transpose_a=False, transpose_b=False, alpha=1.0,
+             axis=-2):
+    """alpha * op(A) @ op(B) (ref: linalg_gemm2)."""
+    return alpha * jnp.matmul(_t(A, transpose_a), _t(B, transpose_b))
+
+
+def _k_potrf(A, *, lower=True):
+    """Cholesky factor (ref: linalg_potrf)."""
+    L = jnp.linalg.cholesky(A)
+    return L if lower else jnp.swapaxes(L, -1, -2)
+
+
+def _k_potri(A, *, lower=True):
+    """Inverse from a Cholesky factor: (L L^T)^-1 (ref: linalg_potri)."""
+    L = A if lower else jnp.swapaxes(A, -1, -2)
+    eye = jnp.broadcast_to(jnp.eye(L.shape[-1], dtype=L.dtype), L.shape)
+    Linv = jax.scipy.linalg.solve_triangular(L, eye, lower=True)
+    return jnp.swapaxes(Linv, -1, -2) @ Linv
+
+
+def _k_trsm(A, B, *, transpose=False, rightside=False, lower=True,
+            alpha=1.0):
+    """Solve op(A) X = alpha B (or X op(A) = alpha B) with triangular A
+    (ref: linalg_trsm)."""
+    from jax.scipy.linalg import solve_triangular
+
+    if rightside:
+        # X op(A) = alpha B  <=>  op(A)^T X^T = alpha B^T
+        sol = solve_triangular(
+            jnp.swapaxes(A, -1, -2), jnp.swapaxes(alpha * B, -1, -2),
+            trans=1 if transpose else 0, lower=not lower)
+        return jnp.swapaxes(sol, -1, -2)
+    return solve_triangular(A, alpha * B,
+                            trans=1 if transpose else 0, lower=lower)
+
+
+def _k_trmm(A, B, *, transpose=False, rightside=False, lower=True,
+            alpha=1.0):
+    """Triangular matmul: alpha op(tri(A)) @ B (ref: linalg_trmm)."""
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    tri = _t(tri, transpose)
+    return alpha * (B @ tri if rightside else tri @ B)
+
+
+def _k_syrk(A, *, transpose=False, alpha=1.0):
+    """alpha * A @ A^T (or A^T @ A) (ref: linalg_syrk)."""
+    At = jnp.swapaxes(A, -1, -2)
+    return alpha * ((At @ A) if transpose else (A @ At))
+
+
+def _k_sumlogdiag(A):
+    """sum(log(diag(A))) per matrix (ref: linalg_sumlogdiag)."""
+    d = jnp.diagonal(A, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(d), axis=-1)
+
+
+def _k_makediag(A, *, offset=0):
+    """Vector(s) -> diagonal matrix (ref: linalg_makediag)."""
+    return jnp.apply_along_axis(
+        lambda v: jnp.diag(v, k=offset), -1, A) \
+        if A.ndim > 1 else jnp.diag(A, k=offset)
+
+
+def _k_extractdiag(A, *, offset=0):
+    """Diagonal of matrix (ref: linalg_extractdiag)."""
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+def _k_maketrian(A, *, offset=0, lower=True):
+    """Packed vector -> triangular matrix (ref: linalg_maketrian)."""
+    n_pack = A.shape[-1]
+    # n*(n+1)/2 = n_pack (offset 0)
+    import math
+
+    n = int((math.isqrt(8 * n_pack + 1) - 1) // 2) + abs(offset)
+    rows, cols = jnp.tril_indices(n, k=offset) if lower \
+        else jnp.triu_indices(n, k=offset)
+    out_shape = A.shape[:-1] + (n, n)
+    out = jnp.zeros(out_shape, A.dtype)
+    return out.at[..., rows, cols].set(A)
+
+
+def _k_extracttrian(A, *, offset=0, lower=True):
+    """Triangle of matrix -> packed vector (ref: linalg_extracttrian)."""
+    n = A.shape[-1]
+    rows, cols = jnp.tril_indices(n, k=offset) if lower \
+        else jnp.triu_indices(n, k=offset)
+    return A[..., rows, cols]
+
+
+def _k_inverse(A):
+    """Matrix inverse (ref: linalg_inverse)."""
+    return jnp.linalg.inv(A)
+
+
+def _k_det(A):
+    """Determinant (ref: linalg_det)."""
+    return jnp.linalg.det(A)
+
+
+def _k_slogdet(A):
+    """(sign, log|det|) (ref: linalg_slogdet)."""
+    sign, logdet = jnp.linalg.slogdet(A)
+    return sign, logdet
+
+
+def _k_syevd(A):
+    """Symmetric eigendecomposition: (U, lambda) with A = U^T diag(l) U
+    (ref: linalg_syevd; note the reference returns row-eigenvector U)."""
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+register("linalg_gemm", _k_gemm, arg_names=("A", "B", "C"),
+         aliases=("_linalg_gemm",))
+register("linalg_potrf", _k_potrf, arg_names=("A",),
+         aliases=("_linalg_potrf",))
+register("linalg_potri", _k_potri, arg_names=("A",),
+         aliases=("_linalg_potri",))
+register("linalg_trsm", _k_trsm, arg_names=("A", "B"),
+         aliases=("_linalg_trsm",))
+register("linalg_trmm", _k_trmm, arg_names=("A", "B"),
+         aliases=("_linalg_trmm",))
+register("linalg_syrk", _k_syrk, arg_names=("A",),
+         aliases=("_linalg_syrk",))
+register("linalg_sumlogdiag", _k_sumlogdiag, arg_names=("A",),
+         aliases=("_linalg_sumlogdiag",))
+register("linalg_makediag", _k_makediag, arg_names=("A",),
+         aliases=("_linalg_makediag",))
+register("linalg_extractdiag", _k_extractdiag, arg_names=("A",),
+         aliases=("_linalg_extractdiag",))
+register("linalg_maketrian", _k_maketrian, arg_names=("A",),
+         aliases=("_linalg_maketrian",))
+register("linalg_extracttrian", _k_extracttrian, arg_names=("A",),
+         aliases=("_linalg_extracttrian",))
+register("linalg_inverse", _k_inverse, arg_names=("A",),
+         aliases=("_linalg_inverse",))
+register("linalg_det", _k_det, arg_names=("A",),
+         aliases=("_linalg_det",))
+register("linalg_slogdet", _k_slogdet, arg_names=("A",),
+         aliases=("_linalg_slogdet",), num_outputs=2)
+register("linalg_syevd", _k_syevd, arg_names=("A",),
+         aliases=("_linalg_syevd",), num_outputs=2)

