@@ -53,6 +53,11 @@ def __getattr__(name):
         mod = importlib.import_module(_LAZY[name], __name__)
         globals()[name] = mod
         return mod
+    if name == "AttrScope":
+        from .symbol import AttrScope
+
+        globals()["AttrScope"] = AttrScope
+        return AttrScope
     raise AttributeError(f"module 'mxnet_tpu' has no attribute {name!r}")
 
 

@@ -146,6 +146,10 @@ class Module(BaseModule):
         if isinstance(self._context, (list, tuple)):
             self._context = self._context[0]  # multi-device via kvstore TODO
         self._fixed_param_names = set(fixed_param_names or [])
+        # ref: Module(group2ctxs=...) → Executor::Bind group2ctx
+        if isinstance(group2ctxs, (list, tuple)):
+            group2ctxs = group2ctxs[0] if group2ctxs else None
+        self._group2ctxs = group2ctxs
         self._exec = None
         self._optimizer = None
         self._updater_states = {}
@@ -206,7 +210,8 @@ class Module(BaseModule):
                 req[name] = "null"
         aux = {n: _nd.zeros(s, ctx=self._context)
                for n, s in zip(aux_names, aux_shapes)}
-        self._exec = self._symbol.bind(self._context, args, grads, req, aux)
+        self._exec = self._symbol.bind(self._context, args, grads, req, aux,
+                                       group2ctx=self._group2ctxs)
         self.binded = True
         self.for_training = for_training
         if shared_module is not None and shared_module.params_initialized:

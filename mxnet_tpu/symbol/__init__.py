@@ -1,6 +1,6 @@
 """Symbolic frontend (ref: python/mxnet/symbol/)."""
 from .symbol import (Symbol, Executor, var, Variable, load, fromjson,  # noqa: F401
-                     Group)
+                     Group, AttrScope)
 from . import symbol as _symbol_mod
 from . import export  # noqa: F401
 from ..ndarray import _ContribNamespace

@@ -32,6 +32,42 @@ from .. import operator as _custom_op_mod  # noqa: F401  (registers Custom)
 _AUX_SLOTS = {"BatchNorm": (3, 4)}
 
 
+class AttrScope:
+    """Attribute scope for symbol construction (ref: mx.AttrScope,
+    python/mxnet/attribute.py).
+
+    Attributes set here are attached to every symbol created inside the
+    ``with`` block, stored as ``__key__`` node attrs so they never
+    collide with op kwargs.  The flagship use is manual model parallel:
+
+        with mx.AttrScope(ctx_group='stage1'):
+            h = mx.sym.FullyConnected(x, num_hidden=128)
+
+    then ``sym.bind(ctx, args, group2ctx={'stage1': mx.cpu(1)})`` places
+    stage1's ops on cpu(1) (ref: Executor::Bind group2ctx + nnvm
+    PlaceDevice pass).
+    """
+
+    _stack = [{}]
+
+    def __init__(self, **attrs):
+        self._attrs = {f"__{k}__": str(v) for k, v in attrs.items()}
+
+    def __enter__(self):
+        merged = dict(AttrScope._stack[-1])
+        merged.update(self._attrs)
+        AttrScope._stack.append(merged)
+        return self
+
+    def __exit__(self, *exc):
+        AttrScope._stack.pop()
+        return False
+
+    @staticmethod
+    def current_attrs():
+        return AttrScope._stack[-1]
+
+
 class _Node:
     __slots__ = ("op", "name", "attrs", "inputs")
 
@@ -124,7 +160,10 @@ class Symbol:
         return dict(self._node.attrs)
 
     def attr(self, key):
-        return self._node.attrs.get(key)
+        a = self._node.attrs
+        if key in a:
+            return a[key]
+        return a.get(f"__{key}__")
 
     def attr_dict(self):
         out = {}
@@ -216,7 +255,8 @@ class Symbol:
 
     def bind(self, ctx, args, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
-        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states,
+                        group2ctx=group2ctx)
 
     def get_backend_symbol(self, backend="TPU"):
         """Apply the backend's registered subgraph fusions
@@ -226,7 +266,7 @@ class Symbol:
         return build_subgraph(self, backend)
 
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
-                    **kwargs):
+                    group2ctx=None, **kwargs):
         """Allocate arrays from shapes + bind (ref: Executor::SimpleBind)."""
         ctx = ctx or current_context()
         arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
@@ -240,7 +280,8 @@ class Symbol:
                          for n, s in zip(arg_names, arg_shapes)}
         aux = {n: _nd.zeros(s, ctx=ctx)
                for n, s in zip(aux_names, aux_shapes)}
-        return Executor(self, ctx, args, args_grad, grad_req, aux)
+        return Executor(self, ctx, args, args_grad, grad_req, aux,
+                        group2ctx=group2ctx)
 
     # -- arithmetic sugar (mirrors NDArray) ---------------------------------
 
@@ -361,8 +402,8 @@ def _eval_graph(heads, feed, is_train=False, key=None):
         else:
             entry = _registry.get(n.op)
             ins = [vals[id(src)][oi] for src, oi in n.inputs]
-            attrs = dict(n.attrs)
-            attrs.pop("__num_outputs__", None)
+            attrs = {k: v for k, v in n.attrs.items()
+                     if not k.startswith("__")}
             if entry.train_aware:
                 attrs["_train"] = is_train
             if entry.needs_rng:
@@ -432,7 +473,8 @@ def _solve_param_shapes(heads, known):
             out_shapes[id(n)] = tuple(tuple(s) for s in in_shapes)
             continue
         entry = _registry.get(n.op)
-        attrs = dict(n.attrs)
+        attrs = {k: v for k, v in n.attrs.items()
+                 if not k.startswith("__")}
         if entry.train_aware:
             attrs["_train"] = False
         specs = [jax.ShapeDtypeStruct(tuple(s), np.float32)
@@ -513,9 +555,11 @@ def _fill_param_shapes(n, in_shapes, solved):
 
 class Executor:
     def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
-                 aux_states=None):
+                 aux_states=None, group2ctx=None):
         self._symbol = symbol
         self._ctx = ctx
+        self._group2ctx = dict(group2ctx) if group2ctx else None
+        self._placed = None  # per-node vjp state for group2ctx backward
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
         if isinstance(args, (list, tuple)):
@@ -541,6 +585,8 @@ class Executor:
                 self.arg_dict[k]._data = _as_nd(v)._data
             else:
                 self.arg_dict[k] = _as_nd(v)
+        if self._group2ctx:
+            return self._forward_placed(is_train)
         feed = {n: self.arg_dict[n]._data for n in self._arg_names}
         feed.update({n: self.aux_dict[n]._data for n in self._aux_names})
         key = _random.next_key()
@@ -556,9 +602,160 @@ class Executor:
         self._saved_feed = (names, raws, key, is_train)
         return self.outputs
 
+    # -- group2ctx placed execution (ref: nnvm PlaceDevice pass +
+    # GraphExecutor cross-device copy nodes; SURVEY §2.3 "MP (manual
+    # model parallel)").
+    #
+    # TPU-native realization: every op node is dispatched through the
+    # per-op executable cache with its inputs *committed* to the device
+    # its ctx_group maps to — XLA's compute-follows-data placement makes
+    # the op run there, and ``jax.device_put`` at group boundaries IS the
+    # auto-inserted cross-device copy.  Backward keeps one vjp closure
+    # per node (residuals live on that node's device) and walks the graph
+    # in reverse, transferring cotangents between devices the same way.
+
+    def _node_device(self, n):
+        import jax
+
+        grp = n.attrs.get("__ctx_group__")
+        ctx = self._group2ctx.get(grp, self._ctx) if grp \
+            else self._ctx
+        try:
+            return ctx.jax_device()
+        except Exception:
+            return jax.devices("cpu")[0]
+
+    def _forward_placed(self, is_train):
+        import jax
+
+        key = _random.next_key()
+        nodes = _topo_order([self._symbol._node])
+        vals = {}      # id(node) -> tuple of raw outputs (on node device)
+        vjps = {}      # id(node) -> vjp_fn over the node's array inputs
+        n_outs = {}    # id(node) -> number of outputs
+        for n in nodes:
+            if n.op is None:
+                src = self.arg_dict.get(n.name)
+                if src is None:
+                    src = self.aux_dict[n.name]
+                vals[id(n)] = (src._data,)
+                n_outs[id(n)] = 1
+                continue
+            if n.op == "_group":
+                vals[id(n)] = tuple(vals[id(s)][oi] for s, oi in n.inputs)
+                n_outs[id(n)] = len(n.inputs)
+                continue
+            entry = _registry.get(n.op)
+            dev = self._node_device(n)
+            ins = [jax.device_put(vals[id(s)][oi], dev)
+                   for s, oi in n.inputs]
+            attrs = {k: v for k, v in n.attrs.items()
+                     if not k.startswith("__")}
+            if entry.train_aware:
+                attrs["_train"] = is_train
+            extra = []
+            if entry.needs_rng:
+                while len(ins) + len(extra) < len(entry.arg_names):
+                    extra.append(None)
+                extra.append(jax.device_put(
+                    jax.random.fold_in(key, len(vals)), dev))
+            n_in = len(ins)
+            closed = (lambda e=entry, a=attrs, x=tuple(extra):
+                      (lambda *arrs: e.fn(*(list(arrs) + list(x)), **a)))()
+            out, vjp_fn = jax.vjp(closed, *ins)
+            out = tuple(out) if isinstance(out, (tuple, list)) else (out,)
+            vals[id(n)] = out
+            vjps[id(n)] = (vjp_fn, n_in)
+            n_outs[id(n)] = len(out)
+            if entry.mutate_aux:
+                for in_idx, out_idx in entry.mutate_aux:
+                    if in_idx < len(n.inputs):
+                        src, _ = n.inputs[in_idx]
+                        if src.op is None and src.name in self.aux_dict:
+                            self.aux_dict[src.name]._data = out[out_idx]
+        head = self._symbol._node
+        outs = vals[id(head)]
+        n_head = _n_outputs(head)
+        self.outputs = [_wrap(o) for o in outs[:n_head]]
+        self._placed = (nodes, vals, vjps, n_outs)
+        self._saved_feed = None
+        return self.outputs
+
+    def _backward_placed(self, out_grads):
+        import jax
+
+        nodes, vals, vjps, n_outs = self._placed
+        head = self._symbol._node
+        if out_grads is None:
+            cts_head = [np.ones(o.shape, o.dtype) for o in self.outputs]
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cts_head = [g._data for g in out_grads]
+        # id(node) -> list (per output) of accumulated cotangents
+        cots = {id(n): [None] * n_outs[id(n)] for n in nodes}
+        for i, c in enumerate(cts_head):
+            cots[id(head)][i] = c
+
+        def _acc(slot_list, i, val):
+            slot_list[i] = val if slot_list[i] is None \
+                else slot_list[i] + val
+
+        for n in reversed(nodes):
+            if n.op is None:
+                continue
+            node_cots = cots[id(n)]
+            if n.op == "_group":
+                for (s, oi), c in zip(n.inputs, node_cots):
+                    if c is None:
+                        continue
+                    if s.op is not None:
+                        c = jax.device_put(c, self._node_device(s))
+                    _acc(cots[id(s)], oi, c)
+                continue
+            if all(c is None for c in node_cots):
+                continue
+            outs_here = vals[id(n)]
+            full_cots = tuple(
+                c if c is not None else np.zeros(o.shape, o.dtype)
+                for c, o in zip(node_cots, outs_here))
+            vjp_fn, n_in = vjps[id(n)]
+            arg = full_cots if len(full_cots) > 1 else full_cots[0]
+            in_cts = vjp_fn(arg)
+            for (s, oi), c in zip(n.inputs, in_cts[:n_in]):
+                if c is None:
+                    continue
+                if s.op is not None:
+                    c = jax.device_put(c, self._node_device(s))
+                _acc(cots[id(s)], oi, c)
+        # variable gradients honour grad_req, land on the grad array's
+        # device (MXNet contract: args_grad ctx == args ctx)
+        for n in nodes:
+            if n.op is not None or n.name not in self.grad_dict:
+                continue
+            req = self._grad_req.get(n.name, "write")
+            if req == "null":
+                continue
+            g = cots[id(n)][0]
+            if g is None:
+                continue
+            dst = self.grad_dict[n.name]
+            dev = list(dst._data.devices())[0] \
+                if hasattr(dst._data, "devices") else None
+            if dev is not None:
+                g = jax.device_put(g, dev)
+            if req == "add":
+                dst._data = dst._data + g
+            else:
+                dst._data = g
+
     def backward(self, out_grads=None):
         import jax
 
+        if self._group2ctx:
+            if self._placed is None:
+                raise MXNetError("backward before forward")
+            return self._backward_placed(out_grads)
         if self._saved_feed is None:
             raise MXNetError("backward before forward")
         names, raws, key, is_train = self._saved_feed
@@ -655,7 +852,8 @@ def _as_nd(v):
 def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
         init=None, stype=None, **kwargs):
     """Create a variable symbol (ref: mx.sym.var/Variable)."""
-    attrs = dict(attr or {})
+    attrs = dict(AttrScope.current_attrs())
+    attrs.update(attr or {})
     if shape is not None:
         attrs["__shape__"] = tuple(shape)
     if dtype is not None:
@@ -670,6 +868,9 @@ def _make_op_symbol(op_name, input_syms, attrs, name=None):
     entry = _registry.get(op_name)
     name = name or _auto_name(entry.name)
     inputs = [(s._node, s._index) for s in input_syms]
+    scope = AttrScope.current_attrs()
+    if scope:
+        attrs = {**scope, **attrs}
     return Symbol(_Node(entry.name, name, attrs, inputs))
 
 
