@@ -39,6 +39,7 @@ _LAZY = {
     "mon": ".monitor",
     "contrib": ".contrib",
     "operator": ".operator",
+    "resource": ".resource",
     "storage": ".storage",
     "rnn": ".rnn",
     "viz": ".visualization",

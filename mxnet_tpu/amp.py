@@ -1,11 +1,12 @@
 """Automatic mixed precision (ref: python/mxnet/contrib/amp/ — fp16
 cast lists + dynamic loss scaling).
 
-TPU-native: the low-precision dtype is bfloat16, which shares float32's
-exponent range — so dynamic loss scaling is unnecessary (kept as an
-always-1 scaler for API parity).  ``init()`` flips matmul/conv-heavy
-ops to bf16 accumulation by casting block parameters; ``convert_model``
-casts a whole Gluon block.
+TPU-native: the preferred low-precision dtype is bfloat16, which shares
+float32's exponent range — under bf16 the dynamic scaler idles at
+scale 1.  fp16 mode gets the reference's REAL dynamic loss scaling
+(2^16 start, halve on overflow + skip update, double after a clean
+scale_window).  ``init()`` records the policy; ``convert_model`` casts
+a Gluon block (norm params stay fp32).
 """
 from __future__ import annotations
 
@@ -56,29 +57,101 @@ convert_hybrid_block = convert_model
 
 
 class LossScaler:
-    """API-parity loss scaler; bf16 needs no scaling (scale always 1)."""
+    """Dynamic loss scaler (ref: contrib/amp/loss_scaler.py).
 
-    def __init__(self, init_scale=1.0, scale_factor=2.0,
-                 scale_window=2000):
-        self.loss_scale = 1.0
+    fp16's 5-bit exponent underflows small gradients; scaling the loss
+    by ``loss_scale`` shifts gradients into range, and the optimizer
+    divides it back out.  On overflow (non-finite grads) the scale
+    halves and the update is skipped; after ``scale_window`` clean steps
+    it doubles.  bf16 shares fp32's exponent range and needs none of
+    this — pass ``init_scale=1`` (the bf16 default in ``scale_loss``).
+    """
+
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000, min_scale=1.0):
+        self.loss_scale = float(init_scale)
+        self._scale_factor = float(scale_factor)
+        self._scale_window = int(scale_window)
+        self._min_scale = float(min_scale)
+        self._unskipped = 0
+        # armed iff constructed with a real scale; stays armed even if
+        # overflows decay loss_scale down to 1.0 (the dynamics must keep
+        # running so the scale can recover and overflows keep skipping)
+        self.enabled = self.loss_scale != 1.0
 
     def scale(self, loss):
-        return loss
+        if self.loss_scale == 1.0:
+            return loss
+        return loss * self.loss_scale
 
     def unscale(self, grads):
-        return grads
+        if self.loss_scale == 1.0:
+            return grads
+        inv = 1.0 / self.loss_scale
+        if isinstance(grads, (list, tuple)):
+            return type(grads)(g * inv for g in grads)
+        return grads * inv
 
-    def update(self, overflow=False):
+    def has_overflow(self, grads):
+        """True if any gradient contains a non-finite value.
+
+        Device-side: one fused all-finite reduction per grad and a
+        SINGLE scalar readback (ref: multi_all_finite), not a full
+        D2H pull of every gradient.
+        """
+        import jax.numpy as jnp
+
+        flag = None
+        for g in grads:
+            if g is None:
+                continue
+            raw = g._data if hasattr(g, "_data") else jnp.asarray(g)
+            ok = jnp.all(jnp.isfinite(raw))
+            flag = ok if flag is None else jnp.logical_and(flag, ok)
+        return bool(not flag) if flag is not None else False
+
+    def update(self, overflow):
+        """Adjust the scale after a step; returns True iff the step
+        must be skipped (ref: LossScaler.update_scale)."""
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor,
+                                  self._min_scale)
+            self._unskipped = 0
+            return True
+        self._unskipped += 1
+        if self._unskipped >= self._scale_window:
+            self.loss_scale *= self._scale_factor
+            self._unskipped = 0
+        return False
+
+
+class _ScaleLossCtx:
+    def __init__(self, loss, trainer):
+        self._loss = loss
+        self._trainer = trainer
+
+    def __enter__(self):
+        tr = self._trainer
+        if getattr(tr, "_amp_loss_scaler", None) is None:
+            # bf16 needs no scaling; fp16 gets the reference's 2^16 start
+            init = 1.0 if _target_dtype == "bfloat16" else 2.0 ** 16
+            tr._amp_loss_scaler = LossScaler(init_scale=init)
+        scaler = tr._amp_loss_scaler
+        # the optimizer divides the scale back out via rescale_grad
+        tr._scale = tr._amp_original_scale / scaler.loss_scale
+        if isinstance(self._loss, (list, tuple)):
+            return type(self._loss)(scaler.scale(l) for l in self._loss)
+        return scaler.scale(self._loss)
+
+    def __exit__(self, *exc):
         return False
 
 
 def scale_loss(loss, trainer):
-    """Context manager parity shim (ref: amp.scale_loss)."""
-    class _Noop:
-        def __enter__(self):
-            return loss
-
-        def __exit__(self, *a):
-            return False
-
-    return _Noop()
+    """``with amp.scale_loss(loss, trainer) as scaled: scaled.backward()``
+    (ref: amp.scale_loss) — scales the loss, points the trainer's
+    rescale_grad at 1/scale, and arms the overflow-skip check in
+    ``Trainer._update``."""
+    if not hasattr(trainer, "_amp_original_scale"):
+        trainer._amp_original_scale = trainer._scale
+    return _ScaleLossCtx(loss, trainer)

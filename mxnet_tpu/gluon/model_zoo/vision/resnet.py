@@ -13,21 +13,23 @@ from ... import nn
 
 class BasicBlockV1(HybridBlock):
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
+                 layout="NCHW", **kwargs):
         super().__init__(**kwargs)
+        ax = -1 if layout[-1] == "C" else 1
         self.body = nn.HybridSequential()
         self.body.add(nn.Conv2D(channels, 3, stride, 1, use_bias=False,
-                                in_channels=in_channels))
-        self.body.add(nn.BatchNorm())
+                                in_channels=in_channels, layout=layout))
+        self.body.add(nn.BatchNorm(axis=ax))
         self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(channels, 3, 1, 1, use_bias=False))
-        self.body.add(nn.BatchNorm())
+        self.body.add(nn.Conv2D(channels, 3, 1, 1, use_bias=False,
+                                layout=layout))
+        self.body.add(nn.BatchNorm(axis=ax))
         if downsample:
             self.downsample = nn.HybridSequential()
             self.downsample.add(
                 nn.Conv2D(channels, 1, stride, use_bias=False,
-                          in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
+                          in_channels=in_channels, layout=layout))
+            self.downsample.add(nn.BatchNorm(axis=ax))
         else:
             self.downsample = None
 
@@ -41,23 +43,27 @@ class BasicBlockV1(HybridBlock):
 
 class BottleneckV1(HybridBlock):
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
+                 layout="NCHW", **kwargs):
         super().__init__(**kwargs)
+        ax = -1 if layout[-1] == "C" else 1
         self.body = nn.HybridSequential()
-        self.body.add(nn.Conv2D(channels // 4, 1, stride, use_bias=False))
-        self.body.add(nn.BatchNorm())
+        self.body.add(nn.Conv2D(channels // 4, 1, stride, use_bias=False,
+                                layout=layout))
+        self.body.add(nn.BatchNorm(axis=ax))
         self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(channels // 4, 3, 1, 1, use_bias=False))
-        self.body.add(nn.BatchNorm())
+        self.body.add(nn.Conv2D(channels // 4, 3, 1, 1, use_bias=False,
+                                layout=layout))
+        self.body.add(nn.BatchNorm(axis=ax))
         self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(channels, 1, 1, use_bias=False))
-        self.body.add(nn.BatchNorm())
+        self.body.add(nn.Conv2D(channels, 1, 1, use_bias=False,
+                                layout=layout))
+        self.body.add(nn.BatchNorm(axis=ax))
         if downsample:
             self.downsample = nn.HybridSequential()
             self.downsample.add(
                 nn.Conv2D(channels, 1, stride, use_bias=False,
-                          in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
+                          in_channels=in_channels, layout=layout))
+            self.downsample.add(nn.BatchNorm(axis=ax))
         else:
             self.downsample = None
 
@@ -71,17 +77,20 @@ class BottleneckV1(HybridBlock):
 
 class BasicBlockV2(HybridBlock):
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
+                 layout="NCHW", **kwargs):
         super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
+        ax = -1 if layout[-1] == "C" else 1
+        self.bn1 = nn.BatchNorm(axis=ax)
         self.conv1 = nn.Conv2D(channels, 3, stride, 1, use_bias=False,
-                               in_channels=in_channels)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = nn.Conv2D(channels, 3, 1, 1, use_bias=False)
+                               in_channels=in_channels, layout=layout)
+        self.bn2 = nn.BatchNorm(axis=ax)
+        self.conv2 = nn.Conv2D(channels, 3, 1, 1, use_bias=False,
+                               layout=layout)
         if downsample:
             self.downsample = nn.Conv2D(channels, 1, stride,
                                         use_bias=False,
-                                        in_channels=in_channels)
+                                        in_channels=in_channels,
+                                        layout=layout)
         else:
             self.downsample = None
 
@@ -100,18 +109,23 @@ class BasicBlockV2(HybridBlock):
 
 class BottleneckV2(HybridBlock):
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
+                 layout="NCHW", **kwargs):
         super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = nn.Conv2D(channels // 4, 1, 1, use_bias=False)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = nn.Conv2D(channels // 4, 3, stride, 1, use_bias=False)
-        self.bn3 = nn.BatchNorm()
-        self.conv3 = nn.Conv2D(channels, 1, 1, use_bias=False)
+        ax = -1 if layout[-1] == "C" else 1
+        self.bn1 = nn.BatchNorm(axis=ax)
+        self.conv1 = nn.Conv2D(channels // 4, 1, 1, use_bias=False,
+                               layout=layout)
+        self.bn2 = nn.BatchNorm(axis=ax)
+        self.conv2 = nn.Conv2D(channels // 4, 3, stride, 1, use_bias=False,
+                               layout=layout)
+        self.bn3 = nn.BatchNorm(axis=ax)
+        self.conv3 = nn.Conv2D(channels, 1, 1, use_bias=False,
+                               layout=layout)
         if downsample:
             self.downsample = nn.Conv2D(channels, 1, stride,
                                         use_bias=False,
-                                        in_channels=in_channels)
+                                        in_channels=in_channels,
+                                        layout=layout)
         else:
             self.downsample = None
 
@@ -142,34 +156,36 @@ resnet_spec = {
 
 class ResNetV1(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000,
-                 thumbnail=False, **kwargs):
+                 thumbnail=False, layout="NCHW", **kwargs):
         super().__init__(**kwargs)
+        ax = -1 if layout[-1] == "C" else 1
         self.features = nn.HybridSequential()
         if thumbnail:
             self.features.add(nn.Conv2D(channels[0], 3, 1, 1,
-                                        use_bias=False))
+                                        use_bias=False, layout=layout))
         else:
             self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                        use_bias=False))
-            self.features.add(nn.BatchNorm())
+                                        use_bias=False, layout=layout))
+            self.features.add(nn.BatchNorm(axis=ax))
             self.features.add(nn.Activation("relu"))
-            self.features.add(nn.MaxPool2D(3, 2, 1))
+            self.features.add(nn.MaxPool2D(3, 2, 1, layout=layout))
         for i, num_layer in enumerate(layers):
             stride = 1 if i == 0 else 2
             self.features.add(self._make_layer(
                 block, num_layer, channels[i + 1], stride,
-                in_channels=channels[i]))
-        self.features.add(nn.GlobalAvgPool2D())
+                in_channels=channels[i], layout=layout))
+        self.features.add(nn.GlobalAvgPool2D(layout=layout))
         self.output = nn.Dense(classes)
 
     def _make_layer(self, block, num_layers, channels, stride,
-                    in_channels=0):
+                    in_channels=0, layout="NCHW"):
         layer = nn.HybridSequential()
         layer.add(block(channels, stride,
                         downsample=(channels != in_channels or stride != 1),
-                        in_channels=in_channels))
+                        in_channels=in_channels, layout=layout))
         for _ in range(num_layers - 1):
-            layer.add(block(channels, 1, False, in_channels=channels))
+            layer.add(block(channels, 1, False, in_channels=channels,
+                            layout=layout))
         return layer
 
     def hybrid_forward(self, F, x):
@@ -179,27 +195,28 @@ class ResNetV1(HybridBlock):
 
 class ResNetV2(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000,
-                 thumbnail=False, **kwargs):
+                 thumbnail=False, layout="NCHW", **kwargs):
         super().__init__(**kwargs)
+        ax = -1 if layout[-1] == "C" else 1
         self.features = nn.HybridSequential()
-        self.features.add(nn.BatchNorm(scale=False, center=False))
+        self.features.add(nn.BatchNorm(axis=ax, scale=False, center=False))
         if thumbnail:
             self.features.add(nn.Conv2D(channels[0], 3, 1, 1,
-                                        use_bias=False))
+                                        use_bias=False, layout=layout))
         else:
             self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                        use_bias=False))
-            self.features.add(nn.BatchNorm())
+                                        use_bias=False, layout=layout))
+            self.features.add(nn.BatchNorm(axis=ax))
             self.features.add(nn.Activation("relu"))
-            self.features.add(nn.MaxPool2D(3, 2, 1))
+            self.features.add(nn.MaxPool2D(3, 2, 1, layout=layout))
         for i, num_layer in enumerate(layers):
             stride = 1 if i == 0 else 2
             self.features.add(self._make_layer(
                 block, num_layer, channels[i + 1], stride,
-                in_channels=channels[i]))
-        self.features.add(nn.BatchNorm())
+                in_channels=channels[i], layout=layout))
+        self.features.add(nn.BatchNorm(axis=ax))
         self.features.add(nn.Activation("relu"))
-        self.features.add(nn.GlobalAvgPool2D())
+        self.features.add(nn.GlobalAvgPool2D(layout=layout))
         self.output = nn.Dense(classes)
 
     _make_layer = ResNetV1._make_layer

@@ -29,25 +29,47 @@ class _Conv(HybridBlock):
         if adj is not None:
             self._kwargs["adj"] = adj
         self._activation = activation
+        self._channel_last = bool(layout) and layout.endswith("C")
+        cin = in_channels // groups if in_channels else 0
         if op_name == "Convolution":
-            wshape = (channels, in_channels // groups if in_channels else 0) \
-                + kernel_size
+            # channel-last layouts store weights OHWI (ref: convolution.cc
+            # NHWC layout param; TPU-preferred — see ops/nn._conv_layouts)
+            wshape = (channels,) + kernel_size + (cin,) \
+                if self._channel_last else (channels, cin) + kernel_size
         else:  # Deconvolution: (in, out/groups, *k)
             wshape = (in_channels, channels // groups) + kernel_size
         self.weight = self.params.get(
             "weight", shape=wshape, init=weight_initializer,
             allow_deferred_init=True)
+        if self._channel_last and cin:
+            self._set_fan_hint(cin)
         self.bias = self.params.get(
             "bias", shape=(channels,), init=bias_initializer,
             allow_deferred_init=True) if use_bias else None
 
+    def _set_fan_hint(self, c_in):
+        """Exact fans for fan-based initializers: OHWI shapes are
+        ambiguous (see initializer.InitDesc)."""
+        import numpy as _np
+
+        k = int(_np.prod(self._kwargs["kernel"]))
+        self.weight._init_attrs = {
+            "__init_fan__": (c_in * k, self._channels * k)}
+
     def infer_shape(self, x, *args):
-        c_in = x.shape[1]
         g = self._kwargs["num_group"]
         k = self._kwargs["kernel"]
         if self._op_name == "Convolution":
-            self.weight.shape = (self._channels, c_in // g) + tuple(k)
+            if self._channel_last:
+                c_in = x.shape[-1]
+                self.weight.shape = (self._channels,) + tuple(k) \
+                    + (c_in // g,)
+                self._set_fan_hint(c_in // g)
+            else:
+                c_in = x.shape[1]
+                self.weight.shape = (self._channels, c_in // g) + tuple(k)
         else:
+            c_in = x.shape[1]
             self.weight.shape = (c_in, self._channels // g) + tuple(k)
 
     def hybrid_forward(self, F, x, weight, bias=None):
@@ -105,14 +127,15 @@ class Conv1DTranspose(_Conv):
 
 class _Pooling(HybridBlock):
     def __init__(self, pool_size, strides, padding, ceil_mode, global_pool,
-                 pool_type, count_include_pad=None, **kwargs):
+                 pool_type, count_include_pad=None, layout=None, **kwargs):
         super().__init__(**kwargs)
         if strides is None:
             strides = pool_size
         self._kwargs = {
             "kernel": pool_size, "stride": strides, "pad": padding,
             "pool_type": pool_type, "global_pool": global_pool,
-            "pooling_convention": "full" if ceil_mode else "valid"}
+            "pooling_convention": "full" if ceil_mode else "valid",
+            "layout": layout}
         if count_include_pad is not None:
             self._kwargs["count_include_pad"] = count_include_pad
 
@@ -125,7 +148,7 @@ class MaxPool1D(_Pooling):
                  ceil_mode=False, **kwargs):
         super().__init__(_pair(pool_size, 1),
                          _pair(strides, 1) if strides else None,
-                         _pair(padding, 1), ceil_mode, False, "max", **kwargs)
+                         _pair(padding, 1), ceil_mode, False, "max", layout=layout, **kwargs)
 
 
 class MaxPool2D(_Pooling):
@@ -133,7 +156,7 @@ class MaxPool2D(_Pooling):
                  layout="NCHW", ceil_mode=False, **kwargs):
         super().__init__(_pair(pool_size, 2),
                          _pair(strides, 2) if strides else None,
-                         _pair(padding, 2), ceil_mode, False, "max", **kwargs)
+                         _pair(padding, 2), ceil_mode, False, "max", layout=layout, **kwargs)
 
 
 class MaxPool3D(_Pooling):
@@ -141,7 +164,7 @@ class MaxPool3D(_Pooling):
                  layout="NCDHW", ceil_mode=False, **kwargs):
         super().__init__(_pair(pool_size, 3),
                          _pair(strides, 3) if strides else None,
-                         _pair(padding, 3), ceil_mode, False, "max", **kwargs)
+                         _pair(padding, 3), ceil_mode, False, "max", layout=layout, **kwargs)
 
 
 class AvgPool1D(_Pooling):
@@ -150,7 +173,7 @@ class AvgPool1D(_Pooling):
         super().__init__(_pair(pool_size, 1),
                          _pair(strides, 1) if strides else None,
                          _pair(padding, 1), ceil_mode, False, "avg",
-                         count_include_pad, **kwargs)
+                         count_include_pad, layout=layout, **kwargs)
 
 
 class AvgPool2D(_Pooling):
@@ -160,7 +183,7 @@ class AvgPool2D(_Pooling):
         super().__init__(_pair(pool_size, 2),
                          _pair(strides, 2) if strides else None,
                          _pair(padding, 2), ceil_mode, False, "avg",
-                         count_include_pad, **kwargs)
+                         count_include_pad, layout=layout, **kwargs)
 
 
 class AvgPool3D(_Pooling):
@@ -170,38 +193,38 @@ class AvgPool3D(_Pooling):
         super().__init__(_pair(pool_size, 3),
                          _pair(strides, 3) if strides else None,
                          _pair(padding, 3), ceil_mode, False, "avg",
-                         count_include_pad, **kwargs)
+                         count_include_pad, layout=layout, **kwargs)
 
 
 class GlobalMaxPool1D(_Pooling):
     def __init__(self, layout="NCW", **kwargs):
-        super().__init__((1,), None, (0,), False, True, "max", **kwargs)
+        super().__init__((1,), None, (0,), False, True, "max", layout=layout, **kwargs)
 
 
 class GlobalMaxPool2D(_Pooling):
     def __init__(self, layout="NCHW", **kwargs):
-        super().__init__((1, 1), None, (0, 0), False, True, "max", **kwargs)
+        super().__init__((1, 1), None, (0, 0), False, True, "max", layout=layout, **kwargs)
 
 
 class GlobalMaxPool3D(_Pooling):
     def __init__(self, layout="NCDHW", **kwargs):
-        super().__init__((1, 1, 1), None, (0, 0, 0), False, True, "max",
+        super().__init__((1, 1, 1), None, (0, 0, 0), False, True, "max", layout=layout,
                          **kwargs)
 
 
 class GlobalAvgPool1D(_Pooling):
     def __init__(self, layout="NCW", **kwargs):
-        super().__init__((1,), None, (0,), False, True, "avg", **kwargs)
+        super().__init__((1,), None, (0,), False, True, "avg", layout=layout, **kwargs)
 
 
 class GlobalAvgPool2D(_Pooling):
     def __init__(self, layout="NCHW", **kwargs):
-        super().__init__((1, 1), None, (0, 0), False, True, "avg", **kwargs)
+        super().__init__((1, 1), None, (0, 0), False, True, "avg", layout=layout, **kwargs)
 
 
 class GlobalAvgPool3D(_Pooling):
     def __init__(self, layout="NCDHW", **kwargs):
-        super().__init__((1, 1, 1), None, (0, 0, 0), False, True, "avg",
+        super().__init__((1, 1, 1), None, (0, 0, 0), False, True, "avg", layout=layout,
                          **kwargs)
 
 

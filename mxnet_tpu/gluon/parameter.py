@@ -108,7 +108,9 @@ class Parameter:
         if isinstance(initializer, str):
             initializer = init_mod.create(initializer)
         data = _nd_mod.zeros(self._shape, dtype=self.dtype, ctx=ctx[0])
-        initializer(self.name, data)
+        desc = init_mod.InitDesc(self.name,
+                                 getattr(self, "_init_attrs", None))
+        initializer(desc, data)
         self._data = {c: (data if c == ctx[0] else data.copyto(c))
                       for c in ctx}
         self._deferred_init = None

@@ -76,6 +76,15 @@ class Trainer:
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce grads + optimizer update (ref: Trainer.step §3.3)."""
         self._init_kvstore()
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        if scaler is not None and scaler.enabled and self._update_on_kvstore:
+            # server-side optimizer is a pickle snapshot that never sees
+            # rescale_grad updates or the overflow skip — applying 2^16-
+            # scaled grads there would silently diverge (ref: amp is a
+            # local-trainer feature in the reference too)
+            raise MXNetError(
+                "dynamic loss scaling (amp.scale_loss) is not supported "
+                "with update_on_kvstore; use update_on_kvstore=False")
         self._optimizer.rescale_grad = self._scale / batch_size
         self._allreduce_grads()
         self._update(ignore_stale_grad)
@@ -113,6 +122,17 @@ class Trainer:
     def _update(self, ignore_stale_grad=False):
         if self._update_on_kvstore and self._kvstore is not None:
             return  # already updated during push
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        if scaler is not None and scaler.enabled:
+            # dynamic loss scaling: on non-finite grads skip the update
+            # and shrink the scale (ref: amp trainer overflow handling);
+            # `enabled` (not the current scale value) gates this so the
+            # dynamics keep running after the scale decays to 1
+            grads = [p.grad(p.list_ctx()[0]) for p in self._params]
+            skip = scaler.update(scaler.has_overflow(grads))
+            self._scale = self._amp_original_scale / scaler.loss_scale
+            if skip:
+                return
         for i, p in enumerate(self._params):
             ctxs = p.list_ctx()
             # grads are identical after allreduce: update ONCE on the first

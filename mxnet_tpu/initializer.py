@@ -11,6 +11,19 @@ _registry = Registry("initializer")
 register = _registry.register
 
 
+class InitDesc(str):
+    """Parameter name + attr hints handed to initializers
+    (ref: mxnet.init.InitDesc).  Layout-dependent layers attach
+    ``__init_fan__`` so fan-based initializers (Xavier/MSRAPrelu) stay
+    correct for channel-last OHWI conv weights, whose shape alone is
+    ambiguous (e.g. (256,3,3,256))."""
+
+    def __new__(cls, name, attrs=None):
+        s = super().__new__(cls, name)
+        s.attrs = dict(attrs or {})
+        return s
+
+
 class Initializer:
     """Base initializer (ref: mx.init.Initializer)."""
 
@@ -21,7 +34,7 @@ class Initializer:
         # legacy call convention: init(name, arr)
         if arr is None:
             name, arr = "", name
-        self.init_array(str(name), arr)
+        self.init_array(name if isinstance(name, str) else str(name), arr)
 
     def init_array(self, name, arr):
         if name.endswith("bias"):
@@ -53,6 +66,12 @@ class Initializer:
 
     def __repr__(self):
         return f"{type(self).__name__}({self._kwargs})"
+
+
+def _np_rng():
+    from .random import np_rng
+
+    return np_rng()
 
 
 def _fill(arr, np_values):
@@ -94,7 +113,7 @@ class Uniform(Initializer):
         self.scale = scale
 
     def _init_weight(self, name, arr):
-        _fill(arr, np.random.uniform(-self.scale, self.scale, arr.shape))
+        _fill(arr, _np_rng().uniform(-self.scale, self.scale, arr.shape))
 
 
 @register()
@@ -104,7 +123,7 @@ class Normal(Initializer):
         self.sigma = sigma
 
     def _init_weight(self, name, arr):
-        _fill(arr, np.random.normal(0, self.sigma, arr.shape))
+        _fill(arr, _np_rng().normal(0, self.sigma, arr.shape))
 
 
 @register()
@@ -119,10 +138,10 @@ class TruncNorm(Initializer):
 
     def _init_weight(self, name, arr):
         lo, hi = -2.0, 2.0
-        vals = np.random.normal(0, 1, arr.shape)
+        vals = _np_rng().normal(0, 1, arr.shape)
         bad = (vals < lo) | (vals > hi)
         while bad.any():  # resample the tails (truncation, not clipping)
-            vals[bad] = np.random.normal(0, 1, int(bad.sum()))
+            vals[bad] = _np_rng().normal(0, 1, int(bad.sum()))
             bad = (vals < lo) | (vals > hi)
         _fill(arr, self.mean + self.stdev * vals)
 
@@ -138,9 +157,9 @@ class Orthogonal(Initializer):
         nout = arr.shape[0]
         nin = int(np.prod(arr.shape[1:])) if len(arr.shape) > 1 else 1
         if self.rand_type == "uniform":
-            tmp = np.random.uniform(-1, 1, (nout, nin))
+            tmp = _np_rng().uniform(-1, 1, (nout, nin))
         else:
-            tmp = np.random.normal(0, 1, (nout, nin))
+            tmp = _np_rng().normal(0, 1, (nout, nin))
         u, _, v = np.linalg.svd(tmp, full_matrices=False)
         q = u if u.shape == tmp.shape else v
         _fill(arr, self.scale * q.reshape(arr.shape))
@@ -159,20 +178,27 @@ class Xavier(Initializer):
 
     def _init_weight(self, name, arr):
         shape = arr.shape
-        hw_scale = 1.0
-        if len(shape) < 2:
-            raise ValueError(
-                f"Xavier initializer needs >=2D weight, got {shape} for {name}")
-        if len(shape) > 2:
-            hw_scale = np.prod(shape[2:])
-        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        hints = getattr(name, "attrs", {})
+        if "__init_fan__" in hints:
+            # layout-aware layers supply exact fans (OHWI weights would
+            # otherwise be misread as OI*k)
+            fan_in, fan_out = hints["__init_fan__"]
+        else:
+            hw_scale = 1.0
+            if len(shape) < 2:
+                raise ValueError(
+                    f"Xavier initializer needs >=2D weight, got {shape} "
+                    f"for {name}")
+            if len(shape) > 2:
+                hw_scale = np.prod(shape[2:])
+            fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
         factor = {"avg": (fan_in + fan_out) / 2.0,
                   "in": fan_in, "out": fan_out}[self.factor_type]
         scale = math.sqrt(self.magnitude / factor)
         if self.rnd_type == "uniform":
-            _fill(arr, np.random.uniform(-scale, scale, shape))
+            _fill(arr, _np_rng().uniform(-scale, scale, shape))
         else:
-            _fill(arr, np.random.normal(0, scale, shape))
+            _fill(arr, _np_rng().normal(0, scale, shape))
 
 
 @register()

@@ -31,9 +31,13 @@ def install():
               doc="Grouped convolution group count."),
         Param("no_bias", bool, True, doc="Skip the bias term."),
         Param("layout", str, None,
-              choices=(None, "NCHW", "NCDHW", "NCW"),
-              doc="Data layout (channels-first only, the TPU-native "
-                  "canonical layout)."),
+              choices=(None, "NCHW", "NCDHW", "NCW",
+                       "NHWC", "NDHWC", "NWC"),
+              doc="Data layout. Channel-last (NHWC & co) is the "
+                  "TPU-preferred form: channel lands on the minormost "
+                  "(128-lane) tile dim, so conv relayouts and "
+                  "per-channel BN reductions vanish. Channel-last "
+                  "weights are OHWI."),
         Param("cudnn_tune", str, None,
               choices=(None, "off", "limited_workspace", "fastest"),
               doc="Accepted for reference compatibility; XLA owns "
@@ -68,6 +72,10 @@ def install():
         Param("count_include_pad", bool, True,
               doc="avg pool: include padding positions in the divisor."),
         Param("p_value", int, 2, low=1, doc="lp pool exponent."),
+        Param("layout", str, None,
+              choices=(None, "NCHW", "NCDHW", "NCW",
+                       "NHWC", "NDHWC", "NWC"),
+              doc="Data layout; channel-last is TPU-preferred."),
     )
     _attach(
         "BatchNorm",

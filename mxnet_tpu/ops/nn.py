@@ -56,6 +56,23 @@ _CONV_DIMS = {1: ("NCW", "OIW", "NCW"),
               3: ("NCDHW", "OIDHW", "NCDHW")}
 
 
+def _conv_layouts(layout, nd):
+    """(data_layout, weight_layout) for a layout string.
+
+    Channel-last layouts (NHWC & co — the TPU-preferred form: channel
+    minormost matches the MXU/VPU (8,128) tiling, so per-channel BN
+    reductions and conv relayouts vanish) use OHWI weights, matching the
+    reference's NHWC convention (src/operator/nn/convolution.cc layout
+    param).
+    """
+    if not layout:
+        layout = _CONV_DIMS[nd][0]
+    spatial = layout.replace("N", "").replace("C", "")
+    if layout.endswith("C"):
+        return layout, "O" + spatial + "I"
+    return layout, "OI" + spatial
+
+
 def _k_convolution(data, weight, bias=None, *, kernel, stride=(), dilate=(),
                    pad=(), num_filter=0, num_group=1, no_bias=False,
                    layout=None, cudnn_tune=None, cudnn_off=False,
@@ -65,14 +82,17 @@ def _k_convolution(data, weight, bias=None, *, kernel, stride=(), dilate=(),
     dilate = dilate or (1,) * nd
     pad = pad or (0,) * nd
     data = _amp_in(data, weight)
-    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_DIMS[nd])
+    dl, wl = _conv_layouts(layout, nd)
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, (dl, wl, dl))
     out = lax.conv_general_dilated(
         data, weight, window_strides=stride,
         padding=[(p, p) for p in pad], rhs_dilation=dilate,
         dimension_numbers=dn, feature_group_count=num_group,
         preferred_element_type=None)
     if not no_bias and bias is not None:
-        out = out + bias.astype(out.dtype).reshape((1, -1) + (1,) * nd)
+        bshape = [1] * (nd + 2)
+        bshape[dl.index("C")] = -1
+        out = out + bias.astype(out.dtype).reshape(bshape)
     return out
 
 register("Convolution", _k_convolution,
@@ -84,6 +104,11 @@ def _k_deconvolution(data, weight, bias=None, *, kernel, stride=(),
                      no_bias=True, target_shape=(), layout=None,
                      cudnn_tune=None, cudnn_off=False, workspace=1024):
     nd = len(kernel)
+    if layout and layout.endswith("C"):
+        raise ValueError(
+            "Deconvolution supports channel-first layouts only; "
+            f"got layout={layout!r} (channel-last deconv weights/"
+            "grouping are not implemented)")
     stride = stride or (1,) * nd
     dilate = dilate or (1,) * nd
     pad = pad or (0,) * nd
@@ -139,23 +164,34 @@ def _k_pooling(data, *, kernel=(), pool_type="max", stride=(), pad=(),
                count_include_pad=True, cudnn_off=False, p_value=2,
                layout=None):
     nd = data.ndim - 2
+    channel_last = bool(layout) and layout.endswith("C")
+    sp0 = 1 if channel_last else 2  # first spatial dim index
     if global_pool:
-        axes = tuple(range(2, 2 + nd))
+        axes = tuple(range(sp0, sp0 + nd))
         if pool_type == "max":
             return jnp.max(data, axis=axes, keepdims=True)
         if pool_type == "sum":
             return jnp.sum(data, axis=axes, keepdims=True)
+        if pool_type == "lp":
+            return jnp.sum(jnp.abs(data) ** p_value, axis=axes,
+                           keepdims=True) ** (1.0 / p_value)
         return jnp.mean(data, axis=axes, keepdims=True)
     kernel = tuple(kernel)
     stride = tuple(stride) or (1,) * nd
     pad = tuple(pad) or (0,) * nd
-    pads = [(0, 0), (0, 0)] + [
-        _pool_out_pad(data.shape[2 + i], kernel[i], stride[i], pad[i],
+    sp_pads = [
+        _pool_out_pad(data.shape[sp0 + i], kernel[i], stride[i], pad[i],
                       pooling_convention)
         for i in range(nd)
     ]
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
+    if channel_last:
+        pads = [(0, 0)] + sp_pads + [(0, 0)]
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+    else:
+        pads = [(0, 0), (0, 0)] + sp_pads
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
 
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
@@ -197,16 +233,25 @@ def _k_batch_norm(data, gamma, beta, moving_mean, moving_var, *,
     (sum, sqsum) — see parallel/.
     """
     g = jnp.ones_like(gamma) if fix_gamma else gamma
+    axis = axis % data.ndim  # normalize negative axis (NHWC uses -1)
     red = tuple(i for i in range(data.ndim) if i != axis)
     shape = [1] * data.ndim
     shape[axis] = data.shape[axis]
 
     # stats math in fp32 even for bf16 activations (AMP-correct split;
-    # the reference's cuDNN BN does the same)
-    x32 = data.astype(jnp.float32)
+    # the reference's cuDNN BN does the same).  The fp32 part touches
+    # only per-channel [C] tensors: the big activation is read ONCE in
+    # its own dtype by the stats reduction (XLA fuses the upcast into
+    # the reduce) and the normalize is a per-channel scale/shift applied
+    # in the data dtype, so it fuses with neighbouring bf16 ops instead
+    # of materializing an fp32 copy of the activation.
     if _train and not use_global_stats:
-        mean = jnp.mean(x32, axis=red)
-        var = jnp.var(x32, axis=red)
+        mean = jnp.mean(data, axis=red, dtype=jnp.float32)
+        # E[x^2]-E[x]^2 can cancel slightly negative in fp32; clamp so
+        # rsqrt(var+eps) can't NaN on near-constant channels
+        var = jnp.maximum(
+            jnp.mean(jnp.square(data), axis=red, dtype=jnp.float32)
+            - jnp.square(mean), 0.0)
         new_mm = moving_mean * momentum + mean.astype(moving_mean.dtype) \
             * (1 - momentum)
         new_mv = moving_var * momentum + var.astype(moving_var.dtype) \
@@ -215,12 +260,11 @@ def _k_batch_norm(data, gamma, beta, moving_mean, moving_var, *,
         mean, var = (moving_mean.astype(jnp.float32),
                      moving_var.astype(jnp.float32))
         new_mm, new_mv = moving_mean, moving_var
-    mean_r = mean.reshape(shape)
-    var_r = var.reshape(shape)
-    out = (x32 - mean_r) * lax.rsqrt(var_r + eps) \
-        * g.astype(jnp.float32).reshape(shape) \
-        + beta.astype(jnp.float32).reshape(shape)
-    return (out.astype(data.dtype), lax.stop_gradient(new_mm),
+    scale = g.astype(jnp.float32) * lax.rsqrt(var + eps)
+    shift = beta.astype(jnp.float32) - mean * scale
+    out = data * scale.astype(data.dtype).reshape(shape) \
+        + shift.astype(data.dtype).reshape(shape)
+    return (out, lax.stop_gradient(new_mm),
             lax.stop_gradient(new_mv))
 
 

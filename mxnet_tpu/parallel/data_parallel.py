@@ -36,10 +36,16 @@ class DataParallelTrainer:
 
     def __init__(self, block, loss_fn, optimizer="sgd", optimizer_params=None,
                  mesh=None, shard_params=False, donate=True,
-                 shard_opt_states=False):
+                 shard_opt_states=False, compute_dtype=None):
         self.block = block
         self.loss_fn = loss_fn
         self.mesh = mesh if mesh is not None else mesh_mod.make_mesh()
+        # multi-precision training (ref: MXNet fp16 + fp32 master weights,
+        # optimizer_op multi_mp_sgd; TPU-first: bf16 feeds the MXU at full
+        # rate, fp32 feeds it at ~1/4): master params + optimizer states
+        # stay fp32, forward/backward run in `compute_dtype`
+        self._compute_dtype = jnp.dtype(compute_dtype) \
+            if compute_dtype is not None else None
         opt_params = dict(optimizer_params or {})
         self._lr = float(opt_params.pop("learning_rate", 0.01))
         self._opt_name = optimizer
@@ -151,7 +157,27 @@ class DataParallelTrainer:
 
         from ..gluon.block import _tracing
 
+        cdt = self._compute_dtype
+
+        def _to_compute(r):
+            if cdt is not None and jnp.issubdtype(r.dtype, jnp.floating):
+                return r.astype(cdt)
+            return r
+
         def forward_loss(param_raws, x_raw, y_raw, key):
+            orig_dtypes = [r.dtype for r in param_raws]
+            if cdt is not None:
+                # trainable params only: non-trainables (BN moving
+                # stats) must stay fp32 so their EMA isn't quantized to
+                # bf16 every step — the BN kernel does its stats math
+                # in fp32 regardless
+                param_raws = tuple(
+                    _to_compute(r) if tr else r
+                    for r, tr in zip(param_raws, trainable))
+                if isinstance(x_raw, tuple):
+                    x_raw = tuple(_to_compute(r) for r in x_raw)
+                else:
+                    x_raw = _to_compute(x_raw)
             params = [p for _, p in named]
             old = [p._traced_value for p in params]
             prev = getattr(_tracing, "active", False)
@@ -173,9 +199,11 @@ class DataParallelTrainer:
                 for p, o in zip(params, old):
                     p._traced_value = o
             # aux side effects (BatchNorm moving stats): wrappers mutated
-            # in place during forward; surface as aux outputs
-            aux = tuple(w._data for w in wrappers)
-            return jnp.mean(loss._data), aux
+            # in place during forward; surface as aux outputs (cast back
+            # to the master dtype so bf16 never leaks into master params)
+            aux = tuple(w._data.astype(d) for w, d in
+                        zip(wrappers, orig_dtypes))
+            return jnp.mean(loss._data.astype(jnp.float32)), aux
 
         def apply_opt(raw, g, state, lr, t):
             if clip is not None:

@@ -24,6 +24,18 @@ _base_key = None
 _counter = 0
 
 
+# host-side generator for initializers and other numpy-domain draws:
+# mx.random.seed() must make parameter init deterministic (ref: the
+# reference's initializers draw from MXNet's own seeded RNG, not
+# numpy's global stream)
+_np_rng = np.random.RandomState()
+
+
+def np_rng():
+    """The framework's seeded numpy generator (initializers etc.)."""
+    return _np_rng
+
+
 def seed(seed_state=None, ctx="all"):
     """Seed the global generators (ref: mx.random.seed)."""
     global _base_key, _counter
@@ -32,6 +44,7 @@ def seed(seed_state=None, ctx="all"):
     with _lock:
         _base_key = jax.random.PRNGKey(int(seed_state))
         _counter = 0
+        _np_rng.seed(int(seed_state) & 0x7FFFFFFF)
 
 
 # trace-local key stack: inside a hybrid graph capture, randomness must

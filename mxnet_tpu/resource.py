@@ -1,0 +1,110 @@
+"""Resource manager: temp-space & RNG resources for operators.
+
+Ref: include/mxnet/resource.h + src/resource.cc — ops declare
+ResourceRequest{kTempSpace, kRandom, kParallelRandom} and the manager
+hands them scratch buffers / seeded generators tied to a device.
+
+TPU-native translation: on-device scratch is XLA's job (the compiler
+materializes and reuses temp buffers inside a fused computation), so
+kTempSpace here provides HOST scratch from the pooled staging allocator
+(src/storage.cc size-class free lists) — the piece custom ops and IO
+actually need.  kRandom/kParallelRandom hand out jax PRNG keys split
+from the framework seed stream (random.py), so resource-supplied
+randomness composes with `mx.random.seed` the way the reference's
+per-device generators compose with its seeds.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+
+
+class ResourceRequest:
+    """Ref: ResourceRequest::Type."""
+
+    kTempSpace = "temp_space"
+    kRandom = "random"
+    kParallelRandom = "parallel_random"
+
+    def __init__(self, type):
+        if type not in (self.kTempSpace, self.kRandom,
+                        self.kParallelRandom):
+            raise MXNetError(f"unknown resource type {type!r}")
+        self.type = type
+
+
+class Resource:
+    """A granted resource (ref: struct Resource)."""
+
+    def __init__(self, req_type, manager):
+        self.req = ResourceRequest(req_type)
+        self._manager = manager
+        self._handles = []
+
+    # -- kTempSpace ----------------------------------------------------------
+
+    def get_space(self, shape, dtype=np.float32):
+        """Host scratch ndarray from the pooled staging allocator.
+
+        Valid until release()/the next epoch of requests — same
+        contract as the reference's temp space (one live buffer per
+        resource, reused across calls).
+        """
+        if self.req.type != ResourceRequest.kTempSpace:
+            raise MXNetError("get_space on a non-temp-space resource")
+        from . import storage
+
+        nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        h = storage.Storage.get().alloc(max(nbytes, 1))
+        self._handles.append(h)
+        flat = h.as_numpy(dtype)[:int(np.prod(shape))]
+        return flat.reshape(shape)
+
+    def release(self):
+        from . import storage
+
+        for h in self._handles:
+            storage.Storage.get().free(h)
+        self._handles.clear()
+
+    # -- kRandom / kParallelRandom ------------------------------------------
+
+    def get_key(self):
+        """One jax PRNG key from the framework seed stream."""
+        if self.req.type not in (ResourceRequest.kRandom,
+                                 ResourceRequest.kParallelRandom):
+            raise MXNetError("get_key on a non-random resource")
+        from . import random as _random
+
+        return _random.next_key()
+
+    def get_parallel_keys(self, n):
+        """n independent keys (ref: kParallelRandom per-thread gens)."""
+        import jax
+
+        if self.req.type != ResourceRequest.kParallelRandom:
+            raise MXNetError("get_parallel_keys needs kParallelRandom")
+        from . import random as _random
+
+        return list(jax.random.split(_random.next_key(), n))
+
+
+class ResourceManager:
+    """Ref: ResourceManager::Get() — grants resources per request."""
+
+    _instance = None
+
+    @classmethod
+    def get(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def request(self, req_type):
+        return Resource(req_type, self)
+
+
+def request(req_type):
+    """Module-level convenience: mx.resource.request('temp_space')."""
+    return ResourceManager.get().request(req_type)

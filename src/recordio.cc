@@ -23,6 +23,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <map>
 #include <mutex>
 #include <queue>
 #include <random>
@@ -144,7 +145,13 @@ struct ImagePipeline {
   size_t num_batches = 0;
 
   std::vector<std::thread> workers;
-  std::queue<Batch*> ready;
+  // completed batches keyed by batch index: the consumer emits them in
+  // sequence order regardless of which worker finished first (the
+  // reference's batcher/prefetcher preserves record order; without
+  // this, batch order silently depends on thread scheduling — a race
+  // caught by the parity test under CPU load)
+  std::map<size_t, Batch*> ready;
+  size_t next_emit = 0;  // guarded by mu
   std::mutex mu;
   std::condition_variable cv_ready, cv_space;
   size_t max_queue = 4;
@@ -155,7 +162,13 @@ struct ImagePipeline {
   ~ImagePipeline() { Shutdown(); }
 
   void Shutdown() {
-    stop.store(true);
+    {
+      // stop must flip under mu: a worker that just evaluated the
+      // cv_space predicate false would otherwise sleep through this
+      // notify and hang the join (lost wakeup)
+      std::lock_guard<std::mutex> lk(mu);
+      stop.store(true);
+    }
     cv_space.notify_all();
     cv_ready.notify_all();
     for (auto& t : workers) {
@@ -163,10 +176,8 @@ struct ImagePipeline {
     }
     workers.clear();
     std::lock_guard<std::mutex> lk(mu);
-    while (!ready.empty()) {
-      delete ready.front();
-      ready.pop();
-    }
+    for (auto& kv : ready) delete kv.second;
+    ready.clear();
     if (f) {
       fclose(f);
       f = nullptr;
@@ -254,6 +265,15 @@ struct ImagePipeline {
     while (!stop.load()) {
       size_t b = cursor.fetch_add(1);
       if (b >= num_batches) break;
+      {
+        // bounded lookahead: claim-order is sequential, so gating on
+        // consumption progress bounds in-flight batches without the
+        // full-queue deadlock an admission gate would have
+        std::unique_lock<std::mutex> lk(mu);
+        cv_space.wait(lk,
+                      [&] { return b < next_emit + max_queue || stop; });
+        if (stop) break;
+      }
       auto* batch = new Batch;
       batch->data.resize(bs * cfg.c * cfg.h * cfg.w);
       batch->labels.resize(bs);
@@ -270,13 +290,12 @@ struct ImagePipeline {
                   &batch->labels[i], &rng);
       }
       std::unique_lock<std::mutex> lk(mu);
-      cv_space.wait(lk, [&] { return ready.size() < max_queue || stop; });
       if (stop) {
         delete batch;
         break;
       }
-      ready.push(batch);
-      cv_ready.notify_one();
+      ready[b] = batch;
+      cv_ready.notify_all();
     }
     if (active_workers.fetch_sub(1) == 1) cv_ready.notify_all();
   }
@@ -394,18 +413,21 @@ void* MXTPUImagePipelineCreate(const char* rec_path,
 // start (or restart) an epoch
 void MXTPUImagePipelineReset(void* handle, uint64_t epoch) {
   auto* p = static_cast<ImagePipeline*>(handle);
-  p->stop.store(true);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);  // see Shutdown: lost wakeup
+    p->stop.store(true);
+  }
   p->cv_space.notify_all();
+  p->cv_ready.notify_all();
   for (auto& t : p->workers) {
     if (t.joinable()) t.join();
   }
   p->workers.clear();
   {
     std::lock_guard<std::mutex> lk(p->mu);
-    while (!p->ready.empty()) {
-      delete p->ready.front();
-      p->ready.pop();
-    }
+    for (auto& kv : p->ready) delete kv.second;
+    p->ready.clear();
+    p->next_emit = 0;
   }
   p->order.resize(p->offsets.size());
   for (size_t i = 0; i < p->order.size(); ++i) p->order[i] = i;
@@ -424,13 +446,15 @@ int MXTPUImagePipelineNext(void* handle, float* out_data,
   auto* p = static_cast<ImagePipeline*>(handle);
   std::unique_lock<std::mutex> lk(p->mu);
   p->cv_ready.wait(lk, [&] {
-    return !p->ready.empty() || p->active_workers.load() == 0 ||
-           p->stop.load();
+    return p->ready.count(p->next_emit) ||
+           p->active_workers.load() == 0 || p->stop.load();
   });
-  if (p->ready.empty()) return 0;
-  Batch* b = p->ready.front();
-  p->ready.pop();
-  p->cv_space.notify_one();
+  auto it = p->ready.find(p->next_emit);
+  if (it == p->ready.end()) return 0;
+  Batch* b = it->second;
+  p->ready.erase(it);
+  ++p->next_emit;
+  p->cv_space.notify_all();
   lk.unlock();
   std::memcpy(out_data, b->data.data(), b->data.size() * sizeof(float));
   std::memcpy(out_labels, b->labels.data(),

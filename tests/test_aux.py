@@ -135,6 +135,67 @@ def test_amp_convert_model():
     assert out.dtype == np.dtype("bfloat16")
 
 
+def test_loss_scaler_dynamics():
+    from mxnet_tpu import amp
+
+    s = amp.LossScaler(init_scale=8.0, scale_factor=2.0, scale_window=3)
+    assert float(s.scale(nd.array([2.0])).asnumpy()[0]) == 16.0
+    # overflow halves and requests a skip
+    assert s.update(overflow=True) is True
+    assert s.loss_scale == 4.0
+    # scale_window clean steps double it back
+    for _ in range(3):
+        assert s.update(overflow=False) is False
+    assert s.loss_scale == 8.0
+    assert s.has_overflow([nd.array([1.0, float("inf")])])
+    assert not s.has_overflow([nd.array([1.0, 2.0])])
+    g = s.unscale([nd.array([8.0])])[0]
+    assert float(g.asnumpy()[0]) == 1.0
+
+
+def test_scale_loss_trainer_integration():
+    """fp16-style dynamic scaling: scaled loss backward, grads rescaled
+    by the optimizer, overflow skips the update and shrinks the scale."""
+    from mxnet_tpu import amp, autograd, gluon
+
+    amp._target_dtype = "float16"  # force a real (non-1) scale
+    try:
+        net = nn.Dense(1, use_bias=False)
+        net.initialize(mx.init.Constant(2.0))
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 1.0})
+        x = nd.ones((1, 1))
+        with autograd.record():
+            out = net(x)
+            loss = out.sum()
+            with amp.scale_loss(loss, tr) as scaled:
+                pass
+        scaled.backward()
+        scale = tr._amp_loss_scaler.loss_scale
+        assert scale > 1.0
+        g = net.weight.grad().asnumpy()
+        assert g[0, 0] == scale  # grad carries the loss scale
+        w_before = net.weight.data().asnumpy().copy()
+        tr.step(1)
+        w_after = net.weight.data().asnumpy()
+        # optimizer divided the scale back out: dw = lr * 1.0
+        np.testing.assert_allclose(w_before - w_after, 1.0, rtol=1e-6)
+
+        # now force an overflow: update must be skipped, scale halved
+        with autograd.record():
+            loss = (net(x) * float("inf")).sum()
+            with amp.scale_loss(loss, tr) as scaled:
+                pass
+        scaled.backward()
+        w_before = net.weight.data().asnumpy().copy()
+        s_before = tr._amp_loss_scaler.loss_scale
+        tr.step(1)
+        assert np.array_equal(net.weight.data().asnumpy(), w_before)
+        assert tr._amp_loss_scaler.loss_scale == s_before / 2.0
+    finally:
+        amp._target_dtype = "bfloat16"
+
+
 def test_monitor_hooks():
     from mxnet_tpu.monitor import Monitor
 
@@ -147,3 +208,35 @@ def test_monitor_hooks():
     stats = mon.toc()
     assert len(stats) >= 2
     assert all(np.isfinite(v) for _, _, v in stats)
+
+
+def test_resource_manager():
+    """Ref: include/mxnet/resource.h — temp space + RNG resources."""
+    from mxnet_tpu import resource
+
+    r = resource.request(resource.ResourceRequest.kTempSpace)
+    buf = r.get_space((16, 4), np.float32)
+    buf[:] = 3.0
+    assert buf.shape == (16, 4) and buf.dtype == np.float32
+    assert (buf == 3.0).all()
+    r.release()
+
+    rr = resource.request(resource.ResourceRequest.kRandom)
+    k = rr.get_key()
+    assert k is not None
+    pr = resource.request(resource.ResourceRequest.kParallelRandom)
+    keys = pr.get_parallel_keys(4)
+    assert len(keys) == 4
+    import jax
+
+    vals = [float(jax.random.uniform(k)) for k in keys]
+    assert len(set(vals)) == 4  # independent streams
+
+    import pytest as _pytest
+
+    with _pytest.raises(mx.MXNetError):
+        rr.get_space((2,))
+    with _pytest.raises(mx.MXNetError):
+        r.get_key()
+    with _pytest.raises(mx.MXNetError):
+        resource.request("bogus")

@@ -1,0 +1,177 @@
+"""Channel-last (NHWC) layout support.
+
+Ref: ConvolutionParam/PoolingParam `layout` field
+(src/operator/nn/convolution.cc, pooling.cc) — the reference supports
+NHWC for tensor-core paths; here it is the TPU-preferred layout (channel
+on the minormost 128-lane tile dim). Weights are OHWI for channel-last
+convs, matching the reference convention.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import nn
+
+
+def test_conv2d_nhwc_matches_nchw():
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 3, 9, 9).astype(np.float32)
+    w = rng.rand(8, 3, 3, 3).astype(np.float32)  # OIHW
+    b = rng.rand(8).astype(np.float32)
+    out_ref = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                             kernel=(3, 3), num_filter=8, stride=(2, 2),
+                             pad=(1, 1), no_bias=False).asnumpy()
+    x_cl = np.transpose(x, (0, 2, 3, 1))
+    w_cl = np.transpose(w, (0, 2, 3, 1))  # OHWI
+    out_cl = nd.Convolution(nd.array(x_cl), nd.array(w_cl), nd.array(b),
+                            kernel=(3, 3), num_filter=8, stride=(2, 2),
+                            pad=(1, 1), no_bias=False,
+                            layout="NHWC").asnumpy()
+    np.testing.assert_allclose(np.transpose(out_cl, (0, 3, 1, 2)),
+                               out_ref, rtol=1e-5, atol=1e-5)
+
+
+def test_pooling_nhwc_matches_nchw():
+    rng = np.random.RandomState(1)
+    x = rng.rand(2, 4, 8, 8).astype(np.float32)
+    x_cl = np.transpose(x, (0, 2, 3, 1))
+    for pool_type in ("max", "avg"):
+        ref = nd.Pooling(nd.array(x), kernel=(3, 3), stride=(2, 2),
+                         pad=(1, 1), pool_type=pool_type).asnumpy()
+        cl = nd.Pooling(nd.array(x_cl), kernel=(3, 3), stride=(2, 2),
+                        pad=(1, 1), pool_type=pool_type,
+                        layout="NHWC").asnumpy()
+        np.testing.assert_allclose(np.transpose(cl, (0, 3, 1, 2)), ref,
+                                   rtol=1e-5, atol=1e-5, err_msg=pool_type)
+    # global pool honours layout too
+    ref = nd.Pooling(nd.array(x), pool_type="avg",
+                     global_pool=True).asnumpy()
+    cl = nd.Pooling(nd.array(x_cl), pool_type="avg", global_pool=True,
+                    layout="NHWC").asnumpy()
+    np.testing.assert_allclose(cl.squeeze(), ref.squeeze(), rtol=1e-5)
+
+
+def test_batchnorm_negative_axis_per_channel_stats():
+    """axis=-1 (NHWC) must compute PER-CHANNEL train-mode stats, not a
+    scalar over all dims (regression: negative axis never matched the
+    reduction-exclusion test, silently normalizing with global stats)."""
+    rng = np.random.RandomState(0)
+    x = rng.rand(4, 5, 5, 3).astype(np.float32)
+    # give each channel a wildly different scale so per-channel vs
+    # global stats are distinguishable
+    x[..., 1] *= 100.0
+    x[..., 2] += 50.0
+    gamma = np.ones(3, np.float32)
+    beta = np.zeros(3, np.float32)
+    mm = np.zeros(3, np.float32)
+    mv = np.ones(3, np.float32)
+    from mxnet_tpu import autograd
+
+    with autograd.train_mode():
+        out = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                           nd.array(mm), nd.array(mv), axis=-1,
+                           fix_gamma=False, eps=1e-5)
+    o = out.asnumpy()
+    # each channel independently standardized
+    for c in range(3):
+        assert abs(o[..., c].mean()) < 2e-2, c
+        assert abs(o[..., c].std() - 1.0) < 5e-2, c
+    # and identical to the channels-first result on transposed input
+    with autograd.train_mode():
+        out_cf = nd.BatchNorm(
+            nd.array(np.transpose(x, (0, 3, 1, 2))), nd.array(gamma),
+            nd.array(beta), nd.array(mm), nd.array(mv), axis=1,
+            fix_gamma=False, eps=1e-5)
+    np.testing.assert_allclose(
+        np.transpose(out_cf.asnumpy(), (0, 2, 3, 1)), o, rtol=1e-4,
+        atol=1e-4)
+
+
+def test_deconv_rejects_channel_last():
+    import pytest
+
+    with pytest.raises(Exception, match="channel-first"):
+        nd.Deconvolution(nd.ones((1, 4, 4, 2)), nd.ones((2, 3, 3, 2)),
+                         kernel=(3, 3), num_filter=2, layout="NHWC")
+
+
+def test_gluon_conv_nhwc_weight_shape():
+    net = nn.Conv2D(16, 3, layout="NHWC")
+    net.initialize()
+    x = nd.array(np.random.rand(2, 8, 8, 5).astype(np.float32))
+    out = net(x)
+    assert out.shape == (2, 6, 6, 16)
+    assert net.weight.shape == (16, 3, 3, 5)  # OHWI
+
+
+def test_xavier_fan_matches_across_layouts():
+    """OHWI weights are shape-ambiguous: Xavier must use the fan hint so
+    NHWC and NCHW convs get the SAME init scale (regression: fan_out was
+    read as O*prod(shape[2:]) = O*W*I for OHWI, ~85x off)."""
+    mx.random.seed(0)
+    a = nn.Conv2D(64, 3, layout="NHWC", in_channels=32)
+    a.initialize(mx.init.Xavier(factor_type="avg", magnitude=3))
+    mx.random.seed(0)
+    b = nn.Conv2D(64, 3, layout="NCHW", in_channels=32)
+    b.initialize(mx.init.Xavier(factor_type="avg", magnitude=3))
+    sa = a.weight.data().asnumpy().std()
+    sb = b.weight.data().asnumpy().std()
+    assert abs(sa - sb) / sb < 0.05, (sa, sb)
+    # deferred-init path (in_channels unknown at ctor) gets it too
+    mx.random.seed(0)
+    c = nn.Conv2D(64, 3, layout="NHWC")
+    c.initialize(mx.init.Xavier(factor_type="avg", magnitude=3))
+    c(nd.ones((1, 8, 8, 32)))
+    sc = c.weight.data().asnumpy().std()
+    assert abs(sc - sb) / sb < 0.05, (sc, sb)
+
+
+def test_resnet_nhwc_parity_with_nchw():
+    """resnet18 NHWC == NCHW given identical (transposed) weights."""
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    mx.random.seed(0)
+    net = vision.resnet18_v1(layout="NHWC", classes=7)
+    net.initialize(mx.init.Xavier())
+    x = np.random.RandomState(2).rand(2, 32, 32, 3).astype(np.float32)
+    out_cl = net(nd.array(x))
+    net2 = vision.resnet18_v1(layout="NCHW", classes=7)
+    net2.initialize(mx.init.Xavier())
+    x_cf = np.transpose(x, (0, 3, 1, 2))
+    net2(nd.array(x_cf))  # finish deferred init
+    for (_, a), (_, b) in zip(net._ordered_params(),
+                              net2._ordered_params()):
+        src = a.data().asnumpy()
+        if src.ndim == 4:
+            src = np.transpose(src, (0, 3, 1, 2))  # OHWI -> OIHW
+        assert src.shape == tuple(b.shape)
+        b.set_data(nd.array(src))
+    out_cf = net2(nd.array(x_cf))
+    np.testing.assert_allclose(out_cl.asnumpy(), out_cf.asnumpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_resnet_nhwc_trains():
+    """NHWC resnet trains end-to-end through the SPMD compiled step
+    with bf16 compute (the flagship bench configuration)."""
+    import jax
+
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel import data_parallel
+
+    mx.random.seed(0)
+    net = vision.resnet18_v1(layout="NHWC", classes=4, thumbnail=True)
+    net.initialize(mx.init.Xavier())
+    tr = data_parallel.DataParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 1e-3}, compute_dtype="bfloat16")
+    rng = np.random.RandomState(3)
+    x = rng.rand(8, 16, 16, 3).astype(np.float32)
+    y = rng.randint(0, 4, 8).astype(np.float32)
+    losses = [float(tr.step(x, y).asscalar()) for _ in range(8)]
+    assert all(np.isfinite(v) for v in losses), losses
+    assert min(losses[4:]) < losses[0], losses
+    # master params stayed fp32 under bf16 compute
+    assert all(r.dtype == np.float32 for r in tr._params
+               if jax.numpy.issubdtype(r.dtype, jax.numpy.floating))

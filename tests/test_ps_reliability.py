@@ -1,0 +1,103 @@
+"""PS transport reliability: resend, dedup, heartbeat failure detection.
+
+Ref: ps-lite Van resend (PS_RESEND) + Postoffice heartbeats — the
+reference's thin failure-detection tier (SURVEY §5 "failure
+detection/elastic recovery").
+"""
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel.ps import PSClient, PSServer
+
+
+def _start_server():
+    srv = PSServer(0)  # ephemeral port
+    srv.start()
+    return srv
+
+
+def test_push_dedup_by_worker_seq():
+    srv = _start_server()
+    try:
+        srv._handle(("init", "w", np.zeros(3, np.float32)))
+        g = np.ones(3, np.float32)
+        assert srv._handle(("push", "w", g, 7, 1)) == ("ok",)
+        # resend of the same (worker, seq): acknowledged, NOT re-applied
+        assert srv._handle(("push", "w", g, 7, 1)) == ("ok", "dup")
+        np.testing.assert_array_equal(
+            srv._handle(("pull", "w"))[1], np.ones(3, np.float32))
+        # next seq applies
+        assert srv._handle(("push", "w", g, 7, 2)) == ("ok",)
+        np.testing.assert_array_equal(
+            srv._handle(("pull", "w"))[1], 2 * np.ones(3, np.float32))
+        # other workers have independent seq spaces
+        assert srv._handle(("push", "w", g, 8, 1)) == ("ok",)
+        np.testing.assert_array_equal(
+            srv._handle(("pull", "w"))[1], 3 * np.ones(3, np.float32))
+    finally:
+        srv.stop()
+
+
+def test_client_reconnects_after_server_restart():
+    srv = _start_server()
+    port = srv.port
+    cli = PSClient([("127.0.0.1", port)], timeout=5, retries=4,
+                   worker_id=1)
+    try:
+        cli.init("k", np.arange(4, dtype=np.float32))
+        assert cli.pull("k")[2] == 2.0
+        # kill the server under the client, then bring a fresh one up on
+        # the same port — the client must resend on a new connection
+        srv.stop()
+        time.sleep(0.1)
+        srv = PSServer(port).start()
+        srv._handle(("init", "k", np.arange(4, dtype=np.float32) * 10))
+        out = cli.pull("k")
+        assert out[2] == 20.0
+        # pushes survive the retry path without double-apply
+        cli.push("k", np.ones(4, np.float32))
+        np.testing.assert_array_equal(
+            cli.pull("k"), np.arange(4, dtype=np.float32) * 10 + 1)
+    finally:
+        cli.close()
+        srv.stop()
+
+
+def test_heartbeat_marks_dead_server():
+    srv = _start_server()
+    deaths = []
+    cli = PSClient([("127.0.0.1", srv.port)], timeout=2, retries=0,
+                   worker_id=2, heartbeat_interval=0.05, dead_after=2,
+                   on_server_death=lambda i, ep, why: deaths.append(
+                       (i, ep, why)))
+    try:
+        cli.init("k", np.zeros(2, np.float32))
+        assert cli.alive() == [("127.0.0.1", srv.port)]
+        srv.stop()
+        deadline = time.time() + 5
+        while cli.alive() and time.time() < deadline:
+            time.sleep(0.05)
+        assert cli.alive() == []
+        assert deaths and deaths[0][0] == 0
+        # subsequent calls fail FAST with the failure cause
+        t0 = time.time()
+        with pytest.raises(mx.MXNetError, match="dead"):
+            cli.pull("k")
+        assert time.time() - t0 < 1.0
+    finally:
+        cli.close()
+
+
+def test_unreachable_server_raises_diagnosable_error():
+    srv = _start_server()
+    cli = PSClient([("127.0.0.1", srv.port)], timeout=2, retries=1,
+                   worker_id=3)
+    srv.stop()
+    time.sleep(0.1)
+    with pytest.raises(mx.MXNetError, match="unreachable|dead"):
+        for _ in range(3):  # first calls may drain buffered replies
+            cli.pull("k")
+    cli.close()

@@ -105,7 +105,7 @@ def test_module_fit_convergence():
                              label_name="softmax_label")
     mod = Module(s, context=mx.cpu())
     mod.fit(train_iter, num_epoch=12, optimizer="sgd",
-            optimizer_params={"learning_rate": 0.5},
+            optimizer_params={"learning_rate": 0.2},
             initializer=mx.init.Xavier())
     from mxnet_tpu import metric
 

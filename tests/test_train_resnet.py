@@ -1,0 +1,65 @@
+"""ResNet-class train-to-accuracy gate.
+
+Ref: tests/python/train/ (training-as-test: mlp-on-mnist asserting a
+final accuracy threshold) — upgraded to a ResNet so the full
+conv/BN/residual/pool stack, the compiled SPMD step, bf16 compute and
+the optimizer are all under the convergence gate, not just LeNet.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.gluon.model_zoo import vision
+from mxnet_tpu.parallel import data_parallel
+
+
+def _synthetic_imageset(n_cls=4, n_per=24, size=12, noise=0.25, seed=5):
+    """Class-prototype images + noise: separable but not trivial."""
+    rng = np.random.RandomState(seed)
+    protos = rng.rand(n_cls, size, size, 3).astype(np.float32)
+    xs, ys = [], []
+    for c in range(n_cls):
+        x = protos[c][None] + noise * rng.randn(
+            n_per, size, size, 3).astype(np.float32)
+        xs.append(x)
+        ys.append(np.full(n_per, c, np.float32))
+    x = np.concatenate(xs)
+    y = np.concatenate(ys)
+    order = rng.permutation(len(x))
+    return x[order], y[order]
+
+
+def test_resnet18_trains_to_accuracy():
+    mx.random.seed(7)
+    x, y = _synthetic_imageset()
+    net = vision.resnet18_v1(classes=4, thumbnail=True, layout="NHWC")
+    net.initialize(mx.init.Xavier())
+    # eval-mode accuracy is part of the gate: drop the BN EMA horizon so
+    # the moving stats converge within the short training budget
+    # (momentum 0.9 needs ~90 steps; 0.6^30 ≈ 2e-7 residual)
+    def _set_bn_momentum(block):
+        from mxnet_tpu.gluon import nn as gnn
+
+        for child in block._children.values():
+            _set_bn_momentum(child)
+        if isinstance(block, gnn.BatchNorm):
+            block._kwargs["momentum"] = 0.6
+    _set_bn_momentum(net)
+    tr = data_parallel.DataParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 2e-3}, compute_dtype="bfloat16")
+    bs = 32
+    losses = []
+    for epoch in range(10):
+        for i in range(0, len(x), bs):
+            losses.append(float(
+                tr.step(x[i:i + bs], y[i:i + bs]).asscalar()))
+    assert all(np.isfinite(v) for v in losses), losses[-5:]
+    # inference pass with the trained params
+    tr.sync_to_block()
+    preds = []
+    for i in range(0, len(x), bs):
+        out = net(nd.array(x[i:i + bs]))
+        preds.append(out.asnumpy().argmax(1))
+    acc = (np.concatenate(preds) == y).mean()
+    assert acc >= 0.9, (acc, losses[-5:])
