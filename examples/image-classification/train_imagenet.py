@@ -60,8 +60,15 @@ def data_source(args):
     else:
         it = mx.io.ImageRecordIter(
             path_imgrec=args.data_train, data_shape=(c, h, w),
-            batch_size=args.batch_size, shuffle=True, rand_crop=True,
-            rand_mirror=True, resize=256,
+            batch_size=args.batch_size, shuffle=True,
+            rand_mirror=True,
+            # the standard ImageNet recipe: area/aspect-sampled crops
+            # + color jitter (ref: image_aug_default.cc defaults used by
+            # example/image-classification)
+            random_resized_crop=True, min_random_area=0.08,
+            max_random_area=1.0, min_aspect_ratio=0.75,
+            max_aspect_ratio=1.333, brightness=0.4, contrast=0.4,
+            saturation=0.4,
             mean_r=123.68, mean_g=116.779, mean_b=103.939,
             std_r=58.393, std_g=57.12, std_b=57.375,
             preprocess_threads=args.data_nthreads)

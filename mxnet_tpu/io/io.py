@@ -377,10 +377,26 @@ class ImageRecordIter(DataIter):
                  rand_mirror=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
                  std_r=1.0, std_g=1.0, std_b=1.0, resize=-1,
                  label_width=1, preprocess_threads=4, prefetch_buffer=2,
-                 round_batch=True, seed=0, use_native=None, **kwargs):
+                 round_batch=True, seed=0, use_native=None,
+                 random_resized_crop=False, min_random_area=1.0,
+                 max_random_area=1.0, min_aspect_ratio=1.0,
+                 max_aspect_ratio=1.0, brightness=0.0, contrast=0.0,
+                 saturation=0.0, random_h=0.0, inter_method=1, **kwargs):
         super().__init__(batch_size)
         from . import recordio as rio
 
+        # augmentation tier (ref: image_aug_default.cc —
+        # max_random_area/max_aspect_ratio sampled crops, HSL jitter,
+        # inter_method choices)
+        self.aug = dict(
+            random_resized_crop=bool(random_resized_crop),
+            min_random_area=float(min_random_area),
+            max_random_area=float(max_random_area),
+            min_aspect_ratio=float(min_aspect_ratio),
+            max_aspect_ratio=float(max_aspect_ratio),
+            brightness=float(brightness), contrast=float(contrast),
+            saturation=float(saturation), random_h=float(random_h),
+            inter_method=int(inter_method))
         self.data_shape = tuple(data_shape)
         idx_path = path_imgidx or os.path.splitext(path_imgrec)[0] + ".idx"
         if os.path.exists(idx_path):
@@ -413,7 +429,7 @@ class ImageRecordIter(DataIter):
                     num_threads=preprocess_threads, shuffle=shuffle,
                     rand_crop=rand_crop, rand_mirror=rand_mirror,
                     resize_short=resize, mean=self.mean, std=self.std,
-                    seed=seed)
+                    seed=seed, **self.aug)
             elif use_native is True:
                 raise MXNetError("native pipeline requested but "
                                  "unavailable (need indexed JPEG .rec)")
@@ -535,27 +551,95 @@ class ImageRecordIter(DataIter):
         from PIL import Image
 
         c, h, w = self.data_shape
-        if self.resize > 0:
-            pil = Image.fromarray(img)
-            short = min(pil.size)
-            scale = self.resize / short
-            pil = pil.resize((max(w, int(pil.size[0] * scale)),
-                              max(h, int(pil.size[1] * scale))))
-            img = np.asarray(pil)
-        ih, iw = img.shape[:2]
-        if ih < h or iw < w:
-            pil = Image.fromarray(img).resize((max(w, iw), max(h, ih)))
-            img = np.asarray(pil)
+        aug = self.aug
+        interp = Image.NEAREST if self._pick_inter(rng) == 0 \
+            else Image.BILINEAR
+        if aug["random_resized_crop"]:
             ih, iw = img.shape[:2]
-        if self.rand_crop:
-            y0 = rng.randint(0, ih - h + 1)
-            x0 = rng.randint(0, iw - w + 1)
+            for _ in range(10):
+                area = rng.uniform(aug["min_random_area"],
+                                   aug["max_random_area"]) * ih * iw
+                ar = np.exp(rng.uniform(
+                    np.log(aug["min_aspect_ratio"]),
+                    np.log(aug["max_aspect_ratio"])))
+                tw = int(round(np.sqrt(area * ar)))
+                th = int(round(np.sqrt(area / ar)))
+                if 0 < tw <= iw and 0 < th <= ih:
+                    x0 = rng.randint(0, iw - tw + 1)
+                    y0 = rng.randint(0, ih - th + 1)
+                    img = img[y0:y0 + th, x0:x0 + tw]
+                    break
+            else:
+                s = min(ih, iw)
+                img = img[(ih - s) // 2:(ih - s) // 2 + s,
+                          (iw - s) // 2:(iw - s) // 2 + s]
+            img = np.asarray(Image.fromarray(img).resize((w, h), interp))
         else:
-            y0, x0 = (ih - h) // 2, (iw - w) // 2
-        img = img[y0:y0 + h, x0:x0 + w]
+            if self.resize > 0:
+                pil = Image.fromarray(img)
+                short = min(pil.size)
+                scale = self.resize / short
+                pil = pil.resize((max(w, int(pil.size[0] * scale)),
+                                  max(h, int(pil.size[1] * scale))),
+                                 interp)
+                img = np.asarray(pil)
+            ih, iw = img.shape[:2]
+            if ih < h or iw < w:
+                pil = Image.fromarray(img).resize((max(w, iw), max(h, ih)),
+                                                  interp)
+                img = np.asarray(pil)
+                ih, iw = img.shape[:2]
+            if self.rand_crop:
+                y0 = rng.randint(0, ih - h + 1)
+                x0 = rng.randint(0, iw - w + 1)
+            else:
+                y0, x0 = (ih - h) // 2, (iw - w) // 2
+            img = img[y0:y0 + h, x0:x0 + w]
         if self.rand_mirror and rng.rand() < 0.5:
             img = img[:, ::-1]
-        return img
+        return self._color_jitter(img, rng)
+
+    def _pick_inter(self, rng):
+        m = self.aug["inter_method"]
+        if m in (9, 10):  # reference: random interpolation choice
+            return int(rng.randint(0, 2))
+        return m
+
+    def _color_jitter(self, img, rng):
+        """brightness -> contrast -> saturation -> hue, matching the
+        native pipeline's fused matrix (see src/recordio.cc)."""
+        aug = self.aug
+        if img.ndim != 3 or img.shape[2] != 3 or not any(
+                aug[k] > 0 for k in ("brightness", "contrast",
+                                     "saturation", "random_h")):
+            return img
+        v = img.astype(np.float32)
+        gw = np.array([0.299, 0.587, 0.114], np.float32)
+        if aug["brightness"] > 0:
+            v = v * (1.0 + rng.uniform(-1, 1) * aug["brightness"])
+        if aug["contrast"] > 0:
+            ac = 1.0 + rng.uniform(-1, 1) * aug["contrast"]
+            gray = (v @ gw).mean()
+            v = ac * v + (1 - ac) * gray
+        if aug["saturation"] > 0:
+            asat = 1.0 + rng.uniform(-1, 1) * aug["saturation"]
+            gray = (v @ gw)[..., None]
+            v = asat * v + (1 - asat) * gray
+        if aug["random_h"] > 0:
+            theta = rng.uniform(-1, 1) * aug["random_h"] / 180.0 * np.pi
+            cs, sn = np.cos(theta), np.sin(theta)
+            H = np.array(
+                [[0.299 + 0.701 * cs + 0.168 * sn,
+                  0.587 - 0.587 * cs + 0.330 * sn,
+                  0.114 - 0.114 * cs - 0.497 * sn],
+                 [0.299 - 0.299 * cs - 0.328 * sn,
+                  0.587 + 0.413 * cs + 0.035 * sn,
+                  0.114 - 0.114 * cs + 0.292 * sn],
+                 [0.299 - 0.300 * cs + 1.25 * sn,
+                  0.587 - 0.588 * cs - 1.05 * sn,
+                  0.114 + 0.886 * cs - 0.203 * sn]], np.float32)
+            v = v @ H.T
+        return np.clip(v, 0, 255).astype(np.uint8)
 
     def next(self):
         if self._native is not None:

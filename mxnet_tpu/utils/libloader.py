@@ -19,9 +19,28 @@ def repo_root():
         os.path.abspath(__file__))))
 
 
+def _stale(so, root):
+    """True when any src/*.{cc,h} is newer than the built .so — a stale
+    binary would silently run an OLD C ABI under new ctypes signatures
+    (extra args are dropped by the calling convention, no error)."""
+    if not os.path.exists(so):
+        return True
+    so_mtime = os.path.getmtime(so)
+    src = os.path.join(root, "src")
+    try:
+        for f in os.listdir(src):
+            if f.endswith((".cc", ".h", ".cpp")) and \
+                    os.path.getmtime(os.path.join(src, f)) > so_mtime:
+                return True
+    except OSError:
+        pass
+    return False
+
+
 def load_native_lib(so_name, make_target=None):
-    """Return the CDLL for lib/<so_name> (building it via make on first
-    miss), or None when native is unavailable/disabled."""
+    """Return the CDLL for lib/<so_name> (building it via make when
+    missing OR out of date vs src/), or None when native is
+    unavailable/disabled."""
     if getenv("NO_NATIVE", False, bool):
         return None  # env wins over the cache (tests toggle it)
     if so_name in _cache:
@@ -29,7 +48,7 @@ def load_native_lib(so_name, make_target=None):
     _cache[so_name] = None
     root = repo_root()
     so = os.path.join(root, "lib", so_name)
-    if not os.path.exists(so) and shutil.which("g++"):
+    if _stale(so, root) and shutil.which("g++"):
         try:
             cmd = ["make", "-C", root]
             if make_target:

@@ -59,7 +59,8 @@ def load():
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.POINTER(ctypes.c_float),
-        ctypes.POINTER(ctypes.c_float), ctypes.c_uint64]
+        ctypes.POINTER(ctypes.c_float), ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_float)]
     lib.MXTPUImagePipelineReset.argtypes = [ctypes.c_void_p,
                                             ctypes.c_uint64]
     lib.MXTPUImagePipelineNext.restype = ctypes.c_int
@@ -79,7 +80,11 @@ class NativeImagePipeline:
     def __init__(self, rec_path, offsets, data_shape, batch_size,
                  num_threads=4, shuffle=False, rand_crop=False,
                  rand_mirror=False, resize_short=-1, mean=(0, 0, 0),
-                 std=(1, 1, 1), seed=0):
+                 std=(1, 1, 1), seed=0, random_resized_crop=False,
+                 min_random_area=1.0, max_random_area=1.0,
+                 min_aspect_ratio=1.0, max_aspect_ratio=1.0,
+                 brightness=0.0, contrast=0.0, saturation=0.0,
+                 random_h=0.0, inter_method=1):
         lib = load()
         assert lib is not None, "native library unavailable"
         self._lib = lib
@@ -87,12 +92,17 @@ class NativeImagePipeline:
         offs = np.asarray(offsets, np.uint64)
         mean_arr = (ctypes.c_float * 3)(*[float(m) for m in mean])
         std_arr = (ctypes.c_float * 3)(*[float(s) for s in std])
+        aug = (ctypes.c_float * 10)(
+            float(bool(random_resized_crop)), float(min_random_area),
+            float(max_random_area), float(min_aspect_ratio),
+            float(max_aspect_ratio), float(brightness), float(contrast),
+            float(saturation), float(random_h), float(inter_method))
         self._handle = lib.MXTPUImagePipelineCreate(
             rec_path.encode(), offs.ctypes.data_as(
                 ctypes.POINTER(ctypes.c_uint64)), len(offs),
             data_shape[0], data_shape[1], data_shape[2], batch_size,
             num_threads, int(shuffle), int(rand_crop), int(rand_mirror),
-            int(resize_short), mean_arr, std_arr, seed)
+            int(resize_short), mean_arr, std_arr, seed, aug)
         assert self._handle, f"failed to open {rec_path}"
         self._epoch = 0
         self._data_buf = np.empty(self._shape, np.float32)

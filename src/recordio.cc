@@ -88,6 +88,22 @@ bool DecodeJpeg(const uint8_t* data, size_t len, std::vector<uint8_t>* out,
   return true;
 }
 
+// nearest-neighbour resize HWC uint8 (inter_method 0)
+void ResizeNearest(const uint8_t* src, int sw, int sh, int c,
+                   uint8_t* dst, int dw, int dh) {
+  const float sx = static_cast<float>(sw) / dw;
+  const float sy = static_cast<float>(sh) / dh;
+  for (int y = 0; y < dh; ++y) {
+    int yy = std::min(sh - 1, static_cast<int>((y + 0.5f) * sy));
+    for (int x = 0; x < dw; ++x) {
+      int xx = std::min(sw - 1, static_cast<int>((x + 0.5f) * sx));
+      for (int ch = 0; ch < c; ++ch) {
+        dst[(y * dw + x) * c + ch] = src[(yy * sw + xx) * c + ch];
+      }
+    }
+  }
+}
+
 // bilinear resize HWC uint8
 void ResizeBilinear(const uint8_t* src, int sw, int sh, int c,
                     uint8_t* dst, int dw, int dh) {
@@ -127,7 +143,23 @@ struct PipelineConfig {
   int resize_short;  // <=0: disabled
   float mean[3], std_[3];
   uint64_t seed;
+  // augmentation tier (ref: src/io/image_aug_default.cc):
+  int random_resized_crop = 0;      // area/aspect-sampled crop
+  float min_area = 1.f, max_area = 1.f;        // fraction of source
+  float min_aspect = 1.f, max_aspect = 1.f;    // w/h ratio range
+  float brightness = 0.f, contrast = 0.f, saturation = 0.f;
+  float hue_deg = 0.f;              // max |hue shift|, OpenCV half-deg
+  int inter_method = 1;             // 0 nearest, 1 bilinear, 9/10 random
 };
+
+void Resize(const uint8_t* src, int sw, int sh, int c, uint8_t* dst,
+            int dw, int dh, int method) {
+  if (method == 0) {
+    ResizeNearest(src, sw, sh, c, dst, dw, dh);
+  } else {
+    ResizeBilinear(src, sw, sh, c, dst, dw, dh);
+  }
+}
 
 struct Batch {
   std::vector<float> data;
@@ -212,38 +244,154 @@ struct ImagePipeline {
       std::fill(out, out + static_cast<size_t>(cfg.c) * cfg.h * cfg.w, 0.f);
       return;
     }
-    // resize shorter side
+    std::uniform_real_distribution<float> u01(0.f, 1.f);
+    int inter = cfg.inter_method;
+    if (inter == 9 || inter == 10) inter = ((*rng)() & 1) ? 1 : 0;
+
     std::vector<uint8_t> resized;
-    if (cfg.resize_short > 0) {
-      int shorter = std::min(w, hh);
-      float scale = static_cast<float>(cfg.resize_short) / shorter;
-      int nw = std::max(cfg.w, static_cast<int>(w * scale + 0.5f));
-      int nh = std::max(cfg.h, static_cast<int>(hh * scale + 0.5f));
-      resized.resize(static_cast<size_t>(nw) * nh * ch);
-      ResizeBilinear(pixels.data(), w, hh, ch, resized.data(), nw, nh);
+    int x0 = 0, y0 = 0;
+    if (cfg.random_resized_crop) {
+      // area/aspect-sampled crop, resized to the target (ref:
+      // image_aug_default.cc max_random_area/max_aspect_ratio path)
+      int cw = -1, chh = -1;
+      for (int attempt = 0; attempt < 10 && cw < 0; ++attempt) {
+        float area = (cfg.min_area +
+                      u01(*rng) * (cfg.max_area - cfg.min_area)) *
+                     static_cast<float>(w) * hh;
+        float la = std::log(cfg.min_aspect), lb = std::log(cfg.max_aspect);
+        float ar = std::exp(la + u01(*rng) * (lb - la));
+        int tw = static_cast<int>(std::sqrt(area * ar) + 0.5f);
+        int th = static_cast<int>(std::sqrt(area / ar) + 0.5f);
+        if (tw > 0 && th > 0 && tw <= w && th <= hh) {
+          cw = tw;
+          chh = th;
+        }
+      }
+      if (cw < 0) {  // fallback: largest centered square
+        cw = chh = std::min(w, hh);
+      }
+      x0 = (w == cw) ? 0 : static_cast<int>((*rng)() % (w - cw + 1));
+      y0 = (hh == chh) ? 0 : static_cast<int>((*rng)() % (hh - chh + 1));
+      std::vector<uint8_t> crop(static_cast<size_t>(cw) * chh * ch);
+      for (int y = 0; y < chh; ++y) {
+        std::memcpy(crop.data() + static_cast<size_t>(y) * cw * ch,
+                    pixels.data() +
+                        (static_cast<size_t>(y0 + y) * w + x0) * ch,
+                    static_cast<size_t>(cw) * ch);
+      }
+      resized.resize(static_cast<size_t>(cfg.w) * cfg.h * ch);
+      Resize(crop.data(), cw, chh, ch, resized.data(), cfg.w, cfg.h,
+             inter);
       pixels.swap(resized);
-      w = nw;
-      hh = nh;
-    }
-    if (w < cfg.w || hh < cfg.h) {
-      int nw = std::max(w, cfg.w), nh = std::max(hh, cfg.h);
-      resized.resize(static_cast<size_t>(nw) * nh * ch);
-      ResizeBilinear(pixels.data(), w, hh, ch, resized.data(), nw, nh);
-      pixels.swap(resized);
-      w = nw;
-      hh = nh;
-    }
-    // crop
-    int x0, y0;
-    if (cfg.rand_crop) {
-      x0 = static_cast<int>((*rng)() % (w - cfg.w + 1));
-      y0 = static_cast<int>((*rng)() % (hh - cfg.h + 1));
+      w = cfg.w;
+      hh = cfg.h;
+      x0 = y0 = 0;
     } else {
-      x0 = (w - cfg.w) / 2;
-      y0 = (hh - cfg.h) / 2;
+      // resize shorter side
+      if (cfg.resize_short > 0) {
+        int shorter = std::min(w, hh);
+        float scale = static_cast<float>(cfg.resize_short) / shorter;
+        int nw = std::max(cfg.w, static_cast<int>(w * scale + 0.5f));
+        int nh = std::max(cfg.h, static_cast<int>(hh * scale + 0.5f));
+        resized.resize(static_cast<size_t>(nw) * nh * ch);
+        Resize(pixels.data(), w, hh, ch, resized.data(), nw, nh, inter);
+        pixels.swap(resized);
+        w = nw;
+        hh = nh;
+      }
+      if (w < cfg.w || hh < cfg.h) {
+        int nw = std::max(w, cfg.w), nh = std::max(hh, cfg.h);
+        resized.resize(static_cast<size_t>(nw) * nh * ch);
+        Resize(pixels.data(), w, hh, ch, resized.data(), nw, nh, inter);
+        pixels.swap(resized);
+        w = nw;
+        hh = nh;
+      }
+      if (cfg.rand_crop) {
+        x0 = static_cast<int>((*rng)() % (w - cfg.w + 1));
+        y0 = static_cast<int>((*rng)() % (hh - cfg.h + 1));
+      } else {
+        x0 = (w - cfg.w) / 2;
+        y0 = (hh - cfg.h) / 2;
+      }
     }
     bool mirror = cfg.rand_mirror && ((*rng)() & 1);
-    // HWC crop -> CHW normalized
+
+    // color jitter as ONE per-image 3x3 matrix + offset (brightness →
+    // contrast → saturation → hue composed; saturation/hue preserve the
+    // gray axis so only contrast contributes an offset).  Applied in
+    // float during the normalize pass — no extra image-sized buffer.
+    bool jitter = ch == 3 &&
+                  (cfg.brightness > 0.f || cfg.contrast > 0.f ||
+                   cfg.saturation > 0.f || cfg.hue_deg > 0.f);
+    bool use_hue = cfg.hue_deg > 0.f;
+    float M[3][3] = {{1, 0, 0}, {0, 1, 0}, {0, 0, 1}};
+    float off = 0.f;
+    if (jitter) {
+      auto uj = [&](float j) {
+        return 1.f + (2.f * u01(*rng) - 1.f) * j;
+      };
+      float ab = cfg.brightness > 0.f ? uj(cfg.brightness) : 1.f;
+      float ac = cfg.contrast > 0.f ? uj(cfg.contrast) : 1.f;
+      float as = cfg.saturation > 0.f ? uj(cfg.saturation) : 1.f;
+      const float gw[3] = {0.299f, 0.587f, 0.114f};
+      if (ac != 1.f) {
+        double gsum = 0;
+        for (int y = 0; y < cfg.h; ++y) {
+          for (int x = 0; x < cfg.w; ++x) {
+            const uint8_t* p = pixels.data() +
+                ((static_cast<size_t>(y0 + y) * w) + x0 + x) * 3;
+            gsum += gw[0] * p[0] + gw[1] * p[1] + gw[2] * p[2];
+          }
+        }
+        float gray0 = static_cast<float>(
+            gsum / (static_cast<double>(cfg.h) * cfg.w));
+        off = (1.f - ac) * ab * gray0;
+      }
+      // S = as*I + (1-as) * 1 * gw^T   (rows identical in the 2nd term)
+      float S[3][3];
+      for (int r = 0; r < 3; ++r) {
+        for (int col = 0; col < 3; ++col) {
+          S[r][col] = (r == col ? as : 0.f) + (1.f - as) * gw[col];
+        }
+      }
+      if (use_hue) {
+        // hue rotation about the gray axis (YIQ approximation; the
+        // reference's HSL conversion is per-pixel — same capability,
+        // cheaper math).  hue_deg is in OpenCV half-degrees (max 180).
+        // Skipped entirely at hue_deg=0: the YIQ constants don't
+        // round-trip exactly and would bias channels at theta=0.
+        float theta = (2.f * u01(*rng) - 1.f) * cfg.hue_deg / 180.f *
+                      3.14159265f;
+        float cs = std::cos(theta), sn = std::sin(theta);
+        const float H[3][3] = {
+            {0.299f + 0.701f * cs + 0.168f * sn,
+             0.587f - 0.587f * cs + 0.330f * sn,
+             0.114f - 0.114f * cs - 0.497f * sn},
+            {0.299f - 0.299f * cs - 0.328f * sn,
+             0.587f + 0.413f * cs + 0.035f * sn,
+             0.114f - 0.114f * cs + 0.292f * sn},
+            {0.299f - 0.300f * cs + 1.25f * sn,
+             0.587f - 0.588f * cs - 1.05f * sn,
+             0.114f + 0.886f * cs - 0.203f * sn}};
+        // M = H * S * (ab*ac)
+        for (int r = 0; r < 3; ++r) {
+          for (int col = 0; col < 3; ++col) {
+            M[r][col] = 0.f;
+            for (int k = 0; k < 3; ++k) M[r][col] += H[r][k] * S[k][col];
+            M[r][col] *= ab * ac;
+          }
+        }
+      } else {
+        for (int r = 0; r < 3; ++r) {
+          for (int col = 0; col < 3; ++col) {
+            M[r][col] = S[r][col] * ab * ac;
+          }
+        }
+      }
+    }
+
+    // HWC crop -> CHW normalized (jitter matrix fused in)
     for (int cc = 0; cc < cfg.c; ++cc) {
       float m = cfg.mean[cc < 3 ? cc : 0];
       float s = cfg.std_[cc < 3 ? cc : 0];
@@ -251,9 +399,17 @@ struct ImagePipeline {
       for (int y = 0; y < cfg.h; ++y) {
         for (int x = 0; x < cfg.w; ++x) {
           int sx = mirror ? (cfg.w - 1 - x) : x;
-          uint8_t v =
-              pixels[((y0 + y) * w + (x0 + sx)) * ch + (ch == 1 ? 0 : cc)];
-          dst[y * cfg.w + x] = (static_cast<float>(v) - m) / s;
+          const uint8_t* p =
+              pixels.data() +
+              (static_cast<size_t>(y0 + y) * w + (x0 + sx)) * ch;
+          float v;
+          if (jitter) {
+            v = M[cc][0] * p[0] + M[cc][1] * p[1] + M[cc][2] * p[2] + off;
+            v = std::min(255.f, std::max(0.f, v));
+          } else {
+            v = static_cast<float>(p[ch == 1 ? 0 : cc]);
+          }
+          dst[y * cfg.w + x] = (v - m) / s;
         }
       }
     }
@@ -388,13 +544,16 @@ void MXTPURecordIOReaderFree(void* handle) {
 }
 
 // ---- Image pipeline ----
+// aug: 10 floats — {random_resized_crop, min_area, max_area, min_aspect,
+// max_aspect, brightness, contrast, saturation, hue_deg, inter_method};
+// may be null (no augmentation beyond crop/mirror).
 void* MXTPUImagePipelineCreate(const char* rec_path,
                                const uint64_t* offsets, uint64_t n,
                                int c, int h, int w, int batch_size,
                                int num_threads, int shuffle, int rand_crop,
                                int rand_mirror, int resize_short,
                                const float* mean, const float* std_,
-                               uint64_t seed) {
+                               uint64_t seed, const float* aug) {
   auto* p = new ImagePipeline;
   p->f = fopen(rec_path, "rb");
   if (!p->f) {
@@ -406,6 +565,18 @@ void* MXTPUImagePipelineCreate(const char* rec_path,
                           rand_crop, rand_mirror, resize_short,
                           {mean[0], mean[1], mean[2]},
                           {std_[0], std_[1], std_[2]}, seed};
+  if (aug != nullptr) {
+    p->cfg.random_resized_crop = aug[0] > 0.5f;
+    p->cfg.min_area = aug[1];
+    p->cfg.max_area = aug[2];
+    p->cfg.min_aspect = aug[3];
+    p->cfg.max_aspect = aug[4];
+    p->cfg.brightness = aug[5];
+    p->cfg.contrast = aug[6];
+    p->cfg.saturation = aug[7];
+    p->cfg.hue_deg = aug[8];
+    p->cfg.inter_method = static_cast<int>(aug[9]);
+  }
   p->epoch_seed = seed;
   return p;
 }
