@@ -102,7 +102,20 @@ def invoke(fn, *args, jit_compile=True, nondiff=False, **kwargs):
         profiler.record_op(getattr(fn, "__name__", "op").lstrip("_k_"),
                            t0, _time.perf_counter() * 1e6)
     elif jit_compile:
-        out = get_jitted(fn, kwargs)(*raws)
+        try:
+            out = get_jitted(fn, kwargs)(*raws)
+        except ValueError as e:
+            if "incompatible devices" not in str(e):
+                raise
+            # ref: MXNet requires operands on ONE context and says so
+            # plainly (CheckAndAlloc ctx checks) — surface that instead
+            # of the raw jax placement error
+            devs = sorted({str(d) for r in raws
+                           if hasattr(r, "devices") for d in r.devices()})
+            raise MXNetError(
+                f"operator '{getattr(fn, '__name__', 'op')}' requires "
+                f"all inputs on one context, got {devs}; move inputs "
+                f"with as_in_context()/copyto()") from e
     else:
         out = fn(*raws, **kwargs)
 

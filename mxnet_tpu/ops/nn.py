@@ -13,6 +13,8 @@ costs nothing at steady state.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -563,3 +565,44 @@ def _k_bilinear_resize(data, *, height=0, width=0, scale_height=None,
 
 register("_contrib_BilinearResize2D", _k_bilinear_resize,
          aliases=("bilinear_resize_2d",))
+
+
+# ---------------------------------------------------------------------------
+# SVMOutput (ref: src/operator/svm_output.cc): identity forward, hinge
+# (or squared-hinge) gradient w.r.t. the scores
+
+
+def _k_svm_output(data, label, *, margin=1.0, regularization_coefficient=1.0,
+                  use_linear=False):
+    return _svm_core(data, label, float(margin),
+                     float(regularization_coefficient), bool(use_linear))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _svm_core(data, label, margin, reg, linear):
+    return data
+
+def _svm_fwd(data, label, margin, reg, linear):
+    return data, (data, label)
+
+def _svm_bwd(margin, reg, linear, res, g):
+    data, label = res
+    k = data.shape[1]
+    lab = label.astype(jnp.int32).reshape(-1)
+    onehot = jax.nn.one_hot(lab, k, dtype=data.dtype)
+    score_y = jnp.take_along_axis(data, lab[:, None], axis=1)
+    viol = (margin - (score_y - data)) > 0  # margin violated per class
+    viol = jnp.logical_and(viol, onehot == 0)
+    if linear:
+        gj = jnp.where(viol, reg, 0.0).astype(data.dtype)
+    else:
+        gj = jnp.where(viol, 2.0 * reg * (margin - (score_y - data)),
+                       0.0).astype(data.dtype)
+    gy = -gj.sum(axis=1, keepdims=True)
+    grad = gj + onehot * gy
+    return (grad * g, jnp.zeros_like(label))
+
+_svm_core.defvjp(_svm_fwd, _svm_bwd)
+
+register("SVMOutput", _k_svm_output, arg_names=("data", "label"),
+         aliases=("svm_output",))

@@ -647,3 +647,91 @@ def _k_div_sqrt_dim(data):
     return data / jnp.sqrt(jnp.asarray(data.shape[-1], data.dtype))
 
 register("_contrib_div_sqrt_dim", _k_div_sqrt_dim)
+
+
+# ---------------------------------------------------------------------------
+# long-tail parity ops (round 2 audit vs src/operator/tensor/)
+
+
+def _k_cumsum(a, *, axis=None, dtype=None):
+    """Cumulative sum (ref: np_cumsum / mx.nd.cumsum)."""
+    x = a if dtype is None else a.astype(dtype)
+    if axis is None:
+        return jnp.cumsum(x.reshape(-1))
+    return jnp.cumsum(x, axis=axis)
+
+register("cumsum", _k_cumsum, arg_names=("a",))
+
+
+def _k_fix(data):
+    """Round toward zero (ref: fix op)."""
+    return jnp.trunc(data)
+
+register("fix", _k_fix)
+
+
+def _k_batch_take(a, indices):
+    """a[i, indices[i]] per batch row (ref: batch_take)."""
+    idx = indices.astype(jnp.int32).reshape(-1)
+    return a[jnp.arange(a.shape[0]), idx]
+
+register("batch_take", _k_batch_take, arg_names=("a", "indices"))
+
+
+def _row_major_strides(shape):
+    """Integer row-major strides for a dim tuple (shared by ravel/
+    unravel) — float stride math corrupts indices past the mantissa
+    (2^24 for the default float32).  jnp's widest int (int32 unless
+    jax_enable_x64) covers tensors to 2^31 elements."""
+    idt = jnp.asarray(0).dtype  # int32, or int64 under x64
+    dims = jnp.asarray(shape, idt)
+    return dims, jnp.concatenate(
+        [jnp.cumprod(dims[::-1])[::-1][1:], jnp.ones((1,), idt)])
+
+
+def _k_ravel_multi_index(data, *, shape):
+    """N-d coords -> flat indices (ref: _ravel_multi_index).
+    data: (ndim, n) array, shape: target dims.  Output is integer:
+    a float32 result would corrupt indices past the 2^24 mantissa."""
+    _, strides = _row_major_strides(shape)
+    flat = (data.astype(strides.dtype) * strides[:, None]).sum(axis=0)
+    if jnp.issubdtype(data.dtype, jnp.integer):
+        return flat.astype(data.dtype)
+    return flat.astype(jnp.int32)
+
+register("_ravel_multi_index", _k_ravel_multi_index,
+         aliases=("ravel_multi_index",), nondiff=True)
+
+
+def _k_unravel_index(data, *, shape):
+    """Flat indices -> N-d coords, output (ndim,) + data.shape
+    (ref: _unravel_index)."""
+    dims, strides = _row_major_strides(shape)
+    flat = data.astype(strides.dtype).reshape(-1)
+    coords = (flat[None, :] // strides[:, None]) % dims[:, None]
+    out = coords.reshape((len(shape),) + data.shape)
+    if jnp.issubdtype(data.dtype, jnp.integer):
+        return out.astype(data.dtype)
+    return out.astype(jnp.int32)
+
+register("_unravel_index", _k_unravel_index,
+         aliases=("unravel_index",), nondiff=True)
+
+
+def _k_crop(data, *, offset=(0, 0), h_w=(0, 0), center_crop=False):
+    """Legacy Crop op on NCHW (ref: src/operator/crop.cc)."""
+    H, W = data.shape[2], data.shape[3]
+    ch, cw = int(h_w[0]) or H, int(h_w[1]) or W
+    if center_crop:
+        y0, x0 = (H - ch) // 2, (W - cw) // 2
+    else:
+        y0, x0 = int(offset[0]), int(offset[1])
+    # ref crop.cc CHECKs bounds; silent truncation/wraparound would
+    # surface as a confusing shape mismatch far downstream
+    if y0 < 0 or x0 < 0 or y0 + ch > H or x0 + cw > W:
+        raise ValueError(
+            f"Crop out of bounds: offset=({y0},{x0}) h_w=({ch},{cw}) "
+            f"on input {H}x{W}")
+    return data[:, :, y0:y0 + ch, x0:x0 + cw]
+
+register("Crop", _k_crop, aliases=("crop_legacy",))

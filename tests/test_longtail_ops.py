@@ -293,3 +293,100 @@ def test_check_numeric_gradient_linalg():
 
     check_numeric_gradient(f, [nd.array(A.astype(np.float32))],
                            rtol=1e-2, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# round-2 audit additions: cumsum/fix/batch_take/ravel/unravel/Crop/SVMOutput
+
+
+def test_cumsum():
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    np.testing.assert_allclose(nd.cumsum(nd.array(x), axis=1).asnumpy(),
+                               np.cumsum(x, axis=1))
+    np.testing.assert_allclose(nd.cumsum(nd.array(x)).asnumpy(),
+                               np.cumsum(x))
+
+
+def test_fix_rounds_toward_zero():
+    x = np.array([-1.7, -0.5, 0.5, 1.7], np.float32)
+    np.testing.assert_array_equal(nd.fix(nd.array(x)).asnumpy(),
+                                  np.fix(x))
+
+
+def test_batch_take():
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    idx = np.array([1, 3, 0], np.float32)
+    out = nd.batch_take(nd.array(a), nd.array(idx)).asnumpy()
+    np.testing.assert_array_equal(out, a[np.arange(3), idx.astype(int)])
+
+
+def test_ravel_unravel_roundtrip():
+    shape = (4, 5, 6)
+    coords = np.array([[1, 3, 0], [4, 0, 2], [5, 1, 3]], np.int64)
+    flat = nd.ravel_multi_index(nd.array(coords.astype(np.float32)),
+                                shape=shape).asnumpy()
+    expect = np.ravel_multi_index(tuple(coords), shape)
+    np.testing.assert_array_equal(flat.astype(np.int64), expect)
+    back = nd.unravel_index(nd.array(flat), shape=shape).asnumpy()
+    np.testing.assert_array_equal(back.astype(np.int64), coords)
+
+
+def test_crop_legacy():
+    x = np.arange(2 * 3 * 6 * 8, dtype=np.float32).reshape(2, 3, 6, 8)
+    out = nd.Crop(nd.array(x), offset=(1, 2), h_w=(4, 5)).asnumpy()
+    np.testing.assert_array_equal(out, x[:, :, 1:5, 2:7])
+    cc = nd.Crop(nd.array(x), h_w=(4, 4), center_crop=True).asnumpy()
+    np.testing.assert_array_equal(cc, x[:, :, 1:5, 2:6])
+
+
+def test_svm_output_forward_and_grad():
+    from mxnet_tpu import autograd
+
+    scores = np.array([[2.0, 1.0, 0.5], [0.0, 3.0, 2.9]], np.float32)
+    label = np.array([0, 1], np.float32)
+    s = nd.array(scores)
+    s.attach_grad()
+    with autograd.record():
+        out = nd.SVMOutput(s, nd.array(label), margin=1.0)
+    # forward is identity on the scores
+    np.testing.assert_array_equal(out.asnumpy(), scores)
+    out.backward()
+    g = s.grad.asnumpy()
+    # row 0: class1 violates (1 - (2-1) = 0, not > 0) -> no violation;
+    # class2: 1 - (2-0.5) = -0.5 -> none; grad row 0 all zero
+    assert np.allclose(g[0], 0.0), g
+    # row 1: class2 violates (1 - (3-2.9) = 0.9 > 0); class0: 1-3 < 0
+    assert g[1, 2] > 0 and g[1, 1] < 0 and g[1, 0] == 0, g
+    assert np.isclose(g[1].sum(), 0.0), g  # hinge grads balance
+
+
+def test_ravel_large_indices_no_float_corruption():
+    """flat indices past float32's 2^24 mantissa must stay exact
+    (regression: float-dtype stride math corrupted them)."""
+    shape = (3000, 3000, 3)
+    coords = np.array([[2999], [2999], [2]], np.int32)
+    flat = nd.ravel_multi_index(nd.array(coords, dtype=np.int32),
+                                shape=shape).asnumpy()
+    assert int(flat[0]) == 26999999, flat
+    back = nd.unravel_index(nd.array([26999999], dtype=np.int32),
+                            shape=shape).asnumpy()
+    np.testing.assert_array_equal(back.astype(np.int64).reshape(-1),
+                                  [2999, 2999, 2])
+
+
+def test_unravel_index_nd_input():
+    flat = np.array([[5, 23], [11, 0]], np.float32)
+    out = nd.unravel_index(nd.array(flat), shape=(4, 6)).asnumpy()
+    expect = np.stack(np.unravel_index(flat.astype(np.int64), (4, 6)))
+    assert out.shape == (2, 2, 2)
+    np.testing.assert_array_equal(out.astype(np.int64), expect)
+
+
+def test_crop_out_of_bounds_raises():
+    import pytest
+
+    x = nd.zeros((1, 1, 6, 8))
+    with pytest.raises(Exception, match="out of bounds"):
+        nd.Crop(x, offset=(4, 0), h_w=(4, 8))
+    with pytest.raises(Exception, match="out of bounds"):
+        nd.Crop(x, offset=(-1, 0), h_w=(2, 2))
