@@ -1,0 +1,78 @@
+"""Pallas BN-stats kernel parity (interpret mode on CPU)."""
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+
+def test_bn_stats_matches_jnp(interpret_pallas):
+    from mxnet_tpu.ops.pallas import batch_norm as pbn
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(512, 64).astype(np.float32) * 3 + 1
+    s, q = pbn.bn_stats(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(s), x.sum(0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(q), (x * x).sum(0), rtol=1e-5)
+
+
+def test_bn_stats_bf16_accumulates_f32(interpret_pallas):
+    from mxnet_tpu.ops.pallas import batch_norm as pbn
+
+    rng = np.random.RandomState(1)
+    x = rng.randn(2048, 128).astype(np.float32)
+    s, q = pbn.bn_stats(jnp.asarray(x, jnp.bfloat16))
+    assert s.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(s),
+                               x.astype(jnp.bfloat16).astype(np.float32)
+                               .sum(0), rtol=2e-2)
+
+
+def test_bn_stats_gradient(interpret_pallas):
+    from mxnet_tpu.ops.pallas import batch_norm as pbn
+
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(64, 8).astype(np.float32))
+
+    def loss_pallas(x):
+        s, q = pbn.bn_stats(x)
+        return (s * 0.5).sum() + (q * 0.25).sum()
+
+    def loss_ref(x):
+        return (x.sum(0) * 0.5).sum() + ((x * x).sum(0) * 0.25).sum()
+
+    g1 = jax.grad(loss_pallas)(x)
+    g2 = jax.grad(loss_ref)(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-5)
+
+
+def test_batch_norm_pallas_path_parity(interpret_pallas, monkeypatch):
+    """_k_batch_norm with MXTPU_BN_STATS=pallas equals the jnp path."""
+    monkeypatch.setenv("MXTPU_BN_STATS", "pallas")
+    from mxnet_tpu.ops.nn import _k_batch_norm
+
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(8, 6, 6, 32).astype(np.float32))
+    gamma = jnp.asarray(rng.rand(32).astype(np.float32))
+    beta = jnp.asarray(rng.rand(32).astype(np.float32))
+    mm = jnp.zeros(32)
+    mv = jnp.ones(32)
+    out_p = _k_batch_norm(x, gamma, beta, mm, mv, axis=-1,
+                          fix_gamma=False, _train=True)
+    monkeypatch.setenv("MXTPU_BN_STATS", "jnp")
+    out_j = _k_batch_norm(x, gamma, beta, mm, mv, axis=-1,
+                          fix_gamma=False, _train=True)
+    for a, b in zip(out_p, out_j):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_stats_supported_gate():
+    from mxnet_tpu.ops.pallas import batch_norm as pbn
+
+    assert pbn.stats_supported(4096, 256)
+    assert not pbn.stats_supported(7, 256)  # no dividing block

@@ -14,15 +14,6 @@ import jax.numpy as jnp
 import mxnet_tpu as mx  # noqa: F401  (registers ops)
 
 
-@pytest.fixture()
-def interpret_pallas(monkeypatch):
-    from jax.experimental import pallas as pl
-
-    orig = pl.pallas_call
-    monkeypatch.setattr(pl, "pallas_call",
-                        functools.partial(orig, interpret=True))
-
-
 def _scan_lstm(x_proj, wh, h0, c0):
     """Oracle recurrence (same math as ops/rnn.py _step_fn('lstm'))."""
     def body(carry, xp_t):
