@@ -82,5 +82,9 @@ bn_stats.defvjp(_bn_stats_fwd, _bn_stats_bwd)
 
 
 def stats_supported(M, C):
-    """Host-side gate: True when the kernel can run for this shape."""
-    return _block_rows(M, C) is not None
+    """Host-side gate: True when the kernel can run for this shape.
+
+    C must be sublane-aligned (Mosaic pads lanes, but ragged C like 6
+    fails at lowering — which happens inside the OUTER jit compile,
+    past any try/except around the call site, so gate it here)."""
+    return C % 8 == 0 and _block_rows(M, C) is not None

@@ -6,7 +6,7 @@ from . import dist  # noqa: F401
 
 
 def __getattr__(name):
-    if name in ("mesh", "data_parallel", "ring_attention"):
+    if name in ("mesh", "data_parallel", "ring_attention", "ulysses"):
         import importlib
 
         mod = importlib.import_module("." + name, __name__)

@@ -94,11 +94,20 @@ def ring_attention(q, k, v, mesh=None, axis="sp", causal=False, scale=None):
         import jax as _jax
 
         mesh = mesh_mod.make_mesh({axis: len(_jax.devices())})
-    spec = PartitionSpec(None, None, axis, None)
+    out = _jitted(mesh, axis, causal, scale)(q, k, v)
+    return _wrap(out) if unwrap else out
 
-    fn = shard_map(
+
+@functools.lru_cache(maxsize=64)
+def _jitted(mesh, axis, causal, scale):
+    """Per-(mesh, axis, causal, scale) jitted shard_map — a fresh
+    jax.jit(fn) per call would recompile every step (jit caches by
+    function identity)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec
+
+    spec = PartitionSpec(None, None, axis, None)
+    return jax.jit(shard_map(
         functools.partial(ring_attention_sharded, axis_name=axis,
                           causal=causal, scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
-    out = jax.jit(fn)(q, k, v)
-    return _wrap(out) if unwrap else out
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))

@@ -1,0 +1,88 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+Ref capability: ABSENT in the reference (SURVEY §2.3 — it predates
+long-context); this is the second context-parallel mode the build plan
+calls for alongside ring attention ("ring attention or all-to-all
+sequence/context parallelism").
+
+Design (DeepSpeed-Ulysses recipe on ICI): activations arrive sharded
+over the sequence axis ((B, H, S/P, D) per device).  One
+``lax.all_to_all`` re-shards heads<->sequence so every device holds the
+FULL sequence for H/P heads, attention runs locally and exactly (any
+mask, causal included — no online-softmax recurrence needed), and a
+second all_to_all restores sequence sharding.  Communication volume is
+2·(B·H·S·D)/P per device vs ring attention's P k/v rotations — Ulysses
+wins when H >= P and attention is reused many times per layer; ring
+wins at extreme S where even one full-head sequence doesn't fit.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def ulysses_attention_sharded(q, k, v, axis_name, *, causal=False,
+                              scale=None):
+    """Run INSIDE shard_map: q,k,v are sequence shards
+    (batch, heads, seq/P, d); returns the local output shard."""
+    from ..ops.attention import sdpa_reference
+
+    # heads -> devices, sequence gathered: (B, H, S/P, D) -> (B, H/P, S, D)
+    def scatter_heads(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    out = sdpa_reference(scatter_heads(q), scatter_heads(k),
+                         scatter_heads(v), scale=scale, causal=causal)
+    # back: sequence -> devices, heads gathered
+    return jax.lax.all_to_all(out, axis_name, split_axis=2,
+                              concat_axis=1, tiled=True)
+
+
+def ulysses_attention(q, k, v, mesh=None, axis="sp", causal=False,
+                      scale=None):
+    """Host-level entry: shards (batch, heads, seq, d) over `axis` of
+    the mesh and runs all-to-all attention. Accepts NDArray or jax
+    arrays. Requires heads % mesh[axis] == 0 and seq % mesh[axis] == 0."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec
+
+    from ..base import MXNetError
+    from ..ndarray.ndarray import NDArray, _wrap
+    from . import mesh as mesh_mod
+
+    unwrap = isinstance(q, NDArray)
+    if unwrap:
+        q, k, v = q._data, k._data, v._data
+    if mesh is None:
+        import jax as _jax
+
+        mesh = mesh_mod.make_mesh({axis: len(_jax.devices())})
+    P = mesh.shape[axis]
+    if q.shape[1] % P:
+        raise MXNetError(
+            f"ulysses_attention: heads ({q.shape[1]}) must divide by the "
+            f"'{axis}' mesh size ({P}); use ring_attention for "
+            f"few-head/long-sequence shapes")
+    if q.shape[2] % P:
+        raise MXNetError(
+            f"ulysses_attention: seq ({q.shape[2]}) must divide by the "
+            f"'{axis}' mesh size ({P})")
+    out = _jitted(mesh, axis, causal, scale)(q, k, v)
+    return _wrap(out) if unwrap else out
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted(mesh, axis, causal, scale):
+    """Per-(mesh, axis, causal, scale) jitted shard_map — a fresh
+    jax.jit(fn) per call would recompile every step (jit caches by
+    function identity)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec
+
+    spec = PartitionSpec(None, None, axis, None)
+    return jax.jit(shard_map(
+        functools.partial(ulysses_attention_sharded, axis_name=axis,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))

@@ -1,12 +1,8 @@
 """Pallas BN-stats kernel parity (interpret mode on CPU)."""
-import functools
-
 import numpy as np
-import pytest
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
 
 
 

@@ -3,8 +3,6 @@
 The lax.scan implementation in ops/rnn.py is the oracle — the same
 CPU-as-oracle pattern the reference uses for GPU kernels (SURVEY §4).
 """
-import functools
-
 import numpy as np
 import pytest
 
