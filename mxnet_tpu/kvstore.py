@@ -309,19 +309,29 @@ def _reduce_sum(vlist, target_ctx):
     from .ndarray import sparse as _sparse
 
     if all(isinstance(v, RowSparseNDArray) for v in vlist):
-        # row_sparse aggregation stays sparse (ref: comm.h ReduceRowSparse)
-        acc = vlist[0]
+        # row_sparse aggregation stays sparse (ref: comm.h ReduceRowSparse);
+        # align every shard on the target device first — sparse add
+        # concatenates indices/values and jax rejects mixed-device inputs
+        acc = vlist[0].as_in_context(target_ctx)
         for v in vlist[1:]:
-            acc = _sparse.add(acc, v)
+            acc = _sparse.add(acc, v.as_in_context(target_ctx))
         return acc.todense()
     vlist = [v.todense() if isinstance(v, BaseSparseNDArray) else v
              for v in vlist]
     if len(vlist) == 1:
         return vlist[0].as_in_context(target_ctx)
-    acc = vlist[0].as_in_context(target_ctx)
-    for v in vlist[1:]:
-        acc = acc + v.as_in_context(target_ctx)
-    return acc
+    # pairwise tree reduce (ref: comm_tree.h CommDeviceTree): log2(N)
+    # dependency depth instead of a serial N-add chain, so independent
+    # partial sums overlap across devices under the async dispatcher
+    while len(vlist) > 1:
+        nxt = []
+        for i in range(0, len(vlist) - 1, 2):
+            nxt.append(vlist[i] + vlist[i + 1].as_in_context(
+                vlist[i].context))
+        if len(vlist) % 2:
+            nxt.append(vlist[-1])
+        vlist = nxt
+    return vlist[0].as_in_context(target_ctx)
 
 
 _VALID = ("local", "device", "nccl", "dist", "dist_sync", "dist_async",

@@ -8,6 +8,8 @@ XLA inserting ICI collectives from sharding annotations.
 """
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 from ..base import MXNetError
@@ -62,3 +64,23 @@ def shard_param_spec(shape, mesh, tp_axis="tp"):
             dims[i] = tp_axis
             break
     return PartitionSpec(*dims)
+
+
+def spmd_jit(sharded_fn, mesh, in_specs, out_specs, **kwargs):
+    """Cached jit(shard_map(partial(fn, **kwargs))) — a fresh jax.jit per
+    call would recompile every step (jit caches by function identity).
+    kwargs values must be hashable (they become cache-key items)."""
+    return _spmd_jit(sharded_fn, mesh, in_specs, out_specs,
+                     tuple(sorted(kwargs.items())))
+
+
+@functools.lru_cache(maxsize=64)
+def _spmd_jit(sharded_fn, mesh, in_specs, out_specs, kwargs_items):
+    import jax
+    from jax import shard_map
+
+    return jax.jit(shard_map(
+        functools.partial(sharded_fn, **dict(kwargs_items)),
+        mesh=mesh, in_specs=in_specs, out_specs=out_specs))
+
+

@@ -15,7 +15,6 @@ O(seq/P); the full score matrix never exists.
 """
 from __future__ import annotations
 
-import functools
 import math
 
 import jax
@@ -78,12 +77,25 @@ def ring_attention_sharded(q, k, v, axis_name, *, causal=False, scale=None):
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
+def attention_spmd_jit(sharded_fn, mesh, axis, causal, scale):
+    """Thin wrapper over mesh.spmd_jit for sequence-parallel attention
+    (ring + ulysses share it): q,k,v rank-4 (B, H, S, D) sharded on the
+    sequence dim over `axis`.  `scale` is coerced to a hashable float so
+    array scalars work as cache keys."""
+    from jax.sharding import PartitionSpec
+
+    from . import mesh as mesh_mod
+
+    spec = PartitionSpec(None, None, axis, None)
+    return mesh_mod.spmd_jit(
+        sharded_fn, mesh, (spec, spec, spec), spec,
+        axis_name=axis, causal=causal,
+        scale=float(scale) if scale is not None else None)
+
+
 def ring_attention(q, k, v, mesh=None, axis="sp", causal=False, scale=None):
     """Host-level entry: shards (batch, heads, seq, d) over `axis` of the
     mesh and runs the ring. Accepts NDArray or jax arrays."""
-    from jax.sharding import NamedSharding, PartitionSpec
-    from jax import shard_map
-
     from ..ndarray.ndarray import NDArray, _wrap
     from . import mesh as mesh_mod
 
@@ -91,23 +103,7 @@ def ring_attention(q, k, v, mesh=None, axis="sp", causal=False, scale=None):
     if unwrap:
         q, k, v = q._data, k._data, v._data
     if mesh is None:
-        import jax as _jax
-
-        mesh = mesh_mod.make_mesh({axis: len(_jax.devices())})
-    out = _jitted(mesh, axis, causal, scale)(q, k, v)
+        mesh = mesh_mod.make_mesh({axis: len(jax.devices())})
+    out = attention_spmd_jit(
+        ring_attention_sharded, mesh, axis, causal, scale)(q, k, v)
     return _wrap(out) if unwrap else out
-
-
-@functools.lru_cache(maxsize=64)
-def _jitted(mesh, axis, causal, scale):
-    """Per-(mesh, axis, causal, scale) jitted shard_map — a fresh
-    jax.jit(fn) per call would recompile every step (jit caches by
-    function identity)."""
-    from jax import shard_map
-    from jax.sharding import PartitionSpec
-
-    spec = PartitionSpec(None, None, axis, None)
-    return jax.jit(shard_map(
-        functools.partial(ring_attention_sharded, axis_name=axis,
-                          causal=causal, scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))

@@ -17,8 +17,6 @@ wins at extreme S where even one full-head sequence doesn't fit.
 """
 from __future__ import annotations
 
-import functools
-
 import jax
 
 
@@ -45,9 +43,6 @@ def ulysses_attention(q, k, v, mesh=None, axis="sp", causal=False,
     """Host-level entry: shards (batch, heads, seq, d) over `axis` of
     the mesh and runs all-to-all attention. Accepts NDArray or jax
     arrays. Requires heads % mesh[axis] == 0 and seq % mesh[axis] == 0."""
-    from jax import shard_map
-    from jax.sharding import PartitionSpec
-
     from ..base import MXNetError
     from ..ndarray.ndarray import NDArray, _wrap
     from . import mesh as mesh_mod
@@ -56,33 +51,20 @@ def ulysses_attention(q, k, v, mesh=None, axis="sp", causal=False,
     if unwrap:
         q, k, v = q._data, k._data, v._data
     if mesh is None:
-        import jax as _jax
-
-        mesh = mesh_mod.make_mesh({axis: len(_jax.devices())})
+        mesh = mesh_mod.make_mesh({axis: len(jax.devices())})
     P = mesh.shape[axis]
-    if q.shape[1] % P:
-        raise MXNetError(
-            f"ulysses_attention: heads ({q.shape[1]}) must divide by the "
-            f"'{axis}' mesh size ({P}); use ring_attention for "
-            f"few-head/long-sequence shapes")
-    if q.shape[2] % P:
-        raise MXNetError(
-            f"ulysses_attention: seq ({q.shape[2]}) must divide by the "
-            f"'{axis}' mesh size ({P})")
-    out = _jitted(mesh, axis, causal, scale)(q, k, v)
+    for name, t in (("q", q), ("k", k), ("v", v)):
+        if t.shape[1] % P:
+            raise MXNetError(
+                f"ulysses_attention: {name} heads ({t.shape[1]}) must "
+                f"divide by the '{axis}' mesh size ({P}); use "
+                f"ring_attention for few-head/long-sequence shapes")
+        if t.shape[2] % P:
+            raise MXNetError(
+                f"ulysses_attention: {name} seq ({t.shape[2]}) must "
+                f"divide by the '{axis}' mesh size ({P})")
+    from .ring_attention import attention_spmd_jit
+
+    out = attention_spmd_jit(
+        ulysses_attention_sharded, mesh, axis, causal, scale)(q, k, v)
     return _wrap(out) if unwrap else out
-
-
-@functools.lru_cache(maxsize=64)
-def _jitted(mesh, axis, causal, scale):
-    """Per-(mesh, axis, causal, scale) jitted shard_map — a fresh
-    jax.jit(fn) per call would recompile every step (jit caches by
-    function identity)."""
-    from jax import shard_map
-    from jax.sharding import PartitionSpec
-
-    spec = PartitionSpec(None, None, axis, None)
-    return jax.jit(shard_map(
-        functools.partial(ulysses_attention_sharded, axis_name=axis,
-                          causal=causal, scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
