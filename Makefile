@@ -7,8 +7,24 @@ LDFLAGS ?= -shared -ljpeg
 LIB := lib/libmxtpu_io.so
 ENGINE_LIB := lib/libmxtpu_engine.so
 STORAGE_LIB := lib/libmxtpu_storage.so
+CAPI_LIB := lib/libmxtpu_capi.so
 
-all: $(LIB) $(ENGINE_LIB) $(STORAGE_LIB)
+PY_INCLUDES := $(shell python3-config --includes)
+PY_LDFLAGS := $(shell python3-config --ldflags --embed 2>/dev/null || python3-config --ldflags)
+
+# the C ABI embeds CPython: only build it where dev headers exist, so a
+# bare `make` still succeeds on hosts without python3-dev
+HAS_PYCONFIG := $(shell command -v python3-config 2>/dev/null)
+ALL_LIBS := $(LIB) $(ENGINE_LIB) $(STORAGE_LIB)
+ifneq ($(HAS_PYCONFIG),)
+ALL_LIBS += $(CAPI_LIB)
+endif
+
+all: $(ALL_LIBS)
+
+$(CAPI_LIB): src/c_api.cc
+	@mkdir -p lib
+	$(CXX) $(CXXFLAGS) $(PY_INCLUDES) $< -o $@ -shared $(PY_LDFLAGS)
 
 $(STORAGE_LIB): src/storage.cc
 	@mkdir -p lib

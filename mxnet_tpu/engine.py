@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import atexit
 import concurrent.futures
+import threading
 import weakref
 
 from .base import getenv
@@ -164,3 +165,80 @@ def push_host(fn, *args, **kwargs):
     if eng is not None:
         return eng.push(lambda: fn(*args, **kwargs))
     return host_pool().submit(fn, *args, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Streams (ref: src/engine/stream_manager.h + mshadow Stream<gpu> in
+# RunContext): per-device ordered lanes so transfers never queue behind
+# unrelated work.  TPU translation: device-side ordering belongs to
+# XLA/PjRt, but HOST-side lanes still matter — H2D staging, D2H
+# checkpoint reads, and IO decode are independent queues that should
+# overlap each other while staying FIFO within themselves.  A Stream is
+# realized as one mutable engine var: the C++ engine's per-var FIFO
+# grant IS the stream-order guarantee, and distinct vars give cross-
+# stream parallelism.  Without the native lib, each stream degrades to
+# its own single-thread executor (same contract, plain threads).
+
+
+class Stream:
+    """One FIFO lane. Ops pushed to the same stream run in push order;
+    different streams run concurrently."""
+
+    def __init__(self, name):
+        self.name = name
+        eng = native_engine()
+        if eng is not None:
+            self._var = eng.new_variable()
+            self._exec = None
+        else:
+            self._var = None
+            self._exec = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix=f"mxtpu-stream-{name}")
+
+    def push(self, fn, *args, **kwargs):
+        """Enqueue fn on this lane; returns a future."""
+        if is_naive():
+            return _sync_future(fn, *args, **kwargs)
+        if self._var is not None:
+            return native_engine().push(
+                lambda: fn(*args, **kwargs), (), (self._var,))
+        return self._exec.submit(fn, *args, **kwargs)
+
+    def wait(self):
+        """Block until everything pushed so far has run (ref:
+        Stream::Wait — a lane-local barrier, unlike waitall)."""
+        self.push(lambda: None).result()
+
+
+class StreamManager:
+    """Per-(context, kind) stream registry (ref: StreamManager hands a
+    compute + copy stream per GPU via RunContext).  Kinds: 'h2d'
+    (host→device staging), 'd2h' (checkpoint/eval readback), 'io'
+    (decode output ordering), 'aux' (anything else)."""
+
+    _KINDS = ("h2d", "d2h", "io", "aux")
+
+    def __init__(self):
+        self._streams = {}
+        self._mu = threading.Lock()
+
+    def get(self, ctx=None, kind="h2d"):
+        if kind not in self._KINDS:
+            raise ValueError(f"unknown stream kind {kind!r}; "
+                             f"valid: {self._KINDS}")
+        key = (str(ctx), kind)
+        with self._mu:
+            s = self._streams.get(key)
+            if s is None:
+                s = self._streams[key] = Stream(f"{ctx}-{kind}")
+            return s
+
+
+_stream_manager = None
+
+
+def stream_manager():
+    global _stream_manager
+    if _stream_manager is None:
+        _stream_manager = StreamManager()
+    return _stream_manager

@@ -454,15 +454,13 @@ class ImageRecordIter(DataIter):
         return [DataDesc("softmax_label", (self.batch_size,))]
 
     def _drain_prefetch(self):
-        """Free staging buffers of in-flight decodes (epoch reset / del)."""
+        """Wait out in-flight decode+upload chains (epoch reset / del);
+        the upload stage frees each staging buffer itself."""
         for fut in self._prefetch:
             if fut is None:
                 continue
             try:
-                handle, _, _ = fut.result()
-                from ..storage import Storage
-
-                Storage.get().free(handle)
+                fut.result()
             except Exception:
                 pass
         self._prefetch = []
@@ -511,10 +509,16 @@ class ImageRecordIter(DataIter):
         if len(recs) < self.batch_size:
             self._prefetch.append(None)
             return
-        # decode+augment on the host pool (the decode-thread role)
+        # decode+augment on the host pool (the decode-thread role), then
+        # chain the device upload onto the 'h2d' stream: uploads stay
+        # FIFO in their own lane (ref: iter_prefetcher.h — the copy to
+        # device is its own engine op on the copy stream) and overlap
+        # both later decodes and the consumer's compute
         fut = engine.push_host(self._decode_batch, recs,
                                self._rng.randint(1 << 30))
-        self._prefetch.append(fut)
+        up = engine.stream_manager().get("default", "h2d").push(
+            self._upload, fut)
+        self._prefetch.append(up)
 
     def _decode_batch(self, recs, seed):
         from . import recordio as rio
@@ -546,6 +550,22 @@ class ImageRecordIter(DataIter):
             Storage.get().free(handle)
             raise
         return handle, data, labels
+
+    def _upload(self, decode_fut):
+        """H2D stage: copy the staged batch to the device and release
+        the staging buffer.  Runs on the 'h2d' stream lane; the device
+        array owns its memory (copy=True — jnp.asarray may alias host
+        buffers on the CPU backend) so the pool slot recycles safely."""
+        import jax.numpy as jnp
+
+        from ..storage import Storage
+
+        handle, data, labels = decode_fut.result()
+        try:
+            dev = jnp.array(data, copy=True)
+        finally:
+            Storage.get().free(handle)
+        return dev, labels
 
     def _augment(self, img, rng):
         from PIL import Image
@@ -655,22 +675,13 @@ class ImageRecordIter(DataIter):
         fut = self._prefetch.pop(0)
         if fut is None:
             raise StopIteration
-        handle, data, labels = fut.result()
+        dev, labels = fut.result()
         self._enqueue()
-        import jax.numpy as jnp
-
         from ..ndarray.ndarray import _wrap
-        from ..storage import Storage
 
-        # copy=True: the staging buffer goes back to the pool right after
-        # upload, so the device array must own its memory (jnp.asarray may
-        # alias host buffers on the CPU backend)
-        batch = DataBatch([_wrap(jnp.array(data, copy=True))],
-                          [_nd.array(labels)],
-                          provide_data=self.provide_data,
-                          provide_label=self.provide_label)
-        Storage.get().free(handle)
-        return batch
+        return DataBatch([_wrap(dev)], [_nd.array(labels)],
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
 
     def iter_next(self):
         if self._native is not None:

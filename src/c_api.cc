@@ -1,0 +1,468 @@
+// Flat C ABI over the framework surface (multi-frontend boundary).
+//
+// Ref (behavioral parity, not translation): include/mxnet/c_api.h +
+// src/c_api/c_api.cc — the reference exposes ~400 flat MX* functions so
+// Scala/R/Julia/C++ frontends can drive the same core the Python
+// frontend uses.
+//
+// TPU-native inversion: the reference's core is C++ with Python layered
+// on top; here the core orchestration layer is Python (driving XLA/PjRt,
+// which are themselves native) with C++ subsystems below it (engine,
+// storage, IO).  The multi-frontend boundary therefore EMBEDS the
+// orchestrator: this library hosts a CPython interpreter and exposes the
+// same flat, stateless C calling convention the reference does —
+// handle-based NDArrays, string-keyed op invoke against the central op
+// registry, MXTPUGetLastError error protocol.  Any language with a C FFI
+// gets the full op surface (260+ registered ops), not a re-binding of a
+// Python API.
+//
+// Thread contract: every entry point takes the GIL (PyGILState_Ensure),
+// so frontends may call from any thread — same guarantee as the
+// reference's engine-backed C API.
+//
+// Build: make lib/libmxtpu_capi.so   (links libpython3.x)
+// Test: tests/test_capi.py compiles+runs a C driver against this ABI.
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#define MXTPU_API extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+std::mutex g_init_mu;
+bool g_initialized = false;
+PyObject* g_nd_module = nullptr;      // mxnet_tpu.ndarray.ops (op table)
+PyObject* g_nd_array_fn = nullptr;    // mxnet_tpu.nd.array
+PyObject* g_registry = nullptr;       // mxnet_tpu.ops.registry module
+
+thread_local std::string tl_last_error;
+
+// Cached storage for MXTPUListAllOpNames (stable pointers after init).
+std::vector<std::string> g_op_names;
+std::vector<const char*> g_op_name_ptrs;
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  tl_last_error = "unknown python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c) tl_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() : st(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(st); }
+};
+
+// dtype codes follow the reference's mshadow enum order
+// (c_api: 0=f32 1=f64 2=f16 3=u8 4=i32 5=i8 6=i64); we add 7=bf16.
+const char* dtype_name(int code) {
+  switch (code) {
+    case 0: return "float32";
+    case 1: return "float64";
+    case 2: return "float16";
+    case 3: return "uint8";
+    case 4: return "int32";
+    case 5: return "int8";
+    case 6: return "int64";
+    case 7: return "bfloat16";
+    default: return nullptr;
+  }
+}
+
+int dtype_code(const std::string& name) {
+  if (name == "float32") return 0;
+  if (name == "float64") return 1;
+  if (name == "float16") return 2;
+  if (name == "uint8") return 3;
+  if (name == "int32") return 4;
+  if (name == "int8") return 5;
+  if (name == "int64") return 6;
+  if (name == "bfloat16") return 7;
+  return -1;
+}
+
+}  // namespace
+
+MXTPU_API const char* MXTPUGetLastError() { return tl_last_error.c_str(); }
+
+namespace {
+// Import the framework and snapshot the op table (GIL held inside).
+int init_body(const char* platform) {
+  Gil gil;
+  do {
+    if (platform && platform[0]) {
+      std::string code =
+          "import jax\n"
+          "jax.config.update('jax_platforms', '" + std::string(platform) +
+          "')\n";
+      if (PyRun_SimpleString(code.c_str()) != 0) {
+        tl_last_error = "failed to pin jax platform";
+        return -1;
+      }
+    }
+    PyObject* mx = PyImport_ImportModule("mxnet_tpu");
+    if (!mx) break;
+    PyObject* nd = PyObject_GetAttrString(mx, "nd");
+    Py_DECREF(mx);
+    if (!nd) break;
+    g_nd_module = nd;
+    g_nd_array_fn = PyObject_GetAttrString(nd, "array");
+    if (!g_nd_array_fn) break;
+    g_registry = PyImport_ImportModule("mxnet_tpu.ops.registry");
+    if (!g_registry) break;
+    // snapshot op names once; pointers stay valid for the process life
+    PyObject* keys = PyObject_CallMethod(g_registry, "list_ops", nullptr);
+    if (!keys) break;
+    PyObject* keys_list = PySequence_List(keys);
+    Py_DECREF(keys);
+    if (!keys_list) break;
+    keys = keys_list;
+    Py_ssize_t n = PyList_Size(keys);
+    g_op_names.reserve(n);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      const char* c = PyUnicode_AsUTF8(PyList_GetItem(keys, i));
+      if (c) g_op_names.emplace_back(c);
+    }
+    Py_DECREF(keys);
+    for (auto& s : g_op_names) g_op_name_ptrs.push_back(s.c_str());
+    g_initialized = true;
+    return 0;
+  } while (false);
+  set_error_from_python();
+  return -1;
+}
+}  // namespace
+
+// Initialize the embedded interpreter + framework. `platform` may be
+// nullptr/"" (leave backend selection to the environment) or "cpu" /
+// "tpu" to pin jax's platform before first device use.
+MXTPU_API int MXTPUCAPIInit(const char* platform) {
+  std::lock_guard<std::mutex> lk(g_init_mu);
+  if (g_initialized) return 0;
+  bool we_initialized = false;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);  // no signal handlers: the host app owns them
+    we_initialized = true;
+  }
+  int rc = init_body(platform);
+  if (we_initialized) {
+    // Py_InitializeEx leaves this thread holding the GIL; release it so
+    // other frontend threads' PyGILState_Ensure can proceed (the
+    // any-thread contract in the header comment).
+    PyEval_SaveThread();
+  }
+  return rc;
+}
+
+MXTPU_API int MXTPUListAllOpNames(int* out_size, const char*** out_array) {
+  if (!g_initialized) {
+    tl_last_error = "MXTPUCAPIInit not called";
+    return -1;
+  }
+  *out_size = static_cast<int>(g_op_name_ptrs.size());
+  *out_array = g_op_name_ptrs.data();
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// NDArray handles: an opaque pointer owning one PyObject* (the NDArray).
+// ---------------------------------------------------------------------------
+
+typedef void* NDArrayHandle;
+
+MXTPU_API int MXTPUNDArrayCreate(const void* data, const int64_t* shape,
+                                 int ndim, int dtype, const char* ctx,
+                                 NDArrayHandle* out) {
+  if (!g_initialized) {
+    tl_last_error = "MXTPUCAPIInit not called";
+    return -1;
+  }
+  const char* dt = dtype_name(dtype);
+  if (!dt || ndim < 0 || ndim > 16) {
+    tl_last_error = "bad dtype code or ndim";
+    return -1;
+  }
+  Gil gil;
+  do {
+    // build via numpy: np.frombuffer(bytes, dtype).reshape(shape)
+    PyObject* np = PyImport_ImportModule("numpy");
+    if (!np) break;
+    PyObject* npdt = PyObject_CallMethod(np, "dtype", "s", dt);
+    if (!npdt) { Py_DECREF(np); break; }
+    PyObject* itemsize_o = PyObject_GetAttrString(npdt, "itemsize");
+    int64_t itemsize = PyLong_AsLongLong(itemsize_o);
+    Py_DECREF(itemsize_o);
+    int64_t count = 1;
+    for (int i = 0; i < ndim; ++i) count *= shape[i];
+    PyObject* buf = PyBytes_FromStringAndSize(
+        static_cast<const char*>(data), count * itemsize);
+    PyObject* flat = buf ? PyObject_CallMethod(np, "frombuffer", "OO",
+                                               buf, npdt)
+                         : nullptr;
+    Py_XDECREF(buf);
+    Py_DECREF(npdt);
+    Py_DECREF(np);
+    if (!flat) break;
+    PyObject* shp = PyTuple_New(ndim);
+    for (int i = 0; i < ndim; ++i)
+      PyTuple_SET_ITEM(shp, i, PyLong_FromLongLong(shape[i]));
+    PyObject* arr = PyObject_CallMethod(flat, "reshape", "O", shp);
+    Py_DECREF(flat);
+    Py_DECREF(shp);
+    if (!arr) break;
+    PyObject* kwargs = PyDict_New();
+    if (ctx && ctx[0]) {
+      PyObject* mx = PyImport_ImportModule("mxnet_tpu");
+      PyObject* ctx_mod = mx ? PyObject_GetAttrString(mx, "Context")
+                             : nullptr;
+      Py_XDECREF(mx);
+      if (!ctx_mod) { Py_DECREF(arr); Py_DECREF(kwargs); break; }
+      // ctx strings look like "cpu(0)" / "xla(0)"
+      std::string s(ctx);
+      auto lp = s.find('(');
+      std::string dev = s.substr(0, lp);
+      int idx = lp == std::string::npos
+                    ? 0
+                    : std::atoi(s.c_str() + lp + 1);
+      PyObject* ctx_obj = PyObject_CallFunction(ctx_mod, "si",
+                                                dev.c_str(), idx);
+      Py_DECREF(ctx_mod);
+      if (!ctx_obj) { Py_DECREF(arr); Py_DECREF(kwargs); break; }
+      PyDict_SetItemString(kwargs, "ctx", ctx_obj);
+      Py_DECREF(ctx_obj);
+    }
+    PyObject* args = PyTuple_Pack(1, arr);
+    PyObject* nd_arr = PyObject_Call(g_nd_array_fn, args, kwargs);
+    Py_DECREF(args);
+    Py_DECREF(kwargs);
+    Py_DECREF(arr);
+    if (!nd_arr) break;
+    *out = nd_arr;
+    return 0;
+  } while (false);
+  set_error_from_python();
+  return -1;
+}
+
+MXTPU_API int MXTPUNDArrayFree(NDArrayHandle h) {
+  if (!h) return 0;
+  Gil gil;
+  Py_DECREF(static_cast<PyObject*>(h));
+  return 0;
+}
+
+MXTPU_API int MXTPUNDArrayGetShape(NDArrayHandle h, int* out_ndim,
+                                   int64_t* out_shape /* >=16 slots */) {
+  Gil gil;
+  do {
+    PyObject* shp = PyObject_GetAttrString(static_cast<PyObject*>(h),
+                                           "shape");
+    if (!shp) break;
+    Py_ssize_t n = PyTuple_Size(shp);
+    if (n > 16) { Py_DECREF(shp); tl_last_error = "ndim > 16"; return -1; }
+    *out_ndim = static_cast<int>(n);
+    for (Py_ssize_t i = 0; i < n; ++i)
+      out_shape[i] = PyLong_AsLongLong(PyTuple_GetItem(shp, i));
+    Py_DECREF(shp);
+    return 0;
+  } while (false);
+  set_error_from_python();
+  return -1;
+}
+
+MXTPU_API int MXTPUNDArrayGetDType(NDArrayHandle h, int* out_dtype) {
+  Gil gil;
+  do {
+    PyObject* dt = PyObject_GetAttrString(static_cast<PyObject*>(h),
+                                          "dtype");
+    if (!dt) break;
+    PyObject* nm = PyObject_GetAttrString(dt, "name");
+    if (!nm) {
+      PyErr_Clear();  // the AttributeError must not leak into the
+      nm = PyObject_Str(dt);  // fallback call or a later API call
+    }
+    Py_DECREF(dt);
+    if (!nm) break;
+    const char* c = PyUnicode_AsUTF8(nm);
+    int code = c ? dtype_code(c) : -1;
+    Py_DECREF(nm);
+    if (code < 0) { tl_last_error = "unmapped dtype"; return -1; }
+    *out_dtype = code;
+    return 0;
+  } while (false);
+  set_error_from_python();
+  return -1;
+}
+
+// Synchronously copy device data out to a host buffer (asnumpy +
+// memcpy) — the MXNDArraySyncCopyToCPU equivalent.
+MXTPU_API int MXTPUNDArraySyncCopyToCPU(NDArrayHandle h, void* out,
+                                        int64_t nbytes) {
+  Gil gil;
+  do {
+    PyObject* npy = PyObject_CallMethod(static_cast<PyObject*>(h),
+                                        "asnumpy", nullptr);
+    if (!npy) break;
+    PyObject* contig = PyObject_CallMethod(npy, "tobytes", nullptr);
+    Py_DECREF(npy);
+    if (!contig) break;
+    char* buf = nullptr;
+    Py_ssize_t len = 0;
+    if (PyBytes_AsStringAndSize(contig, &buf, &len) != 0) {
+      Py_DECREF(contig);
+      break;
+    }
+    if (len != nbytes) {
+      Py_DECREF(contig);
+      tl_last_error = "size mismatch: have " + std::to_string(len) +
+                      " bytes, caller asked " + std::to_string(nbytes);
+      return -1;
+    }
+    std::memcpy(out, buf, len);
+    Py_DECREF(contig);
+    return 0;
+  } while (false);
+  set_error_from_python();
+  return -1;
+}
+
+// ---------------------------------------------------------------------------
+// Op invoke: the MXImperativeInvokeEx equivalent. Inputs are NDArray
+// handles; kwargs arrive as parallel string arrays and are parsed as
+// Python literals (so "(2, 2)" / "1e-5" / "'valid'" all work — same
+// stringly-typed convention as the reference's C API).
+// ---------------------------------------------------------------------------
+
+MXTPU_API int MXTPUImperativeInvoke(const char* op_name,
+                                    NDArrayHandle* inputs, int num_inputs,
+                                    const char** keys, const char** vals,
+                                    int num_kwargs,
+                                    NDArrayHandle* outputs,
+                                    int* num_outputs /* in: capacity */) {
+  if (!g_initialized) {
+    tl_last_error = "MXTPUCAPIInit not called";
+    return -1;
+  }
+  Gil gil;
+  do {
+    PyObject* fn = PyObject_GetAttrString(g_nd_module, op_name);
+    if (!fn) break;
+    PyObject* args = PyTuple_New(num_inputs);
+    for (int i = 0; i < num_inputs; ++i) {
+      PyObject* o = static_cast<PyObject*>(inputs[i]);
+      Py_INCREF(o);
+      PyTuple_SET_ITEM(args, i, o);
+    }
+    PyObject* kwargs = PyDict_New();
+    PyObject* ast = PyImport_ImportModule("ast");
+    PyObject* lit = ast ? PyObject_GetAttrString(ast, "literal_eval")
+                        : nullptr;
+    Py_XDECREF(ast);
+    bool kw_ok = true;
+    for (int i = 0; i < num_kwargs && kw_ok; ++i) {
+      PyObject* v = lit ? PyObject_CallFunction(lit, "s", vals[i])
+                        : nullptr;
+      if (!v) {  // not a literal -> pass the raw string (e.g. act_type)
+        PyErr_Clear();
+        v = PyUnicode_FromString(vals[i]);
+      }
+      if (!v || PyDict_SetItemString(kwargs, keys[i], v) != 0)
+        kw_ok = false;
+      Py_XDECREF(v);
+    }
+    Py_XDECREF(lit);
+    PyObject* res = kw_ok ? PyObject_Call(fn, args, kwargs) : nullptr;
+    Py_DECREF(fn);
+    Py_DECREF(args);
+    Py_DECREF(kwargs);
+    if (!res) break;
+    // normalize to a list of outputs
+    PyObject* res_list;
+    if (PyTuple_Check(res) || PyList_Check(res)) {
+      res_list = PySequence_Fast(res, "op outputs");
+      Py_DECREF(res);
+    } else {
+      res_list = PyTuple_Pack(1, res);
+      Py_DECREF(res);
+    }
+    if (!res_list) break;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(res_list);
+    if (n > *num_outputs) {
+      Py_DECREF(res_list);
+      tl_last_error = "output capacity too small: need " +
+                      std::to_string(n);
+      return -1;
+    }
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* o = PySequence_Fast_GET_ITEM(res_list, i);
+      Py_INCREF(o);
+      outputs[i] = o;
+    }
+    *num_outputs = static_cast<int>(n);
+    Py_DECREF(res_list);
+    return 0;
+  } while (false);
+  set_error_from_python();
+  return -1;
+}
+
+// Block until all async work is visible (mx.nd.waitall).
+MXTPU_API int MXTPUWaitAll() {
+  Gil gil;
+  do {
+    PyObject* r = PyObject_CallMethod(g_nd_module, "waitall", nullptr);
+    if (!r) break;
+    Py_DECREF(r);
+    return 0;
+  } while (false);
+  set_error_from_python();
+  return -1;
+}
+
+// Save/load NDArrays in the reference-compatible .params container
+// (MXNDArraySave/Load equivalents; keys optional for save).
+MXTPU_API int MXTPUNDArraySave(const char* fname, NDArrayHandle* handles,
+                               const char** keys, int num) {
+  Gil gil;
+  do {
+    PyObject* d;
+    if (keys) {
+      d = PyDict_New();
+      for (int i = 0; i < num; ++i)
+        PyDict_SetItemString(d, keys[i],
+                             static_cast<PyObject*>(handles[i]));
+    } else {
+      d = PyList_New(num);
+      for (int i = 0; i < num; ++i) {
+        PyObject* o = static_cast<PyObject*>(handles[i]);
+        Py_INCREF(o);
+        PyList_SET_ITEM(d, i, o);
+      }
+    }
+    PyObject* r = PyObject_CallMethod(g_nd_module, "save", "sO", fname, d);
+    Py_DECREF(d);
+    if (!r) break;
+    Py_DECREF(r);
+    return 0;
+  } while (false);
+  set_error_from_python();
+  return -1;
+}

@@ -1,0 +1,151 @@
+/* C frontend driver for the flat C ABI (tests/test_capi.py compiles and
+ * runs this against lib/libmxtpu_capi.so).
+ *
+ * Ref: the role of cpp-package/ — a non-Python frontend exercising the
+ * same flat C API the Python frontend rides (include/mxnet/c_api.h).
+ * Exercises: init, op listing, NDArray round-trip, imperative invoke
+ * with tensor + string + literal kwargs, error protocol, waitall.
+ */
+#include <pthread.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+
+typedef void* NDArrayHandle;
+
+extern const char* MXTPUGetLastError(void);
+extern int MXTPUCAPIInit(const char* platform);
+extern int MXTPUListAllOpNames(int* out_size, const char*** out_array);
+extern int MXTPUNDArrayCreate(const void* data, const int64_t* shape,
+                              int ndim, int dtype, const char* ctx,
+                              NDArrayHandle* out);
+extern int MXTPUNDArrayFree(NDArrayHandle h);
+extern int MXTPUNDArrayGetShape(NDArrayHandle h, int* out_ndim,
+                                int64_t* out_shape);
+extern int MXTPUNDArrayGetDType(NDArrayHandle h, int* out_dtype);
+extern int MXTPUNDArraySyncCopyToCPU(NDArrayHandle h, void* out,
+                                     int64_t nbytes);
+extern int MXTPUImperativeInvoke(const char* op_name, NDArrayHandle* in,
+                                 int num_in, const char** keys,
+                                 const char** vals, int num_kwargs,
+                                 NDArrayHandle* out, int* num_out);
+extern int MXTPUWaitAll(void);
+
+#define CHECK(cond, msg)                                            \
+  do {                                                              \
+    if (!(cond)) {                                                  \
+      fprintf(stderr, "FAIL %s: %s\n", msg, MXTPUGetLastError());   \
+      return 1;                                                     \
+    }                                                               \
+  } while (0)
+
+static void* thread_invoke(void* arg) {
+  int* rc = (int*)arg;
+  float d[4] = {1, 2, 3, 4};
+  int64_t shp[1] = {4};
+  NDArrayHandle x = NULL, outs[2];
+  int n_out = 2;
+  if (MXTPUNDArrayCreate(d, shp, 1, 0, "", &x) != 0) return NULL;
+  if (MXTPUImperativeInvoke("relu", &x, 1, NULL, NULL, 0, outs,
+                            &n_out) != 0) {
+    MXTPUNDArrayFree(x);
+    return NULL;
+  }
+  float out[4];
+  if (MXTPUNDArraySyncCopyToCPU(outs[0], out, sizeof(out)) == 0 &&
+      out[3] == 4.0f)
+    *rc = 0;
+  MXTPUNDArrayFree(outs[0]);
+  MXTPUNDArrayFree(x);
+  return NULL;
+}
+
+int main(void) {
+  CHECK(MXTPUCAPIInit("cpu") == 0, "init");
+
+  int n_ops = 0;
+  const char** names = NULL;
+  CHECK(MXTPUListAllOpNames(&n_ops, &names) == 0, "list ops");
+  CHECK(n_ops > 200, "op registry size");
+  int has_conv = 0;
+  for (int i = 0; i < n_ops; ++i)
+    if (strcmp(names[i], "Convolution") == 0) has_conv = 1;
+  CHECK(has_conv, "Convolution registered");
+
+  /* a 2x3 fp32 array, element-wise ops, reduce */
+  float data[6] = {1, 2, 3, 4, 5, 6};
+  int64_t shape[2] = {2, 3};
+  NDArrayHandle a = NULL, b = NULL;
+  CHECK(MXTPUNDArrayCreate(data, shape, 2, 0, "cpu(0)", &a) == 0,
+        "create a");
+  CHECK(MXTPUNDArrayCreate(data, shape, 2, 0, "", &b) == 0, "create b");
+
+  int ndim = 0;
+  int64_t got_shape[16];
+  CHECK(MXTPUNDArrayGetShape(a, &ndim, got_shape) == 0, "get shape");
+  CHECK(ndim == 2 && got_shape[0] == 2 && got_shape[1] == 3, "shape vals");
+  int dt = -1;
+  CHECK(MXTPUNDArrayGetDType(a, &dt) == 0 && dt == 0, "dtype f32");
+
+  /* broadcast_add(a, b) -> 2a */
+  NDArrayHandle outs[4];
+  int n_out = 4;
+  NDArrayHandle ins[2] = {a, b};
+  CHECK(MXTPUImperativeInvoke("broadcast_add", ins, 2, NULL, NULL, 0,
+                              outs, &n_out) == 0, "broadcast_add");
+  CHECK(n_out == 1, "one output");
+  float sum[6];
+  CHECK(MXTPUNDArraySyncCopyToCPU(outs[0], sum, sizeof(sum)) == 0,
+        "copy out");
+  for (int i = 0; i < 6; ++i)
+    CHECK(sum[i] == 2 * data[i], "broadcast_add values");
+  MXTPUNDArrayFree(outs[0]);
+
+  /* kwargs: literal tuple + plain string (sum over axis as a tuple,
+   * Activation's act_type as a raw string) */
+  const char* k1[] = {"axis", "keepdims"};
+  const char* v1[] = {"(1,)", "False"};
+  n_out = 4;
+  CHECK(MXTPUImperativeInvoke("sum", ins, 1, k1, v1, 2, outs, &n_out)
+            == 0, "sum axis=(1,)");
+  float rowsum[2];
+  CHECK(MXTPUNDArraySyncCopyToCPU(outs[0], rowsum, sizeof(rowsum)) == 0,
+        "copy rowsum");
+  CHECK(rowsum[0] == 6 && rowsum[1] == 15, "rowsum values");
+  MXTPUNDArrayFree(outs[0]);
+
+  const char* k2[] = {"act_type"};
+  const char* v2[] = {"relu"};
+  n_out = 4;
+  CHECK(MXTPUImperativeInvoke("Activation", ins, 1, k2, v2, 1, outs,
+                              &n_out) == 0, "Activation relu");
+  MXTPUNDArrayFree(outs[0]);
+
+  /* error protocol: bad op name must fail with a message, not crash */
+  n_out = 4;
+  CHECK(MXTPUImperativeInvoke("NoSuchOp__", ins, 1, NULL, NULL, 0, outs,
+                              &n_out) != 0, "bad op rejected");
+  CHECK(strlen(MXTPUGetLastError()) > 0, "error message set");
+
+  /* bad kwarg value must fail cleanly too */
+  const char* k3[] = {"act_type"};
+  const char* v3[] = {"bogus_activation"};
+  n_out = 4;
+  CHECK(MXTPUImperativeInvoke("Activation", ins, 1, k3, v3, 1, outs,
+                              &n_out) != 0, "bad act_type rejected");
+
+  CHECK(MXTPUWaitAll() == 0, "waitall");
+
+  /* any-thread contract: a second OS thread must be able to call in
+   * (the embedded interpreter's GIL is released between calls) */
+  pthread_t th;
+  int thread_rc = -1;
+  pthread_create(&th, NULL, thread_invoke, &thread_rc);
+  pthread_join(th, NULL);
+  CHECK(thread_rc == 0, "second-thread invoke");
+
+  MXTPUNDArrayFree(a);
+  MXTPUNDArrayFree(b);
+  printf("CAPI_DRIVER_OK ops=%d\n", n_ops);
+  return 0;
+}

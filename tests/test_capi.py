@@ -1,0 +1,55 @@
+"""Flat C ABI (multi-frontend boundary) — compile and run a pure-C
+frontend against lib/libmxtpu_capi.so.
+
+Ref: include/mxnet/c_api.h + src/c_api/c_api.cc (the reference's ~400
+MX* flat functions that Scala/R/Julia/cpp-package ride).  The TPU build
+inverts the embedding (C hosts the Python orchestrator, which drives
+XLA), but the frontend-facing contract is the same: opaque NDArray
+handles, string-keyed imperative invoke against the op registry,
+GetLastError error protocol, stateless flat calls.
+
+The test builds the .so (make) and the C driver (gcc), then runs the
+driver in a clean subprocess — a frontend with no Python of its own.
+"""
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tool(name):
+    return shutil.which(name)
+
+
+@pytest.mark.skipif(not _tool("g++") or not _tool("python3-config"),
+                    reason="native toolchain unavailable")
+def test_c_frontend_drives_the_framework(tmp_path):
+    # 1. build the shared library
+    r = subprocess.run(["make", "lib/libmxtpu_capi.so"], cwd=REPO,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    # 2. build the C driver (plain C, no python headers — the point)
+    exe = str(tmp_path / "capi_driver")
+    r = subprocess.run(
+        ["gcc", os.path.join(REPO, "tests", "capi_driver.c"),
+         "-o", exe, "-L" + os.path.join(REPO, "lib"), "-lmxtpu_capi",
+         "-Wl,-rpath," + os.path.join(REPO, "lib")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    # 3. run it: the embedded interpreter must find the venv + repo.
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + [p for p in sys.path if "site-packages" in p])
+    # the driver pins jax to cpu itself (MXTPUCAPIInit("cpu")); make sure
+    # the axon plugin's env pin doesn't fight that in the subprocess
+    env.pop("JAX_PLATFORMS", None)
+    r = subprocess.run([exe], capture_output=True, text=True, timeout=600,
+                       env=env, cwd=REPO)
+    assert r.returncode == 0, (r.stdout[-1000:], r.stderr[-2000:])
+    assert "CAPI_DRIVER_OK" in r.stdout

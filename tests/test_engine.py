@@ -170,3 +170,37 @@ def test_engine_module_push_with_deps():
     f2 = engine.push(lambda: order.append("b"), mutable_vars=[v])
     f1.result(timeout=10), f2.result(timeout=10)
     assert order == ["a", "b"]
+
+
+def test_stream_fifo_within_lane():
+    """Ops on one stream run in push order (ref: stream_manager.h —
+    per-stream FIFO), regardless of which backend realizes the lane."""
+    s = engine.Stream("test-fifo")
+    order = []
+    futs = [s.push(lambda i=i: order.append(i)) for i in range(20)]
+    for f in futs:
+        f.result(timeout=10)
+    assert order == list(range(20))
+
+
+def test_streams_overlap_across_lanes():
+    """Two lanes must make independent progress: a blocked 'h2d' lane
+    cannot stall the 'd2h' lane (the reference's compute-vs-copy stream
+    separation)."""
+    import threading
+
+    gate = threading.Event()
+    sm = engine.StreamManager()
+    slow = sm.get("cpu(0)", "h2d")
+    fast = sm.get("cpu(0)", "d2h")
+    assert sm.get("cpu(0)", "h2d") is slow  # registry caches per key
+    slow.push(gate.wait)                    # blocks its lane only
+    out = fast.push(lambda: "ran").result(timeout=10)
+    assert out == "ran"
+    gate.set()
+    slow.wait()
+
+
+def test_stream_kind_validated():
+    with pytest.raises(ValueError):
+        engine.StreamManager().get("cpu(0)", "bogus")
