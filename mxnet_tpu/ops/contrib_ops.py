@@ -1,0 +1,502 @@
+"""Contrib / long-tail operators: CTC, detection boxes, ROIAlign, AMP
+helpers, misc math.
+
+Ref: src/operator/contrib/ (ctc_loss.cc, roi_align.cc, bounding_box.cc,
+amp_cast.cc, allclose_op.cc, index_copy.cc, gradient_multiplier_op.cc,
+quadratic_op.cc, fft/), src/operator/nn/moments.cc and optimizer_op.cc
+(lamb_update_phase1/2) — each re-emitted as XLA HLO through jnp/lax.
+Sequential recurrences (CTC's alpha recursion) ride lax.scan so the
+whole loss lowers into one fused XLA while-loop instead of a Python
+loop; detection NMS uses a fori_loop greedy mask (compiler-friendly
+control flow, no dynamic shapes).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_NEG = -1e30
+
+
+# ---------------------------------------------------------------------------
+# CTC loss (ref: src/operator/contrib/ctc_loss.cc; cuDNN/warp-ctc in the
+# reference — here the standard log-space alpha recursion under lax.scan)
+
+def _k_ctc_loss(data, label, data_lengths=None, label_lengths=None, *,
+                use_data_lengths=False, use_label_lengths=False,
+                blank_label="first"):
+    """data (T, N, C) unnormalized activations; label (N, L) padded.
+
+    blank_label='first': blank id 0, labels 1..C-1, padding 0.
+    blank_label='last': blank id C-1, labels 0..C-2, padding -1.
+    Returns per-example loss (N,).
+    """
+    T, N, C = data.shape
+    L = label.shape[1]
+    logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
+    lab = label.astype(jnp.int32)
+    if blank_label == "first":
+        blank = 0
+        pad_mask = lab > 0
+    else:
+        blank = C - 1
+        pad_mask = lab >= 0
+    if use_label_lengths and label_lengths is not None:
+        lab_len = label_lengths.astype(jnp.int32).reshape(N)
+    else:
+        lab_len = pad_mask.astype(jnp.int32).sum(axis=1)
+    if use_data_lengths and data_lengths is not None:
+        dat_len = data_lengths.astype(jnp.int32).reshape(N)
+    else:
+        dat_len = jnp.full((N,), T, jnp.int32)
+
+    # expanded sequence z: (N, S) with S = 2L+1: blank, l1, blank, ...
+    S = 2 * L + 1
+    z = jnp.full((N, S), blank, jnp.int32)
+    safe_lab = jnp.where(pad_mask, lab, blank)
+    z = z.at[:, 1::2].set(safe_lab)
+    s_idx = jnp.arange(S)[None, :]                      # (1, S)
+    s_valid = s_idx < (2 * lab_len + 1)[:, None]        # (N, S)
+    # skip-transition allowed where z_s is a label and z_s != z_{s-2}
+    z_m2 = jnp.pad(z, ((0, 0), (2, 0)), constant_values=blank)[:, :S]
+    can_skip = (z != blank) & (z != z_m2) & (s_idx >= 2)
+
+    batch = jnp.arange(N)
+
+    def emit(t):
+        # logp[t, n, z[n, s]] -> (N, S)
+        return logp[t][batch[:, None], z]
+
+    alpha0 = jnp.full((N, S), _NEG, jnp.float32)
+    alpha0 = alpha0.at[:, 0].set(logp[0][:, blank])
+    first_lab = jnp.where(lab_len > 0, z[:, 1], blank)
+    alpha0 = alpha0.at[:, 1].set(jnp.where(
+        lab_len > 0, logp[0][batch, first_lab], _NEG))
+    alpha0 = jnp.where(s_valid, alpha0, _NEG)
+
+    def step(alpha, t):
+        a_m1 = jnp.pad(alpha, ((0, 0), (1, 0)),
+                       constant_values=_NEG)[:, :S]
+        a_m2 = jnp.pad(alpha, ((0, 0), (2, 0)),
+                       constant_values=_NEG)[:, :S]
+        stay = jnp.logaddexp(alpha, a_m1)
+        merged = jnp.where(can_skip, jnp.logaddexp(stay, a_m2), stay)
+        new = merged + emit(t)
+        new = jnp.where(s_valid, new, _NEG)
+        # past this example's length: carry alpha through unchanged
+        alive = (t < dat_len)[:, None]
+        new = jnp.where(alive, new, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    # final: logaddexp of positions 2*len and 2*len-1
+    end = 2 * lab_len
+    a_end = alpha[batch, end]
+    a_end1 = jnp.where(end - 1 >= 0, alpha[batch,
+                                           jnp.maximum(end - 1, 0)], _NEG)
+    ll = jnp.logaddexp(a_end, a_end1)
+    return -ll
+
+
+register("CTCLoss", _k_ctc_loss,
+         arg_names=("data", "label", "data_lengths", "label_lengths"),
+         aliases=("ctc_loss", "_contrib_ctc_loss", "_contrib_CTCLoss"),
+         doc=_k_ctc_loss.__doc__)
+
+
+# ---------------------------------------------------------------------------
+# Detection boxes (ref: src/operator/contrib/bounding_box.cc)
+
+def _corner(box, fmt):
+    if fmt == "center":
+        x, y, w, h = (box[..., i] for i in range(4))
+        return jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2],
+                         axis=-1)
+    return box
+
+
+def _pair_iou(a, b):
+    """IoU of (..., Na, 4) corner boxes vs (..., Nb, 4) -> (..., Na, Nb)."""
+    ax1, ay1, ax2, ay2 = (a[..., :, None, i] for i in range(4))
+    bx1, by1, bx2, by2 = (b[..., None, :, i] for i in range(4))
+    iw = jnp.maximum(jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1), 0.0)
+    ih = jnp.maximum(jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1), 0.0)
+    inter = iw * ih
+    area_a = jnp.maximum(ax2 - ax1, 0.0) * jnp.maximum(ay2 - ay1, 0.0)
+    area_b = jnp.maximum(bx2 - bx1, 0.0) * jnp.maximum(by2 - by1, 0.0)
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-12)
+
+
+def _k_box_iou(lhs, rhs, *, format="corner"):
+    """Pairwise IoU: lhs (..., N, 4), rhs (..., M, 4) -> (..., N, M)."""
+    return _pair_iou(_corner(lhs, format), _corner(rhs, format))
+
+
+def _to_center(box):
+    x1, y1, x2, y2 = (box[..., i] for i in range(4))
+    return jnp.stack([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1],
+                     axis=-1)
+
+
+def _k_box_nms(data, *, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+               coord_start=2, score_index=1, id_index=-1,
+               background_id=-1, force_suppress=False, in_format="corner",
+               out_format="corner"):
+    """Greedy NMS (ref bounding_box.cc): data (..., N, K) with score at
+    `score_index`, coords at `coord_start:coord_start+4`.  Suppressed or
+    invalid entries are wiped to -1 across the whole row (reference
+    semantics — consumers filter on any column != -1); surviving rows
+    get their coords emitted in `out_format`."""
+    orig_shape = data.shape
+    flat = data.reshape((-1,) + orig_shape[-2:])   # (B, N, K)
+    B, N, K = flat.shape
+
+    def one(batch):
+        scores = batch[:, score_index]
+        boxes = _corner(batch[:, coord_start:coord_start + 4], in_format)
+        ids = batch[:, id_index] if id_index >= 0 else jnp.zeros(N)
+        valid = scores > valid_thresh
+        if background_id >= 0 and id_index >= 0:
+            valid &= ids != background_id
+        order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+        if topk > 0:
+            in_topk = jnp.arange(N) < topk
+        else:
+            in_topk = jnp.ones(N, bool)
+        iou = _pair_iou(boxes[order], boxes[order])
+        same_class = (ids[order][:, None] == ids[order][None, :]) \
+            if (id_index >= 0 and not force_suppress) \
+            else jnp.ones((N, N), bool)
+        valid_o = valid[order] & in_topk
+
+        def body(i, keep):
+            sup = (iou[i] > overlap_thresh) & same_class[i] & \
+                (jnp.arange(N) > i) & keep[i] & valid_o[i]
+            return keep & ~sup
+
+        keep = lax.fori_loop(0, N, body, valid_o)[jnp.argsort(order)]
+        out = batch
+        if out_format != in_format:
+            coords = _corner(batch[:, coord_start:coord_start + 4],
+                             in_format)            # now corner
+            if out_format == "center":
+                coords = _to_center(coords)
+            out = out.at[:, coord_start:coord_start + 4].set(coords)
+        return jnp.where(keep[:, None], out, -1.0)
+
+    out = jax.vmap(one)(flat)
+    return out.reshape(orig_shape)
+
+
+register("_contrib_box_iou", _k_box_iou, arg_names=("lhs", "rhs"),
+         aliases=("box_iou",), nondiff=True, doc=_k_box_iou.__doc__)
+register("_contrib_box_nms", _k_box_nms, arg_names=("data",),
+         aliases=("box_nms", "_contrib_box_non_maximum_suppression"),
+         nondiff=True, doc=_k_box_nms.__doc__)
+
+
+# ---------------------------------------------------------------------------
+# ROIAlign (ref: src/operator/contrib/roi_align.cc)
+
+def _k_roi_align(data, rois, *, pooled_size, spatial_scale=1.0,
+                 sample_ratio=-1, position_sensitive=False,
+                 aligned=False):
+    """data (B, C, H, W), rois (R, 5) [batch_idx, x1, y1, x2, y2] in
+    image coords; bilinear average pooling per cell (no quantization —
+    the Mask-RCNN fix the reference implements).
+
+    sample_ratio<=0 (the reference's adaptive mode — taps scale with
+    the roi size) is approximated with a fixed 2x2 tap grid: per-roi
+    tap counts are data-dependent shapes, which XLA cannot compile."""
+    if position_sensitive:
+        raise NotImplementedError(
+            "ROIAlign position_sensitive=True (PSROIAlign) is not "
+            "implemented; pool plain ROIAlign per class instead")
+    ph, pw = (pooled_size if isinstance(pooled_size, (tuple, list))
+              else (pooled_size, pooled_size))
+    B, C, H, W = data.shape
+    sr = int(sample_ratio) if int(sample_ratio) > 0 else 2
+    offset = 0.5 if aligned else 0.0
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = roi[1] * spatial_scale - offset, \
+            roi[2] * spatial_scale - offset, \
+            roi[3] * spatial_scale - offset, \
+            roi[4] * spatial_scale - offset
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        bw, bh = rw / pw, rh / ph
+        # sample grid: (ph, sr) x (pw, sr) bilinear taps
+        ys = y1 + (jnp.arange(ph)[:, None] +
+                   (jnp.arange(sr)[None, :] + 0.5) / sr) * bh
+        xs = x1 + (jnp.arange(pw)[:, None] +
+                   (jnp.arange(sr)[None, :] + 0.5) / sr) * bw
+        ys = ys.reshape(-1)  # (ph*sr,)
+        xs = xs.reshape(-1)  # (pw*sr,)
+        y0 = jnp.clip(jnp.floor(ys), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(xs), 0, W - 1)
+        y1i = jnp.clip(y0 + 1, 0, H - 1).astype(jnp.int32)
+        x1i = jnp.clip(x0 + 1, 0, W - 1).astype(jnp.int32)
+        y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+        wy1 = jnp.clip(ys - y0, 0.0, 1.0)
+        wx1 = jnp.clip(xs - x0, 0.0, 1.0)
+        img = data[bidx]                                   # (C, H, W)
+        # gather 4 corners: (C, ph*sr, pw*sr)
+        g = (img[:, y0i[:, None], x0i[None, :]] *
+             ((1 - wy1)[:, None] * (1 - wx1)[None, :]) +
+             img[:, y0i[:, None], x1i[None, :]] *
+             ((1 - wy1)[:, None] * wx1[None, :]) +
+             img[:, y1i[:, None], x0i[None, :]] *
+             (wy1[:, None] * (1 - wx1)[None, :]) +
+             img[:, y1i[:, None], x1i[None, :]] *
+             (wy1[:, None] * wx1[None, :]))
+        g = g.reshape(C, ph, sr, pw, sr)
+        return g.mean(axis=(2, 4))                         # (C, ph, pw)
+
+    return jax.vmap(one_roi)(rois.astype(jnp.float32))
+
+
+register("_contrib_ROIAlign", _k_roi_align, arg_names=("data", "rois"),
+         aliases=("ROIAlign",), doc=_k_roi_align.__doc__)
+
+
+# ---------------------------------------------------------------------------
+# AMP helpers (ref: src/operator/tensor/amp_cast.cc, all_finite.cc)
+
+def _k_amp_cast(data, *, dtype="float16"):
+    return data.astype(jnp.dtype(dtype))
+
+
+def _k_amp_multicast(*arrays, num_outputs=0, cast_narrow=False):
+    """Cast all inputs to a common dtype: widest by default, narrowest
+    with cast_narrow (ref amp_multicast)."""
+    arrays = [a for a in arrays if a is not None]
+    widths = [jnp.dtype(a.dtype).itemsize for a in arrays]
+    pick = min(range(len(arrays)),
+               key=lambda i: widths[i]) if cast_narrow else \
+        max(range(len(arrays)), key=lambda i: widths[i])
+    target = arrays[pick].dtype
+    return tuple(a.astype(target) for a in arrays)
+
+
+def _k_all_finite(data, *, init_output=True):
+    return jnp.isfinite(data).all().astype(jnp.float32).reshape(1)
+
+
+def _k_multi_all_finite(*arrays, num_arrays=0, init_output=True):
+    ok = jnp.asarray(True)
+    for a in arrays:
+        if a is not None:
+            ok = jnp.logical_and(ok, jnp.isfinite(a).all())
+    return ok.astype(jnp.float32).reshape(1)
+
+
+register("amp_cast", _k_amp_cast, arg_names=("data",))
+register("amp_multicast", _k_amp_multicast, arg_names=(), variadic=True,
+         num_outputs=-1, doc=_k_amp_multicast.__doc__)
+register("all_finite", _k_all_finite, arg_names=("data",), nondiff=True)
+register("multi_all_finite", _k_multi_all_finite, arg_names=(),
+         variadic=True, nondiff=True)
+
+
+# ---------------------------------------------------------------------------
+# Misc math / indexing (ref: moments.cc, allclose_op.cc, index_copy.cc,
+# quadratic_op.cc, gradient_multiplier_op.cc, fft/)
+
+def _k_moments(data, *, axes=None, keepdims=False):
+    ax = tuple(axes) if axes is not None else None
+    mean = data.mean(axis=ax, keepdims=bool(keepdims))
+    var = ((data - data.mean(axis=ax, keepdims=True)) ** 2).mean(
+        axis=ax, keepdims=bool(keepdims))
+    return mean, var
+
+
+def _k_isfinite(data):
+    return jnp.isfinite(data).astype(jnp.float32)
+
+
+def _k_softmax_cross_entropy(data, label):
+    """Total cross entropy over the batch, shape (1,) (ref
+    softmax_cross_entropy.cc)."""
+    logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
+    n = data.shape[0]
+    picked = logp[jnp.arange(n), label.astype(jnp.int32)]
+    return -picked.sum().reshape(1)
+
+
+def _k_allclose(a, b, *, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.allclose(a, b, rtol=rtol, atol=atol,
+                        equal_nan=equal_nan).astype(jnp.float32).reshape(1)
+
+
+def _k_index_copy(old_tensor, index_vector, new_tensor):
+    return old_tensor.at[index_vector.astype(jnp.int32)].set(new_tensor)
+
+
+def _k_index_add(old_tensor, index_vector, new_tensor):
+    return old_tensor.at[index_vector.astype(jnp.int32)].add(new_tensor)
+
+
+def _k_arange_like(data, *, start=0.0, step=1.0, repeat=1, ctx=None,
+                   axis=None):
+    if axis is None:
+        n = 1
+        for d in data.shape:
+            n *= d
+        out = start + step * (jnp.arange(n) // max(int(repeat), 1))
+        return out.reshape(data.shape).astype(data.dtype)
+    n = data.shape[axis]
+    return (start + step *
+            (jnp.arange(n) // max(int(repeat), 1))).astype(data.dtype)
+
+
+def _k_quadratic(data, *, a=0.0, b=0.0, c=0.0):
+    return a * data * data + b * data + c
+
+
+@jax.custom_vjp
+def _gradmult(data, scalar):
+    return data
+
+
+def _gradmult_fwd(data, scalar):
+    return data, scalar
+
+
+def _gradmult_bwd(scalar, g):
+    return g * scalar, None
+
+
+_gradmult.defvjp(_gradmult_fwd, _gradmult_bwd)
+
+
+def _k_gradientmultiplier(data, *, scalar=1.0):
+    """Identity forward; gradient scaled by `scalar` (ref
+    gradient_multiplier_op.cc — the GRL trick uses scalar<0)."""
+    return _gradmult(data, jnp.asarray(scalar, jnp.float32))
+
+
+def _k_fft(data, *, compute_size=128):
+    """(N, d) real -> (N, 2d) interleaved re/im (ref contrib/fft)."""
+    out = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    inter = jnp.stack([out.real, out.imag], axis=-1)
+    return inter.reshape(data.shape[:-1] + (2 * data.shape[-1],))
+
+
+def _k_ifft(data, *, compute_size=128):
+    d = data.shape[-1] // 2
+    pair = data.reshape(data.shape[:-1] + (d, 2))
+    comp = pair[..., 0] + 1j * pair[..., 1]
+    return jnp.fft.ifft(comp, axis=-1).real.astype(jnp.float32) * d
+
+
+register("moments", _k_moments, arg_names=("data",), num_outputs=2,
+         doc=_k_moments.__doc__)
+register("isfinite", _k_isfinite, arg_names=("data",), nondiff=True)
+register("softmax_cross_entropy", _k_softmax_cross_entropy,
+         arg_names=("data", "label"),
+         doc=_k_softmax_cross_entropy.__doc__)
+register("_contrib_allclose", _k_allclose, arg_names=("a", "b"),
+         aliases=("allclose",), nondiff=True)
+register("_contrib_index_copy", _k_index_copy,
+         arg_names=("old_tensor", "index_vector", "new_tensor"),
+         aliases=("index_copy",))
+register("_contrib_index_add", _k_index_add,
+         arg_names=("old_tensor", "index_vector", "new_tensor"),
+         aliases=("index_add",))
+register("_contrib_arange_like", _k_arange_like, arg_names=("data",),
+         aliases=("arange_like",), nondiff=True)
+register("_contrib_quadratic", _k_quadratic, arg_names=("data",),
+         aliases=("quadratic",))
+register("_contrib_gradientmultiplier", _k_gradientmultiplier,
+         arg_names=("data",), aliases=("gradientmultiplier",),
+         jit_compile=False, doc=_k_gradientmultiplier.__doc__)
+register("_contrib_fft", _k_fft, arg_names=("data",), aliases=("fft",),
+         nondiff=True, doc=_k_fft.__doc__)
+register("_contrib_ifft", _k_ifft, arg_names=("data",), aliases=("ifft",),
+         nondiff=True)
+
+
+# ---------------------------------------------------------------------------
+# Sampling / shuffle (ref: sample_multinomial_op.cc, shuffle_op.cc)
+
+def _k_sample_multinomial(data, key=None, *, shape=(), get_prob=False,
+                          dtype="int32"):
+    """Draw from batched categoricals: data (..., C) probabilities."""
+    n = 1
+    shp = (shape,) if isinstance(shape, int) else tuple(shape)
+    for d in shp:
+        n *= d
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    draws = jax.random.categorical(key, logits, axis=-1,
+                                   shape=(max(n, 1),) + data.shape[:-1])
+    draws = jnp.moveaxis(draws, 0, -1)
+    out_shape = data.shape[:-1] + shp
+    draws = draws.reshape(out_shape if shp else data.shape[:-1])
+    samples = draws.astype(jnp.dtype(dtype))
+    if get_prob:
+        logp = jnp.log(jnp.maximum(data, 1e-30))
+        picked = jnp.take_along_axis(
+            logp, draws.reshape(data.shape[:-1] + (-1,)).astype(jnp.int32),
+            axis=-1).reshape(samples.shape)
+        return samples, picked
+    return samples
+
+
+def _k_shuffle(data, key=None):
+    """Shuffle along the first axis (ref shuffle_op.cc)."""
+    return jax.random.permutation(key, data, axis=0)
+
+
+# differentiable: with get_prob=True the log-likelihood output carries
+# gradient back to the probabilities (REINFORCE; ref
+# sample_multinomial_op.cc registers a backward for the prob output)
+register("sample_multinomial", _k_sample_multinomial, arg_names=("data",),
+         needs_rng=True, doc=_k_sample_multinomial.__doc__)
+register("_shuffle", _k_shuffle, arg_names=("data",), needs_rng=True,
+         nondiff=True, aliases=("shuffle",))
+
+
+# ---------------------------------------------------------------------------
+# LAMB phase ops (ref: optimizer_op.cc lamb_update_phase1/2 — the
+# layerwise-adaptive pieces BERT-large training uses)
+
+def _k_lamb_update_phase1(weight, grad, mean, var, *, beta1=0.9,
+                          beta2=0.999, epsilon=1e-6, t=1,
+                          bias_correction=True, wd=0.0,
+                          rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    m, v = new_mean, new_var
+    if bias_correction:
+        m = m / (1 - beta1 ** t)
+        v = v / (1 - beta2 ** t)
+    return m / (jnp.sqrt(v) + epsilon) + wd * weight, new_mean, new_var
+
+
+def _k_lamb_update_phase2(weight, g, r1, r2, *, lr=0.01,
+                          lower_bound=-1.0, upper_bound=-1.0):
+    r1 = r1.reshape(())
+    r2 = r2.reshape(())
+    if lower_bound > 0:
+        r1 = jnp.maximum(r1, lower_bound)
+    if upper_bound > 0:
+        r1 = jnp.minimum(r1, upper_bound)
+    ratio = jnp.where((r1 > 0) & (r2 > 0), r1 / r2, 1.0)
+    return weight - lr * ratio * g
+
+
+register("lamb_update_phase1", _k_lamb_update_phase1,
+         arg_names=("weight", "grad", "mean", "var"), num_outputs=3,
+         nondiff=True, mutate_aux=((2, 1), (3, 2)),
+         doc=_k_lamb_update_phase1.__doc__)
+register("lamb_update_phase2", _k_lamb_update_phase2,
+         arg_names=("weight", "g", "r1", "r2"), nondiff=True,
+         doc=_k_lamb_update_phase2.__doc__)

@@ -98,7 +98,8 @@ def _k_convolution(data, weight, bias=None, *, kernel, stride=(), dilate=(),
     return out
 
 register("Convolution", _k_convolution,
-         arg_names=("data", "weight", "bias"), aliases=("convolution",))
+         arg_names=("data", "weight", "bias"),
+         aliases=("convolution", "Convolution_v1"))
 
 
 def _k_deconvolution(data, weight, bias=None, *, kernel, stride=(),
@@ -216,7 +217,7 @@ def _k_pooling(data, *, kernel=(), pool_type="max", stride=(), pad=(),
         return total ** (1.0 / p_value)
     raise ValueError(pool_type)
 
-register("Pooling", _k_pooling, aliases=("pooling",))
+register("Pooling", _k_pooling, aliases=("pooling", "Pooling_v1"))
 
 # ---------------------------------------------------------------------------
 # Normalization (ref: batch_norm.cc, layer_norm.cc, instance_norm.cc,

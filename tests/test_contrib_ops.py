@@ -1,0 +1,247 @@
+"""Contrib / long-tail op family (ref: src/operator/contrib/ —
+ctc_loss.cc, bounding_box.cc, roi_align.cc, amp_cast.cc, moments.cc,
+optimizer_op.cc lamb phases). Numpy/brute-force oracles per SURVEY §4."""
+import itertools
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def _brute_ctc(logp, target, blank=0):
+    """Sum path probabilities over all alignments (tiny cases only)."""
+    T, C = logp.shape
+
+    def collapse(path):
+        out, prev = [], None
+        for p in path:
+            if p != prev and p != blank:
+                out.append(p)
+            prev = p
+        return tuple(out)
+
+    tot = -np.inf
+    for path in itertools.product(range(C), repeat=T):
+        if collapse(path) == tuple(target):
+            tot = np.logaddexp(tot, sum(logp[t, path[t]]
+                                        for t in range(T)))
+    return -tot
+
+
+def test_ctc_loss_matches_brute_force():
+    import jax
+
+    T, N, C = 6, 2, 4
+    rng = np.random.RandomState(0)
+    data = rng.randn(T, N, C).astype(np.float32)
+    label = np.array([[1, 2], [3, 0]], np.float32)  # second len-1 (0 pad)
+    loss = nd.CTCLoss(nd.array(data), nd.array(label)).asnumpy()
+    logp = np.asarray(jax.nn.log_softmax(data, axis=-1))
+    assert np.allclose(loss[0], _brute_ctc(logp[:, 0], (1, 2)), atol=1e-4)
+    assert np.allclose(loss[1], _brute_ctc(logp[:, 1], (3,)), atol=1e-4)
+
+
+def test_ctc_loss_lengths_and_blank_last():
+    import jax
+
+    T, N, C = 5, 1, 3
+    rng = np.random.RandomState(1)
+    data = rng.randn(T, N, C).astype(np.float32)
+    logp = np.asarray(jax.nn.log_softmax(data, axis=-1))
+    # blank_label='last': blank id C-1, labels 0..C-2
+    loss = nd.CTCLoss(nd.array(data), nd.array(np.array([[0, 1]], np.float32)),
+                      blank_label="last").asnumpy()
+    assert np.allclose(loss[0], _brute_ctc(logp[:, 0], (0, 1), blank=C - 1),
+                       atol=1e-4)
+    # explicit data length < T must shorten the recursion
+    dl = nd.array(np.array([4], np.float32))
+    loss4 = nd.CTCLoss(nd.array(data), nd.array(np.array([[1, 0]], np.float32)),
+                       dl, use_data_lengths=True).asnumpy()
+    assert np.allclose(loss4[0], _brute_ctc(logp[:4, 0], (1,)), atol=1e-4)
+
+
+def test_ctc_loss_differentiable():
+    x = nd.random.uniform(shape=(5, 2, 4))
+    x.attach_grad()
+    with autograd.record():
+        loss = nd.CTCLoss(x, nd.array(np.array([[1, 2], [2, 0]],
+                                               np.float32))).sum()
+    loss.backward()
+    g = x.grad.asnumpy()
+    assert np.isfinite(g).all() and (np.abs(g) > 0).any()
+
+
+def test_box_iou_and_nms():
+    a = nd.array(np.array([[0, 0, 2, 2]], np.float32))
+    b = nd.array(np.array([[1, 1, 3, 3]], np.float32))
+    assert np.allclose(nd.contrib.box_iou(a, b).asnumpy(), 1.0 / 7.0)
+    boxes = np.array([[0, 0.9, 0, 0, 10, 10],
+                      [1, 0.8, 1, 1, 11, 11],
+                      [0, 0.7, 20, 20, 30, 30],
+                      [0, 0.05, 0, 0, 9, 9]], np.float32)
+    out = nd.contrib.box_nms(nd.array(boxes), overlap_thresh=0.5,
+                             valid_thresh=0.1,
+                             force_suppress=True).asnumpy()
+    # box1 overlaps box0 beyond thresh -> suppressed; box3 under
+    # valid_thresh -> invalid; box2 disjoint -> kept.  Suppressed rows
+    # are wiped to -1 across all columns (reference semantics)
+    assert np.allclose(out[:, 1], [0.9, -1.0, 0.7, -1.0])
+    assert np.allclose(out[1], -1.0) and np.allclose(out[3], -1.0)
+    assert np.allclose(out[0], boxes[0])  # survivors pass through
+    # per-class mode: different ids never suppress each other
+    out2 = nd.contrib.box_nms(nd.array(boxes), overlap_thresh=0.5,
+                              valid_thresh=0.1, id_index=0,
+                              force_suppress=False).asnumpy()
+    assert np.allclose(out2[:, 1], [0.9, 0.8, 0.7, -1.0])
+
+
+def test_box_nms_out_format_conversion():
+    # one valid center-format box: cx=5, cy=5, w=4, h=2 -> corners 3,4,7,6
+    boxes = np.array([[0, 0.9, 5, 5, 4, 2]], np.float32)
+    out = nd.contrib.box_nms(nd.array(boxes), in_format="center",
+                             out_format="corner").asnumpy()
+    assert np.allclose(out[0, 2:6], [3, 4, 7, 6])
+    back = nd.contrib.box_nms(nd.array(out), in_format="corner",
+                              out_format="center").asnumpy()
+    assert np.allclose(back[0, 2:6], [5, 5, 4, 2])
+
+
+def test_roi_align_position_sensitive_rejected():
+    img = nd.zeros((1, 4, 4, 4))
+    rois = nd.array(np.array([[0, 0, 0, 3, 3]], np.float32))
+    with pytest.raises(Exception):
+        nd.contrib.ROIAlign(img, rois, pooled_size=(2, 2),
+                            position_sensitive=True)
+
+
+def test_sample_multinomial_get_prob_differentiable():
+    mx.random.seed(5)
+    p = nd.array(np.array([[0.3, 0.7]], np.float32))
+    p.attach_grad()
+    with autograd.record():
+        s, logp = nd.sample_multinomial(p, get_prob=True)
+        (logp.sum()).backward()
+    g = p.grad.asnumpy()
+    assert np.isfinite(g).all() and (np.abs(g) > 0).any()
+
+
+def test_roi_align_values():
+    img = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    rois = nd.array(np.array([[0, 0, 0, 3, 3]], np.float32))
+    out = nd.contrib.ROIAlign(img, rois, pooled_size=(2, 2),
+                              spatial_scale=1.0).asnumpy()
+    # bilinear average of each quadrant's sample taps
+    assert np.allclose(out.ravel(), [3.75, 5.25, 9.75, 11.25])
+    # gradient flows to the image
+    img.attach_grad()
+    with autograd.record():
+        y = nd.contrib.ROIAlign(img, rois, pooled_size=(2, 2)).sum()
+    y.backward()
+    assert np.isfinite(img.grad.asnumpy()).all()
+    assert img.grad.asnumpy().sum() > 0
+
+
+def test_moments_matches_numpy():
+    x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    m, v = nd.moments(nd.array(x), axes=(0,))
+    assert np.allclose(m.asnumpy(), x.mean(0), atol=1e-6)
+    assert np.allclose(v.asnumpy(), x.var(0), atol=1e-6)
+
+
+def test_amp_ops():
+    a = nd.array(np.ones((2, 2), np.float32))
+    assert nd.amp_cast(a, dtype="float16").dtype == np.float16
+    b16 = nd.amp_cast(a, dtype="float16")
+    outs = nd.amp_multicast(b16, a, num_outputs=2)
+    assert all(o.dtype == np.float32 for o in outs)  # widest wins
+    outs = nd.amp_multicast(b16, a, num_outputs=2, cast_narrow=True)
+    assert all(o.dtype == np.float16 for o in outs)
+    assert nd.all_finite(a).asnumpy()[0] == 1.0
+    assert nd.all_finite(nd.array(np.array([np.inf]))).asnumpy()[0] == 0.0
+    assert nd.multi_all_finite(a, a, num_arrays=2).asnumpy()[0] == 1.0
+
+
+def test_index_copy_add_allclose_quadratic():
+    old = nd.zeros((4, 2))
+    new = nd.array(np.ones((2, 2), np.float32))
+    idx = nd.array(np.array([1, 3], np.float32))
+    out = nd.contrib.index_copy(old, idx, new).asnumpy()
+    assert np.allclose(out[[1, 3]], 1.0) and np.allclose(out[[0, 2]], 0.0)
+    out2 = nd.contrib.index_add(nd.ones((4, 2)), idx, new).asnumpy()
+    assert np.allclose(out2[[1, 3]], 2.0)
+    assert nd.contrib.allclose(old, old).asnumpy()[0] == 1.0
+    q = nd.contrib.quadratic(nd.array(np.array([2.0])), a=1.0, b=2.0,
+                             c=3.0).asnumpy()
+    assert np.allclose(q, 11.0)
+
+
+def test_gradientmultiplier_reverses_gradient():
+    x = nd.array(np.array([1.0, 2.0]))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.contrib.gradientmultiplier(x, scalar=-0.5).sum()
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [-0.5, -0.5])
+
+
+def test_fft_ifft_reference_semantics():
+    x = np.array([[1.0, 2.0, 3.0, 4.0]], np.float32)
+    f = nd.contrib.fft(nd.array(x))
+    assert f.shape == (1, 8)  # interleaved re/im
+    # reference contrib ifft is unnormalized: ifft(fft(x)) == d * x
+    assert np.allclose(nd.contrib.ifft(f).asnumpy(), 4 * x, atol=1e-4)
+
+
+def test_sample_multinomial_and_shuffle():
+    mx.random.seed(7)
+    p = nd.array(np.array([[0.0, 1.0, 0.0]], np.float32))
+    assert nd.sample_multinomial(p).asnumpy()[0] == 1
+    data = nd.array(np.arange(10, dtype=np.float32))
+    mx.random.seed(3)
+    s = nd.shuffle(data).asnumpy()
+    assert sorted(s.tolist()) == list(range(10))
+
+
+def test_softmax_cross_entropy_total():
+    x = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+    y = np.array([0, 2, 1, 4], np.float32)
+    out = nd.softmax_cross_entropy(nd.array(x), nd.array(y)).asnumpy()
+    logp = x - np.log(np.exp(x).sum(1, keepdims=True))
+    expect = -logp[np.arange(4), y.astype(int)].sum()
+    assert np.allclose(out, expect, atol=1e-4)
+
+
+def test_lamb_phases_descend():
+    w = nd.array(np.ones((4,), np.float32))
+    g = nd.array(np.full((4,), 0.5, np.float32))
+    mean, var = nd.zeros((4,)), nd.zeros((4,))
+    upd = nd.lamb_update_phase1(w, g, mean, var, t=1, wd=0.01)
+    assert (np.abs(mean.asnumpy()) > 0).all()  # states updated in place
+    r1 = nd.array(np.array([np.linalg.norm(w.asnumpy())], np.float32))
+    r2 = nd.array(np.array([np.linalg.norm(upd.asnumpy())], np.float32))
+    w2 = nd.lamb_update_phase2(w, upd, r1, r2, lr=0.1)
+    assert (w2.asnumpy() < 1.0).all()
+
+
+def test_arange_like_and_isfinite():
+    x = nd.zeros((2, 3))
+    out = nd.contrib.arange_like(x).asnumpy()
+    assert np.allclose(out, np.arange(6).reshape(2, 3))
+    out = nd.contrib.arange_like(x, axis=1).asnumpy()
+    assert np.allclose(out, [0, 1, 2])
+    assert np.allclose(
+        nd.isfinite(nd.array(np.array([1.0, np.inf, np.nan]))).asnumpy(),
+        [1.0, 0.0, 0.0])
+
+
+def test_legacy_v1_aliases():
+    x = nd.random.uniform(shape=(1, 3, 8, 8))
+    w = nd.random.uniform(shape=(4, 3, 3, 3))
+    b = nd.zeros((4,))
+    y1 = nd.Convolution_v1(x, w, b, kernel=(3, 3), num_filter=4)
+    y2 = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4)
+    assert np.allclose(y1.asnumpy(), y2.asnumpy())
+    p = nd.Pooling_v1(x, kernel=(2, 2), pool_type="max", stride=(2, 2))
+    assert p.shape == (1, 3, 4, 4)
