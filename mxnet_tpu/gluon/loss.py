@@ -232,3 +232,34 @@ class CosineEmbeddingLoss(Loss):
                        F.relu(cos - self._margin))
         loss = _apply_weighting(F, loss, self._weight, sample_weight)
         return loss
+
+
+class CTCLoss(Loss):
+    """Connectionist Temporal Classification loss (ref:
+    gluon.loss.CTCLoss over src/operator/contrib/ctc_loss.cc).
+
+    layout: 'NTC' (default, batch-major) or 'TNC'; label_layout 'NT'.
+    """
+
+    def __init__(self, layout="NTC", label_layout="NT", weight=None,
+                 **kwargs):
+        if layout not in ("NTC", "TNC"):
+            raise ValueError(f"unsupported pred layout {layout!r}")
+        if label_layout not in ("NT", "TN"):
+            raise ValueError(f"unsupported label layout {label_layout!r}")
+        self._layout = layout
+        self._label_layout = label_layout
+        batch_axis = 0 if label_layout == "NT" else 1
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, pred_lengths=None,
+                       label_lengths=None, sample_weight=None):
+        if self._layout == "NTC":
+            pred = F.swapaxes(pred, dim1=0, dim2=1)    # -> (T, N, C)
+        if self._label_layout == "TN":
+            label = F.swapaxes(label, dim1=0, dim2=1)  # -> (N, L)
+        loss = F.CTCLoss(pred, label, pred_lengths, label_lengths,
+                         use_data_lengths=pred_lengths is not None,
+                         use_label_lengths=label_lengths is not None,
+                         blank_label="last")
+        return _apply_weighting(F, loss, self._weight, sample_weight)

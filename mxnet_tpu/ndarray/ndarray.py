@@ -440,14 +440,14 @@ class NDArray:
         if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
             shape = tuple(shape[0])
         shape = kwargs.get("shape", shape)
-        # MXNet magic values: -1 infer, 0 copy-from-input, -2/-3/-4 advanced
-        out = []
-        for i, s in enumerate(shape):
-            if s == 0 and i < self.ndim:
-                out.append(self.shape[i])
-            else:
-                out.append(int(s))
-        return invoke(_reshape, self, shape=tuple(out))
+        reverse = bool(kwargs.get("reverse", False))
+        # MXNet magic values (0 copy, -1 infer, -2 rest, -3 merge,
+        # -4 split) resolved centrally — ref matrix_op-inl.h
+        if any(int(s) <= 0 for s in shape):
+            from ..ops.tensor import mx_reshape_target
+
+            shape = mx_reshape_target(self.shape, shape, reverse)
+        return invoke(_reshape, self, shape=tuple(int(s) for s in shape))
 
     def reshape_like(self, other):
         return invoke(_reshape, self, shape=other.shape)

@@ -237,7 +237,90 @@ register("khatri_rao", _k_khatri_rao, variadic=True)
 # ---------------------------------------------------------------------------
 # Shape manipulation (ref: matrix_op.cc)
 
-def _k_reshape(data, *, shape):
+def mx_reshape_target(in_shape, spec, reverse=False):
+    """Resolve MXNet reshape magic codes to a concrete shape (ref:
+    matrix_op-inl.h InferReshapeShape): 0 copy input dim, -1 infer one,
+    -2 copy all remaining, -3 merge next two, -4 split one dim into the
+    following two entries; reverse applies the spec right-to-left."""
+    ins = list(in_shape)
+    spec = [int(s) for s in spec]
+    if reverse:
+        if -4 in spec:
+            raise ValueError("reshape: reverse=True with -4 split is "
+                             "not supported")
+        ins, spec = ins[::-1], spec[::-1]
+    out, i, j = [], 0, 0
+    while j < len(spec):
+        s = spec[j]
+        if s > 0:
+            out.append(s)
+            i += 1
+        elif s == 0:
+            if i >= len(ins):
+                raise ValueError(f"reshape 0 at output pos {j} has no "
+                                 f"matching input dim for {tuple(in_shape)}")
+            out.append(ins[i])
+            i += 1
+        elif s == -1:
+            out.append(-1)
+            i += 1
+        elif s == -2:
+            out.extend(ins[i:])
+            i = len(ins)
+        elif s == -3:
+            if i + 1 >= len(ins):
+                raise ValueError("reshape -3 needs two input dims")
+            out.append(ins[i] * ins[i + 1])
+            i += 2
+        elif s == -4:
+            if j + 2 >= len(spec):
+                raise ValueError("reshape -4 needs two following entries")
+            d1, d2 = spec[j + 1], spec[j + 2]
+            cur = ins[i]
+            if d1 == -1 and d2 == -1:
+                raise ValueError("reshape -4 cannot infer both factors")
+            if d1 == 0 or d2 == 0 or d1 < -1 or d2 < -1:
+                raise ValueError(
+                    f"reshape -4 factors must be positive or -1, got "
+                    f"({d1}, {d2})")
+            if d1 == -1:
+                d1 = cur // d2
+            if d2 == -1:
+                d2 = cur // d1
+            if d1 * d2 != cur:
+                raise ValueError(
+                    f"reshape -4 split ({spec[j + 1]}, {spec[j + 2]}) "
+                    f"does not factor input dim {cur}")
+            out.extend([d1, d2])
+            i += 1
+            j += 2
+        else:
+            raise ValueError(f"invalid reshape code {s}")
+        j += 1
+    if reverse:
+        out = out[::-1]
+    # resolve a single -1 from the total size
+    if out.count(-1) > 1:
+        raise ValueError(f"reshape can infer at most one dim, got {spec}")
+    total = 1
+    for d in in_shape:
+        total *= d
+    if -1 in out:
+        known = 1
+        for d in out:
+            if d != -1:
+                known *= d
+        if known <= 0 or total % known != 0:
+            raise ValueError(
+                f"reshape cannot infer -1: input size {total} is not "
+                f"divisible by the known dims of {tuple(spec)}")
+        out[out.index(-1)] = total // known
+    return tuple(out)
+
+
+def _k_reshape(data, *, shape, reverse=False):
+    if any(s <= 0 for s in shape):
+        shape = mx_reshape_target(data.shape, shape, reverse)
     return jnp.reshape(data, shape)
 
 register("reshape", _k_reshape, aliases=("Reshape",))

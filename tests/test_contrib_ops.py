@@ -245,3 +245,25 @@ def test_legacy_v1_aliases():
     assert np.allclose(y1.asnumpy(), y2.asnumpy())
     p = nd.Pooling_v1(x, kernel=(2, 2), pool_type="max", stride=(2, 2))
     assert p.shape == (1, 3, 4, 4)
+
+
+def test_gluon_ctc_loss():
+    from mxnet_tpu import gluon
+
+    mx.random.seed(0)
+    loss = gluon.loss.CTCLoss()                      # NTC, blank last
+    pred = nd.random.uniform(shape=(2, 8, 5))
+    label = nd.array(np.array([[0, 1, -1], [2, 2, 3]], np.float32))
+    out = loss(pred, label)
+    assert out.shape == (2,) and np.isfinite(out.asnumpy()).all()
+    # TNC layout must agree with manually swapped NTC
+    out_tnc = gluon.loss.CTCLoss(layout="TNC")(
+        nd.swapaxes(pred, dim1=0, dim2=1), label)
+    assert np.allclose(out.asnumpy(), out_tnc.asnumpy(), atol=1e-5)
+    # explicit lengths path
+    out_len = loss(pred, label,
+                   nd.array(np.array([8, 6], np.float32)),
+                   nd.array(np.array([2, 3], np.float32)))
+    assert np.isfinite(out_len.asnumpy()).all()
+    with pytest.raises(ValueError):
+        gluon.loss.CTCLoss(layout="CTN")

@@ -340,3 +340,30 @@ def test_cast_stop_gradient():
     y.backward()
     assert np.allclose(x.grad.asnumpy(), [1.0])
     assert nd.cast(x, dtype="float16").dtype == np.float16
+
+
+def test_reshape_magic_codes():
+    """Ref matrix_op-inl.h InferReshapeShape: 0 copy, -1 infer, -2 rest,
+    -3 merge, -4 split, reverse right-to-left (doc examples)."""
+    x = nd.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    assert nd.reshape(x, shape=(0, 0, -1)).shape == (2, 3, 4)
+    assert nd.reshape(x, shape=(-2,)).shape == (2, 3, 4)
+    assert nd.reshape(x, shape=(0, -3)).shape == (2, 12)
+    assert nd.reshape(x, shape=(-3, 4)).shape == (6, 4)
+    assert nd.reshape(x, shape=(0, -4, 1, 3, 0)).shape == (2, 1, 3, 4)
+    assert nd.reshape(x, shape=(0, -4, -1, 1, 0)).shape == (2, 3, 1, 4)
+    # reverse doc example: (10, 5, 4) + (-1, 0) -> (50, 4)
+    y = nd.zeros((10, 5, 4))
+    assert y.reshape((-1, 0), reverse=True).shape == (50, 4)
+    assert y.reshape((-1, 0)).shape == (40, 5)
+    # values preserved, not just shapes
+    out = nd.reshape(x, shape=(0, -3)).asnumpy()
+    assert np.allclose(out, np.arange(24).reshape(2, 12))
+    with pytest.raises(ValueError, match="invalid reshape code"):
+        nd.reshape(x, shape=(-5,))
+    with pytest.raises(ValueError, match="not divisible"):
+        nd.reshape(x, shape=(-1, 5))
+    with pytest.raises(ValueError, match="does not factor"):
+        nd.reshape(x, shape=(0, -4, 2, -1, 0))
+    with pytest.raises(ValueError, match="factors must be positive"):
+        nd.reshape(x, shape=(0, -4, -1, 0, 0))
