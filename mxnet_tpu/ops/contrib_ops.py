@@ -500,3 +500,182 @@ register("lamb_update_phase1", _k_lamb_update_phase1,
 register("lamb_update_phase2", _k_lamb_update_phase2,
          arg_names=("weight", "g", "r1", "r2"), nondiff=True,
          doc=_k_lamb_update_phase2.__doc__)
+
+
+# ---------------------------------------------------------------------------
+# SSD MultiBox family (ref: src/operator/contrib/multibox_prior.cc,
+# multibox_target.cc, multibox_detection.cc — the detection-era anchor
+# pipeline).  All three are static-shape HLO: anchor generation is pure
+# arithmetic, target matching is a vectorized argmax bipartite pass, and
+# detection decodes + reuses the greedy fori_loop NMS.
+
+def _k_multibox_prior(data, *, sizes=(1.0,), ratios=(1.0,), steps=(-1.0, -1.0),
+                      offsets=(0.5, 0.5), clip=False):
+    """Anchor boxes per feature-map cell: data (B, C, H, W) ->
+    (1, H*W*(S+R-1), 4) corner boxes in [0,1] coords.
+
+    Reference order (multibox_prior.h): every size at ratios[0] first,
+    then sizes[0] with each remaining ratio; widths carry the in_h/in_w
+    aspect correction so anchors are square in pixels on non-square
+    feature maps."""
+    H, W = data.shape[2], data.shape[3]
+    if isinstance(sizes, (int, float)):
+        sizes = (float(sizes),)
+    if isinstance(ratios, (int, float)):
+        ratios = (float(ratios),)
+    sizes, ratios = tuple(sizes), tuple(ratios)
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(W, dtype=jnp.float32) + offsets[1]) * step_x
+    aspect = H / W
+    ws, hs = [], []
+    for s in sizes:                       # sizes first, at ratios[0]
+        sr = ratios[0] ** 0.5
+        ws.append(s * sr * aspect)
+        hs.append(s / sr)
+    for r in ratios[1:]:                  # then ratios[1:], at sizes[0]
+        sr = r ** 0.5
+        ws.append(sizes[0] * sr * aspect)
+        hs.append(sizes[0] / sr)
+    ws = jnp.asarray(ws, jnp.float32)[None, None, :]
+    hs = jnp.asarray(hs, jnp.float32)[None, None, :]
+    cy_g = cy[:, None, None]
+    cx_g = cx[None, :, None]
+    x1 = cx_g - ws / 2
+    y1 = cy_g - hs / 2
+    x2 = cx_g + ws / 2
+    y2 = cy_g + hs / 2
+    out = jnp.stack(jnp.broadcast_arrays(x1, y1, x2, y2), axis=-1)
+    out = out.reshape(1, -1, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+def _k_multibox_target(anchor, label, cls_pred, *, overlap_threshold=0.5,
+                       ignore_label=-1.0, negative_mining_ratio=-1.0,
+                       negative_mining_thresh=0.5, minimum_negative_samples=0,
+                       variances=(0.1, 0.1, 0.2, 0.2)):
+    """Match anchors to ground truth (ref multibox_target.cc).
+
+    anchor (1, N, 4) corners; label (B, M, 5) [cls, x1, y1, x2, y2] with
+    cls=-1 padding; cls_pred (B, num_cls+1, N) feeds hard negative
+    mining: when negative_mining_ratio > 0, unmatched anchors below
+    negative_mining_thresh IoU are ranked by background-class prediction
+    loss and only the top ratio*num_pos (>= minimum_negative_samples)
+    are labelled background — the rest get ignore_label (ref
+    multibox_target.cc mining; rank-vs-traced-scalar keeps shapes
+    static).  Returns (box_target (B, N*4), box_mask (B, N*4),
+    cls_target (B, N) — 0 background, 1+cls matched, ignore_label
+    unmined).
+    """
+    anc = anchor[0]                                     # (N, 4)
+    N = anc.shape[0]
+
+    def one(lab, cpred):
+        gt_valid = lab[:, 0] >= 0                       # (M,)
+        gt_boxes = lab[:, 1:5]
+        iou = _pair_iou(anc, gt_boxes)                  # (N, M)
+        iou = jnp.where(gt_valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)               # (N,)
+        best_iou = jnp.max(iou, axis=1)
+        # bipartite stage: each gt claims its best anchor
+        best_anchor_per_gt = jnp.argmax(iou, axis=0)    # (M,)
+        # .max, not .set: a padding gt (valid=False) scattering onto the
+        # same anchor as a real gt must not clobber the real claim
+        claimed = jnp.zeros(N, bool).at[best_anchor_per_gt].max(
+            gt_valid, mode="drop")
+        matched = claimed | (best_iou >= overlap_threshold)
+        m_gt = best_gt
+        gt = gt_boxes[m_gt]                             # (N, 4)
+        # encode center-offset targets with variances
+        aw = jnp.maximum(anc[:, 2] - anc[:, 0], 1e-12)
+        ah = jnp.maximum(anc[:, 3] - anc[:, 1], 1e-12)
+        acx = (anc[:, 0] + anc[:, 2]) / 2
+        acy = (anc[:, 1] + anc[:, 3]) / 2
+        gw = jnp.maximum(gt[:, 2] - gt[:, 0], 1e-12)
+        gh = jnp.maximum(gt[:, 3] - gt[:, 1], 1e-12)
+        gcx = (gt[:, 0] + gt[:, 2]) / 2
+        gcy = (gt[:, 1] + gt[:, 3]) / 2
+        tx = (gcx - acx) / aw / variances[0]
+        ty = (gcy - acy) / ah / variances[1]
+        tw = jnp.log(gw / aw) / variances[2]
+        th = jnp.log(gh / ah) / variances[3]
+        t = jnp.stack([tx, ty, tw, th], axis=-1)        # (N, 4)
+        mask = matched[:, None].astype(jnp.float32) * jnp.ones((1, 4))
+        cls_t = jnp.where(matched, lab[m_gt, 0] + 1.0, 0.0)
+        if negative_mining_ratio > 0:
+            # hard negative mining: rank unmatched low-IoU anchors by
+            # background prediction loss, keep the hardest k
+            logp = jax.nn.log_softmax(cpred.astype(jnp.float32), axis=0)
+            neg_loss = -logp[0]                          # bg is class 0
+            cand = (~matched) & (best_iou < negative_mining_thresh)
+            num_pos = matched.astype(jnp.float32).sum()
+            k = jnp.maximum(negative_mining_ratio * num_pos,
+                            float(minimum_negative_samples))
+            ranked = jnp.argsort(
+                jnp.where(cand, neg_loss, -jnp.inf))[::-1]
+            rank = jnp.zeros(N).at[ranked].set(
+                jnp.arange(N, dtype=jnp.float32))
+            kept_neg = cand & (rank < k)
+            cls_t = jnp.where(matched, cls_t,
+                              jnp.where(kept_neg, 0.0,
+                                        float(ignore_label)))
+        return (t * mask).reshape(-1), mask.reshape(-1), cls_t
+
+    bt, bm, ct = jax.vmap(one)(label, cls_pred)
+    return bt, bm, ct
+
+
+def _k_multibox_detection(cls_prob, loc_pred, anchor, *, clip=True,
+                          threshold=0.01, background_id=0,
+                          nms_threshold=0.5, force_suppress=False,
+                          variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode predictions to detections (ref multibox_detection.cc):
+    cls_prob (B, num_cls+1, N), loc_pred (B, N*4), anchor (1, N, 4) ->
+    (B, N, 6) rows [cls_id, score, x1, y1, x2, y2], -1 rows invalid."""
+    anc = anchor[0]
+    N = anc.shape[0]
+
+    def one(probs, loc):
+        loc = loc.reshape(N, 4)
+        aw = anc[:, 2] - anc[:, 0]
+        ah = anc[:, 3] - anc[:, 1]
+        acx = (anc[:, 0] + anc[:, 2]) / 2
+        acy = (anc[:, 1] + anc[:, 3]) / 2
+        cx = loc[:, 0] * variances[0] * aw + acx
+        cy = loc[:, 1] * variances[1] * ah + acy
+        w = jnp.exp(loc[:, 2] * variances[2]) * aw
+        h = jnp.exp(loc[:, 3] * variances[3]) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2,
+                           cx + w / 2, cy + h / 2], axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class per anchor
+        fg = jnp.concatenate([probs[:background_id],
+                              probs[background_id + 1:]], axis=0)
+        cls_id = jnp.argmax(fg, axis=0).astype(jnp.float32)
+        score = jnp.max(fg, axis=0)
+        keep = score > threshold
+        rows = jnp.concatenate(
+            [jnp.where(keep, cls_id, -1.0)[:, None],
+             jnp.where(keep, score, -1.0)[:, None], boxes], axis=-1)
+        return rows
+
+    rows = jax.vmap(one)(cls_prob, loc_pred)            # (B, N, 6)
+    return _k_box_nms(rows, overlap_thresh=nms_threshold,
+                      valid_thresh=threshold, topk=nms_topk,
+                      coord_start=2, score_index=1, id_index=0,
+                      force_suppress=force_suppress)
+
+
+register("_contrib_MultiBoxPrior", _k_multibox_prior, arg_names=("data",),
+         aliases=("MultiBoxPrior",), nondiff=True,
+         doc=_k_multibox_prior.__doc__)
+register("_contrib_MultiBoxTarget", _k_multibox_target,
+         arg_names=("anchor", "label", "cls_pred"), num_outputs=3,
+         nondiff=True, doc=_k_multibox_target.__doc__)
+register("_contrib_MultiBoxDetection", _k_multibox_detection,
+         arg_names=("cls_prob", "loc_pred", "anchor"), nondiff=True,
+         doc=_k_multibox_detection.__doc__)

@@ -267,3 +267,113 @@ def test_gluon_ctc_loss():
     assert np.isfinite(out_len.asnumpy()).all()
     with pytest.raises(ValueError):
         gluon.loss.CTCLoss(layout="CTN")
+
+
+def test_multibox_prior_layout():
+    x = nd.zeros((1, 3, 2, 2))
+    anchors = nd.contrib.MultiBoxPrior(x, sizes=(0.5, 0.25),
+                                       ratios=(1.0, 2.0)).asnumpy()
+    # S + R - 1 = 3 anchors per cell, 2x2 cells
+    assert anchors.shape == (1, 12, 4)
+    # reference order (multibox_prior.h): sizes at ratios[0] first,
+    # then ratios[1:] at sizes[0].  Cell 0 center (0.25, 0.25).
+    assert np.allclose(anchors[0, 0], [0.0, 0.0, 0.5, 0.5], atol=1e-6)
+    assert np.allclose(anchors[0, 1],
+                       [0.125, 0.125, 0.375, 0.375], atol=1e-6)
+    w, h = 0.5 * np.sqrt(2), 0.5 / np.sqrt(2)
+    assert np.allclose(anchors[0, 2],
+                       [0.25 - w / 2, 0.25 - h / 2,
+                        0.25 + w / 2, 0.25 + h / 2], atol=1e-6)
+    # non-square feature map: widths carry the in_h/in_w correction so
+    # the ratio-1 anchor is square in pixel space
+    a2 = nd.contrib.MultiBoxPrior(nd.zeros((1, 3, 2, 4)),
+                                  sizes=(0.5,)).asnumpy()
+    w2 = a2[0, 0, 2] - a2[0, 0, 0]
+    h2 = a2[0, 0, 3] - a2[0, 0, 1]
+    assert np.allclose(w2, h2 * 2 / 4 * 1), (w2, h2)  # w = s*(H/W)
+    # int scalars accepted like the reference's attr parsing
+    a3 = nd.contrib.MultiBoxPrior(x, sizes=1, ratios=1)
+    assert a3.shape == (1, 4, 4)
+
+
+def test_multibox_target_hard_negative_mining():
+    anchors = nd.contrib.MultiBoxPrior(nd.zeros((1, 3, 4, 4)),
+                                       sizes=(0.4,), ratios=(1.0,))
+    N = anchors.shape[1]
+    label = nd.array(np.array([[[0, 0.1, 0.1, 0.45, 0.45]]], np.float32))
+    # cls_pred: make a few unmatched anchors look confidently non-bg
+    cpred = np.zeros((1, 3, N), np.float32)
+    cpred[0, 1, :4] = 5.0          # anchors 0-3: hard negatives
+    _, _, ct = nd.contrib.MultiBoxTarget(
+        anchors, label, nd.array(cpred), negative_mining_ratio=2.0,
+        negative_mining_thresh=0.5, ignore_label=-1.0)
+    ct = ct.asnumpy()[0]
+    n_pos = (ct > 0).sum()
+    n_bg = (ct == 0).sum()
+    n_ign = (ct == -1).sum()
+    assert n_pos >= 1
+    assert n_bg <= 2 * n_pos + 1   # ratio bound holds
+    assert n_ign == N - n_pos - n_bg and n_ign > 0
+    # the kept negatives are exactly the confidently-wrong anchors
+    kept = np.where(ct == 0)[0]
+    assert set(kept).issubset({0, 1, 2, 3})
+
+
+def test_multibox_target_encode_decode_roundtrip():
+    anchors = nd.contrib.MultiBoxPrior(nd.zeros((1, 3, 4, 4)),
+                                       sizes=(0.4,), ratios=(1.0,))
+    # one gt box; cls 2
+    label = nd.array(np.array(
+        [[[2, 0.1, 0.1, 0.4, 0.45],
+          [-1, 0, 0, 0, 0]]], np.float32))
+    cls_pred = nd.zeros((1, 4, anchors.shape[1]))
+    bt, bm, ct = nd.contrib.MultiBoxTarget(anchors, label, cls_pred)
+    bt, bm, ct = bt.asnumpy(), bm.asnumpy(), ct.asnumpy()
+    assert (bm > 0).any(), "at least the bipartite match must fire"
+    matched = np.where(ct[0] > 0)[0]
+    assert (ct[0][matched] == 3).all()  # cls 2 -> target 3 (bg=0)
+    # decode the encoded target for a matched anchor -> the gt box
+    anc = anchors.asnumpy()[0]
+    i = matched[0]
+    t = bt[0].reshape(-1, 4)[i]
+    aw, ah = anc[i, 2] - anc[i, 0], anc[i, 3] - anc[i, 1]
+    acx, acy = (anc[i, 0] + anc[i, 2]) / 2, (anc[i, 1] + anc[i, 3]) / 2
+    cx = t[0] * 0.1 * aw + acx
+    cy = t[1] * 0.1 * ah + acy
+    w = np.exp(t[2] * 0.2) * aw
+    h = np.exp(t[3] * 0.2) * ah
+    assert np.allclose([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                       [0.1, 0.1, 0.4, 0.45], atol=1e-5)
+
+
+def test_multibox_detection_roundtrip():
+    anchors = nd.contrib.MultiBoxPrior(nd.zeros((1, 3, 4, 4)),
+                                       sizes=(0.4,), ratios=(1.0,))
+    N = anchors.shape[1]
+    # ground truth: the anchor at index 5, class 1
+    anc = anchors.asnumpy()[0]
+    cls_prob = np.full((1, 3, N), 0.01, np.float32)  # bg + 2 classes
+    cls_prob[0, 2, 5] = 0.95                          # class 1 at anchor 5
+    loc_pred = np.zeros((1, N * 4), np.float32)       # zero offsets
+    out = nd.contrib.MultiBoxDetection(
+        nd.array(cls_prob), nd.array(loc_pred), anchors).asnumpy()
+    rows = out[0]
+    live = rows[rows[:, 0] >= 0]
+    assert len(live) >= 1
+    best = live[np.argmax(live[:, 1])]
+    assert best[0] == 1 and best[1] > 0.9
+    assert np.allclose(best[2:6], anc[5], atol=1e-5)
+
+
+def test_multibox_target_padding_gt_cannot_clobber():
+    """A padding row whose all -1 IoU argmaxes to anchor 0 must not wipe
+    a real gt's bipartite claim on anchor 0."""
+    # one anchor only: the real gt and the padding row both argmax to it
+    anchors = nd.array(np.array([[[0.0, 0.0, 1.0, 1.0]]], np.float32))
+    label = nd.array(np.array(
+        [[[1, 0.0, 0.0, 1.0, 1.0],
+          [-1, 0, 0, 0, 0]]], np.float32))
+    cls_pred = nd.zeros((1, 3, 1))
+    _, bm, ct = nd.contrib.MultiBoxTarget(anchors, label, cls_pred)
+    assert ct.asnumpy()[0, 0] == 2.0  # class 1 -> target 2
+    assert (bm.asnumpy() > 0).all()
