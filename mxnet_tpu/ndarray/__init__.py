@@ -53,3 +53,33 @@ random_randint = random.randint
 def __getattr__(name):
     # fall through to generated ops for aliases added later
     return getattr(_gen_ops, name)
+
+
+class _RandomNamespace:
+    """mx.sym.random-style access by registry name: `random.X` → the op
+    registered as `_random_X` (ref: python/mxnet/{ndarray,symbol}/
+    random.py generated wrappers).  Names whose ops live under other
+    registry spellings (multinomial → sample_multinomial, shuffle →
+    _shuffle) are mapped so eager code keeps working when hybridized."""
+
+    _OP_ALIASES = {"multinomial": "sample_multinomial",
+                   "shuffle": "_shuffle",
+                   "randint": "_random_randint"}
+
+    def __init__(self, mod):
+        self._mod = mod
+
+    def __getattr__(self, name):
+        if name == "randn":
+            normal = getattr(self._mod, "_random_normal")
+
+            def randn(*shape, loc=0.0, scale=1.0, **kw):
+                return normal(loc=loc, scale=scale, shape=shape, **kw)
+
+            return randn
+        target = self._OP_ALIASES.get(name, "_random_" + name)
+        try:
+            return getattr(self._mod, target)
+        except AttributeError:
+            raise AttributeError(
+                f"random namespace has no operator '{name}'") from None

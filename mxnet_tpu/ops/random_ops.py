@@ -1,0 +1,183 @@
+"""Random sampling operators (ref: src/operator/random/sample_op.cc,
+sample_multinomial_op.cc, multisample_op.cc).
+
+Two families, mirroring the reference:
+- ``_random_*`` — scalar-parameter generators with a ``shape`` attr
+  (the ops behind mx.nd.random.* / mx.sym.random.*).
+- ``sample_*`` — array-parameter generators: each element of the
+  parameter tensors parameterizes its own distribution; output shape is
+  ``param_shape + shape`` (ref multisample_op.h).
+
+All draw from the framework seed stream (needs_rng: the wrapper passes
+a fresh PRNG key split from mx.random.seed state), so symbolic graphs
+and hybridized blocks containing them stay pure functions of (inputs,
+key) — the jax discipline the whole stack rides on.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _shp(shape):
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s) for s in shape)
+
+
+def _dt(dtype, default=jnp.float32):
+    return jnp.dtype(dtype) if dtype not in (None, "None") else default
+
+
+# ---------------------------------------------------------------------------
+# scalar-parameter generators (ref sample_op.cc)
+
+def _k_random_uniform(key=None, *, low=0.0, high=1.0, shape=(1,),
+                      dtype="float32", ctx=None):
+    return jax.random.uniform(key, _shp(shape), _dt(dtype),
+                              minval=low, maxval=high)
+
+
+def _k_random_normal(key=None, *, loc=0.0, scale=1.0, shape=(1,),
+                     dtype="float32", ctx=None):
+    return loc + scale * jax.random.normal(key, _shp(shape), _dt(dtype))
+
+
+def _k_random_gamma(key=None, *, alpha=1.0, beta=1.0, shape=(1,),
+                    dtype="float32", ctx=None):
+    return beta * jax.random.gamma(key, alpha, _shp(shape), _dt(dtype))
+
+
+def _k_random_exponential(key=None, *, lam=None, scale=None, shape=(1,),
+                          dtype="float32", ctx=None):
+    """Accepts either the op-level rate ``lam`` or the python-API mean
+    ``scale`` (= 1/lam) — upstream's python wrapper converts scale to
+    lam before hitting the op; both fronts work here."""
+    if lam is None:
+        lam = 1.0 / scale if scale is not None else 1.0
+    return jax.random.exponential(key, _shp(shape), _dt(dtype)) / lam
+
+
+def _k_random_bernoulli(key=None, *, p=0.5, shape=(1,), dtype="float32",
+                        ctx=None):
+    return jax.random.bernoulli(key, p, _shp(shape)).astype(_dt(dtype))
+
+
+def _k_random_poisson(key=None, *, lam=1.0, shape=(1,), dtype="float32",
+                      ctx=None):
+    return jax.random.poisson(key, lam, _shp(shape)).astype(_dt(dtype))
+
+
+def _k_random_randint(key=None, *, low=0, high=None, shape=(1,),
+                      dtype="int32", ctx=None):
+    if high is None:
+        raise ValueError("_random_randint requires both low and high")
+    return jax.random.randint(key, _shp(shape), int(low), int(high),
+                              _dt(dtype, jnp.int32))
+
+
+register("_random_uniform", _k_random_uniform, arg_names=(),
+         needs_rng=True, nondiff=True, aliases=("random_uniform",))
+register("_random_normal", _k_random_normal, arg_names=(),
+         needs_rng=True, nondiff=True, aliases=("random_normal",))
+register("_random_gamma", _k_random_gamma, arg_names=(),
+         needs_rng=True, nondiff=True, aliases=("random_gamma",))
+register("_random_exponential", _k_random_exponential, arg_names=(),
+         needs_rng=True, nondiff=True, aliases=("random_exponential",))
+register("_random_poisson", _k_random_poisson, arg_names=(),
+         needs_rng=True, nondiff=True, aliases=("random_poisson",))
+register("_random_randint", _k_random_randint, arg_names=(),
+         needs_rng=True, nondiff=True)
+register("_random_bernoulli", _k_random_bernoulli, arg_names=(),
+         needs_rng=True, nondiff=True)
+
+
+# ---------------------------------------------------------------------------
+# array-parameter generators (ref multisample_op.h): output shape is
+# param.shape + shape; parameters broadcast per element
+
+def _expand(p, shp):
+    return p.reshape(p.shape + (1,) * len(shp))
+
+
+def _k_sample_uniform(low, high, key=None, *, shape=(), dtype=None):
+    shp = _shp(shape)
+    u = jax.random.uniform(key, low.shape + shp,
+                           _dt(dtype, low.dtype))
+    return _expand(low, shp) + u * (_expand(high, shp) - _expand(low, shp))
+
+
+def _k_sample_normal(mu, sigma, key=None, *, shape=(), dtype=None):
+    shp = _shp(shape)
+    z = jax.random.normal(key, mu.shape + shp, _dt(dtype, mu.dtype))
+    return _expand(mu, shp) + _expand(sigma, shp) * z
+
+
+def _k_sample_gamma(alpha, beta, key=None, *, shape=(), dtype=None):
+    shp = _shp(shape)
+    g = jax.random.gamma(key, _expand(alpha, shp) *
+                         jnp.ones(alpha.shape + shp, alpha.dtype))
+    return (g * _expand(beta, shp)).astype(_dt(dtype, alpha.dtype))
+
+
+def _k_sample_exponential(lam, key=None, *, shape=(), dtype=None):
+    shp = _shp(shape)
+    e = jax.random.exponential(key, lam.shape + shp,
+                               _dt(dtype, lam.dtype))
+    return e / _expand(lam, shp)
+
+
+def _k_sample_poisson(lam, key=None, *, shape=(), dtype=None):
+    shp = _shp(shape)
+    out = jax.random.poisson(key, _expand(lam, shp) *
+                             jnp.ones(lam.shape + shp, lam.dtype))
+    return out.astype(_dt(dtype, jnp.float32))
+
+
+def _k_sample_negative_binomial(k, p, key=None, *, shape=(), dtype=None):
+    """NB(k successes, prob p) via the gamma–Poisson mixture."""
+    shp = _shp(shape)
+    kk, kp = jax.random.split(key)
+    lam_shape = k.shape + shp
+    g = jax.random.gamma(kk, _expand(k, shp) *
+                         jnp.ones(lam_shape, jnp.float32))
+    rate = g * (1.0 - _expand(p, shp)) / jnp.maximum(_expand(p, shp),
+                                                     1e-12)
+    out = jax.random.poisson(kp, rate)
+    return out.astype(_dt(dtype, jnp.float32))
+
+
+def _k_sample_generalized_negative_binomial(mu, alpha, key=None, *,
+                                            shape=(), dtype=None):
+    """GNB(mu, alpha): r = 1/alpha, p = r/(r+mu) (ref
+    multisample_op.h GeneralizedNegativeBinomialSampler)."""
+    shp = _shp(shape)
+    mu_e = _expand(mu, shp)
+    a_e = jnp.maximum(_expand(alpha, shp), 1e-12)
+    r = 1.0 / a_e
+    kk, kp = jax.random.split(key)
+    g = jax.random.gamma(kk, r * jnp.ones(mu.shape + shp, jnp.float32))
+    rate = g * mu_e * a_e
+    out = jax.random.poisson(kp, rate)
+    return out.astype(_dt(dtype, jnp.float32))
+
+
+register("sample_uniform", _k_sample_uniform, arg_names=("low", "high"),
+         needs_rng=True, nondiff=True, doc=_k_sample_uniform.__doc__)
+register("sample_normal", _k_sample_normal, arg_names=("mu", "sigma"),
+         needs_rng=True, nondiff=True)
+register("sample_gamma", _k_sample_gamma, arg_names=("alpha", "beta"),
+         needs_rng=True, nondiff=True)
+register("sample_exponential", _k_sample_exponential, arg_names=("lam",),
+         needs_rng=True, nondiff=True)
+register("sample_poisson", _k_sample_poisson, arg_names=("lam",),
+         needs_rng=True, nondiff=True)
+register("sample_negative_binomial", _k_sample_negative_binomial,
+         arg_names=("k", "p"), needs_rng=True, nondiff=True,
+         doc=_k_sample_negative_binomial.__doc__)
+register("sample_generalized_negative_binomial",
+         _k_sample_generalized_negative_binomial,
+         arg_names=("mu", "alpha"), needs_rng=True, nondiff=True,
+         doc=_k_sample_generalized_negative_binomial.__doc__)

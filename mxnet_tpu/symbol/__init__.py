@@ -3,9 +3,10 @@ from .symbol import (Symbol, Executor, var, Variable, load, fromjson,  # noqa: F
                      Group, AttrScope)
 from . import symbol as _symbol_mod
 from . import export  # noqa: F401
-from ..ndarray import _ContribNamespace
+from ..ndarray import _ContribNamespace, _RandomNamespace
 
 contrib = _ContribNamespace(_symbol_mod)
+random = _RandomNamespace(_symbol_mod)
 
 
 def __getattr__(name):

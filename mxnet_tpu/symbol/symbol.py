@@ -427,11 +427,26 @@ def _eval_graph(heads, feed, is_train=False, key=None):
     return outs, aux_updates
 
 
+_dummy_key_cache = None
+
+
+def _dummy_key():
+    """One cached concrete PRNG key for shape inference — needs_rng ops
+    (random generators, dropout) shape-infer like any other node and
+    eval_shape never executes them, so the value is irrelevant."""
+    global _dummy_key_cache
+    if _dummy_key_cache is None:
+        import jax
+
+        _dummy_key_cache = jax.random.PRNGKey(0)
+    return _dummy_key_cache
+
+
 def _eval_graph_shapes(heads, specs):
     import jax
 
     def fn(feed):
-        outs, _ = _eval_graph(heads, feed)
+        outs, _ = _eval_graph(heads, feed, key=_dummy_key())
         return [o for tup in outs for o in tup]
 
     return jax.eval_shape(fn, specs)
@@ -482,7 +497,7 @@ def _solve_param_shapes(heads, known):
         if entry.needs_rng:
             while len(specs) < len(entry.arg_names):
                 specs.append(None)
-            specs.append(None)  # key
+            specs.append(_dummy_key())  # concrete key: shape-only eval
         try:
             fn = functools.partial(entry.fn, **attrs) if attrs else entry.fn
             out = jax.eval_shape(fn, *specs)
@@ -959,9 +974,16 @@ def _sym_wrapper(entry):
                 nm = f"{name or _auto_name(entry.name)}_{entry.arg_names[i]}"
                 s = var(nm)
             filled.append(s)
-        if not filled or any(not isinstance(s, Symbol) for s in filled):
+        if any(not isinstance(s, Symbol) for s in filled):
             raise MXNetError(
                 f"sym.{entry.name} requires Symbol inputs")
+        if not filled and entry.arg_names:
+            # ops with declared array inputs need at least one; ops with
+            # none (random generators, init-style sources) are valid
+            # zero-input graph nodes
+            raise MXNetError(
+                f"sym.{entry.name} needs at least one of its inputs "
+                f"{entry.arg_names}")
         if name is None and entry.name in ("FullyConnected", "Convolution",
                                            "BatchNorm", "Embedding", "RNN",
                                            "Deconvolution"):

@@ -1,0 +1,134 @@
+"""Random op families (ref: src/operator/random/sample_op.cc,
+multisample_op.h; test model: tests/python/unittest/test_random.py's
+distribution-moment checks)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def setup_function(_):
+    mx.random.seed(20)
+
+
+def test_sym_random_source_nodes():
+    """Zero-input random generators are valid graph sources; each
+    forward re-draws (the executor threads a fresh key)."""
+    s = mx.sym.random.uniform(low=0.0, high=2.0, shape=(4, 5)) \
+        + mx.sym.Variable("b")
+    ex = s.simple_bind(b=(4, 5))
+    b = np.zeros((4, 5), np.float32)
+    a1 = ex.forward(b=b)[0].asnumpy()
+    a2 = ex.forward(b=b)[0].asnumpy()
+    assert a1.shape == (4, 5)
+    assert (a1 >= 0).all() and (a1 <= 2).all() and a1.std() > 0
+    assert not np.allclose(a1, a2)
+    n = mx.sym.random.normal(loc=3.0, scale=0.5, shape=(2000,))
+    out = n.simple_bind().forward()[0].asnumpy()
+    assert abs(out.mean() - 3.0) < 0.1 and abs(out.std() - 0.5) < 0.05
+
+
+def test_nd_random_op_forms():
+    u = nd.random_uniform(low=1.0, high=2.0, shape=(3, 3)).asnumpy()
+    assert (u >= 1).all() and (u <= 2).all()
+    r = nd.random_randint(low=0, high=5, shape=(100,)).asnumpy()
+    assert r.dtype == np.int32 and r.min() >= 0 and r.max() < 5
+    p = nd.random_poisson(lam=3.0, shape=(3000,)).asnumpy()
+    assert abs(p.mean() - 3.0) < 0.3
+
+
+def test_sample_uniform_normal_moments():
+    lo = nd.array(np.array([0.0, 10.0], np.float32))
+    hi = nd.array(np.array([1.0, 20.0], np.float32))
+    s = nd.sample_uniform(lo, hi, shape=(2000,)).asnumpy()
+    assert s.shape == (2, 2000)
+    assert 0 <= s[0].min() and s[0].max() <= 1
+    assert 10 <= s[1].min() and s[1].max() <= 20
+    assert abs(s[0].mean() - 0.5) < 0.05 and abs(s[1].mean() - 15) < 0.5
+    mu = nd.array(np.array([0.0, 5.0], np.float32))
+    sg = nd.array(np.array([1.0, 0.1], np.float32))
+    z = nd.sample_normal(mu, sg, shape=(4000,)).asnumpy()
+    assert abs(z[0].mean()) < 0.1 and abs(z[0].std() - 1.0) < 0.08
+    assert abs(z[1].mean() - 5.0) < 0.05 and abs(z[1].std() - 0.1) < 0.02
+
+
+def test_sample_gamma_exponential_poisson_moments():
+    g = nd.sample_gamma(nd.array(np.array([2.0], np.float32)),
+                        nd.array(np.array([3.0], np.float32)),
+                        shape=(5000,)).asnumpy()
+    # mean alpha*beta = 6, var alpha*beta^2 = 18
+    assert abs(g.mean() - 6.0) < 0.4 and abs(g.var() - 18.0) < 3.0
+    e = nd.sample_exponential(nd.array(np.array([2.0], np.float32)),
+                              shape=(5000,)).asnumpy()
+    assert abs(e.mean() - 0.5) < 0.05
+    p = nd.sample_poisson(nd.array(np.array([4.0], np.float32)),
+                          shape=(5000,)).asnumpy()
+    assert abs(p.mean() - 4.0) < 0.2 and abs(p.var() - 4.0) < 0.8
+
+
+def test_sample_negative_binomial_moments():
+    k, p = 3.0, 0.5
+    nb = nd.sample_negative_binomial(
+        nd.array(np.array([k], np.float32)),
+        nd.array(np.array([p], np.float32)), shape=(6000,)).asnumpy()
+    # mean k(1-p)/p = 3, var k(1-p)/p^2 = 6
+    assert abs(nb.mean() - 3.0) < 0.3 and abs(nb.var() - 6.0) < 1.2
+    assert (nb >= 0).all() and np.allclose(nb, np.round(nb))
+    mu, alpha = 4.0, 0.25
+    gnb = nd.sample_generalized_negative_binomial(
+        nd.array(np.array([mu], np.float32)),
+        nd.array(np.array([alpha], np.float32)), shape=(6000,)).asnumpy()
+    # mean mu = 4, var mu + alpha*mu^2 = 8
+    assert abs(gnb.mean() - 4.0) < 0.3 and abs(gnb.var() - 8.0) < 1.6
+
+
+def test_sample_param_shape_broadcast():
+    """Output is param_shape + shape (ref multisample_op.h)."""
+    lam = nd.array(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    out = nd.sample_poisson(lam, shape=(50,))
+    assert out.shape == (2, 2, 50)
+    m = out.asnumpy().mean(axis=-1)
+    assert np.allclose(m, [[1, 2], [3, 4]], atol=0.8)
+
+
+def test_seed_determinism():
+    mx.random.seed(123)
+    a = nd.random_uniform(shape=(16,)).asnumpy()
+    mx.random.seed(123)
+    b = nd.random_uniform(shape=(16,)).asnumpy()
+    assert np.allclose(a, b)
+
+
+def test_random_source_feeds_nn_layer():
+    """Param-shape inference must thread a key through needs_rng ops so
+    layers fed by random sources backward-fill their weights."""
+    s = mx.sym.FullyConnected(data=mx.sym.random.normal(shape=(32, 100)),
+                              num_hidden=10)
+    out = s.simple_bind().forward()[0]
+    assert out.shape == (32, 10)
+
+
+def test_random_namespace_parity_across_fronts():
+    """Names eager code uses must survive hybridization: the namespace
+    maps multinomial/shuffle/randn/bernoulli onto their registry ops."""
+    p = mx.sym.random.multinomial(mx.sym.Variable("p"))
+    out = p.simple_bind(p=(2, 3)).forward(
+        p=np.array([[0, 1, 0], [1, 0, 0]], np.float32))[0].asnumpy()
+    assert (out == [1, 0]).all()
+    b = mx.sym.random.bernoulli(p=0.3, shape=(4000,)) \
+        .simple_bind().forward()[0].asnumpy()
+    assert abs(b.mean() - 0.3) < 0.05
+    assert mx.sym.random.randn(3, 4).simple_bind().forward()[0] \
+        .shape == (3, 4)
+    so = mx.sym.random.shuffle(mx.sym.Variable("d")).simple_bind(
+        d=(10,)).forward(d=np.arange(10, dtype=np.float32))[0].asnumpy()
+    assert sorted(so.tolist()) == list(range(10))
+
+
+def test_exponential_scale_lam_equivalent():
+    e1 = mx.sym.random.exponential(scale=2.0, shape=(5000,)) \
+        .simple_bind().forward()[0].asnumpy()
+    e2 = mx.sym.random.exponential(lam=0.5, shape=(5000,)) \
+        .simple_bind().forward()[0].asnumpy()
+    assert abs(e1.mean() - 2.0) < 0.2 and abs(e2.mean() - 2.0) < 0.2
