@@ -1,0 +1,134 @@
+"""Single-shot detection through the MultiBox pipeline.
+
+Ref: example/ssd/ in the reference (MultiBoxPrior/Target/Detection +
+SmoothL1 and softmax losses).  TPU-native: the whole anchor pipeline is
+static-shape HLO — matching, encoding and hard-negative mining run as
+vectorized device ops inside the compiled step, no host round-trips.
+
+Synthetic task: localize one bright square per image.  Trains a tiny
+conv head end-to-end and reports the detection IoU against ground
+truth.
+
+  python examples/detection/train_ssd_toy.py --steps 120 --cpu
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+
+class TinySSD(gluon.HybridBlock):
+    """Conv body + per-anchor class/box heads (one anchor per cell)."""
+
+    def __init__(self, num_classes=1, **kw):
+        super().__init__(**kw)
+        self.body = gluon.nn.HybridSequential()
+        self.body.add(
+            gluon.nn.Conv2D(16, 3, padding=1, activation="relu"),
+            gluon.nn.Conv2D(16, 3, padding=1, activation="relu"))
+        self.cls = gluon.nn.Conv2D(num_classes + 1, 3, padding=1)
+        self.loc = gluon.nn.Conv2D(4, 3, padding=1)
+
+    def hybrid_forward(self, F, x):
+        f = self.body(x)
+        return self.cls(f), self.loc(f)
+
+
+def make_batch(rng, bs=8, size=8):
+    imgs = np.zeros((bs, 1, size, size), np.float32)
+    labels = np.zeros((bs, 1, 5), np.float32)
+    for i in range(bs):
+        r, c = rng.randint(0, size - 2), rng.randint(0, size - 2)
+        imgs[i, 0, r:r + 3, c:c + 3] = 1.0
+        labels[i, 0] = [0, c / size, r / size,
+                        (c + 3) / size, (r + 3) / size]
+    return imgs, labels
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--steps", type=int, default=120)
+    p.add_argument("--lr", type=float, default=5e-3)
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend (skip the TPU tunnel)")
+    args = p.parse_args()
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    net = TinySSD()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    sce = gluon.loss.SoftmaxCrossEntropyLoss(axis=-1)
+
+    # anchors depend only on the input geometry — build once up front
+    probe, _ = make_batch(rng, 1)
+    anchors = nd.contrib.MultiBoxPrior(nd.array(probe), sizes=(0.4,),
+                                       ratios=(1.0,))
+    N = anchors.shape[1]
+    t0 = time.time()
+    for step in range(1, args.steps + 1):
+        imgs, labels = make_batch(rng, args.batch_size)
+        x, y = nd.array(imgs), nd.array(labels)
+        with autograd.record():
+            cls_pred, loc_pred = net(x)
+            B = cls_pred.shape[0]
+            cls_pred_r = cls_pred.reshape((B, 2, N))
+            loc_pred_r = loc_pred.transpose(
+                axes=(0, 2, 3, 1)).reshape((B, -1))
+            bt, bm, ct = nd.contrib.MultiBoxTarget(
+                anchors, y, cls_pred_r, negative_mining_ratio=3.0)
+            # mask the mined-out anchors: ignore_label -1 must carry NO
+            # gradient (pick would wrap -1 onto the foreground class)
+            keep = (ct >= 0).expand_dims(axis=-1)
+            cls_l = sce(cls_pred_r.transpose(axes=(0, 2, 1)), ct,
+                        keep)
+            loc_l = nd.smooth_l1((loc_pred_r - bt) * bm,
+                                 scalar=1.0).mean()
+            loss = cls_l.mean() + loc_l
+        loss.backward()
+        trainer.step(args.batch_size)
+        if step % 20 == 0 or step == args.steps:
+            print(f"step {step:4d}  loss {float(loss.asscalar()):.4f}  "
+                  f"({time.time() - t0:.1f}s)")
+
+    # evaluate: decode detections, compare against ground truth
+    imgs, labels = make_batch(np.random.RandomState(99), 16)
+    cls_pred, loc_pred = net(nd.array(imgs))
+    B, N = 16, anchors.shape[1]
+    cls_prob = nd.softmax(cls_pred.reshape((B, 2, N)), axis=1)
+    loc_pred_r = loc_pred.transpose(axes=(0, 2, 3, 1)).reshape((B, -1))
+    det = nd.contrib.MultiBoxDetection(cls_prob, loc_pred_r, anchors,
+                                       nms_threshold=0.45).asnumpy()
+    ious = []
+    for i in range(B):
+        live = det[i][det[i][:, 0] >= 0]
+        if not len(live):
+            ious.append(0.0)
+            continue
+        b = live[np.argmax(live[:, 1])]
+        g = labels[i, 0, 1:]
+        x1, y1 = max(b[2], g[0]), max(b[3], g[1])
+        x2, y2 = min(b[4], g[2]), min(b[5], g[3])
+        inter = max(0, x2 - x1) * max(0, y2 - y1)
+        union = (b[4] - b[2]) * (b[5] - b[3]) + \
+            (g[2] - g[0]) * (g[3] - g[1]) - inter
+        ious.append(inter / union)
+    print(f"mean detection IoU vs gt: {np.mean(ious):.3f}")
+
+
+if __name__ == "__main__":
+    main()
