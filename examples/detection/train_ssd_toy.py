@@ -56,7 +56,7 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--steps", type=int, default=120)
-    p.add_argument("--lr", type=float, default=5e-3)
+    p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU backend (skip the TPU tunnel)")
     args = p.parse_args()
@@ -100,7 +100,8 @@ def main():
                                  scalar=1.0).mean()
             loss = cls_l.mean() + loc_l
         loss.backward()
-        trainer.step(args.batch_size)
+        # loss is already a per-batch mean, so no 1/batch rescale here
+        trainer.step(1)
         if step % 20 == 0 or step == args.steps:
             print(f"step {step:4d}  loss {float(loss.asscalar()):.4f}  "
                   f"({time.time() - t0:.1f}s)")

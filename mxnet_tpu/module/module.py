@@ -264,7 +264,27 @@ class Module(BaseModule):
             return
         if isinstance(optimizer_params, tuple):
             optimizer_params = dict(optimizer_params)
-        self._optimizer = _opt.create(optimizer, **optimizer_params)
+        # ref Module.init_optimizer: fold 1/batch into rescale_grad when
+        # the caller didn't set it — loss-op grads ('null' normalization)
+        # are per-example sums, and this is where the mean happens
+        batch = self._data_shapes[0].shape[0] if self._data_shapes else 0
+        if isinstance(optimizer, _opt.Optimizer):
+            # ref: base_module warns and fixes up instance rescale_grad
+            if batch and abs(optimizer.rescale_grad * batch - 1.0) > 1e-8:
+                import logging
+
+                logging.warning(
+                    "optimizer instance rescale_grad=%g != 1/batch (%g); "
+                    "setting it to 1/%d — pass rescale_grad explicitly "
+                    "to silence", optimizer.rescale_grad, 1.0 / batch,
+                    batch)
+                optimizer.rescale_grad = 1.0 / batch
+            self._optimizer = optimizer
+        else:
+            if "rescale_grad" not in optimizer_params and batch:
+                optimizer_params = dict(optimizer_params,
+                                        rescale_grad=1.0 / batch)
+            self._optimizer = _opt.create(optimizer, **optimizer_params)
         self._updater = _opt.get_updater(self._optimizer)
         self.optimizer_initialized = True
 

@@ -442,27 +442,48 @@ def _k_softmin(data, *, axis=-1):
 register("softmin", _k_softmin)
 
 
-@jax.custom_vjp
-def _softmax_output_core(data, label):
-    return jax.nn.softmax(data, axis=1)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _softmax_output_core(data, label, opts):
+    return jax.nn.softmax(data, axis=opts[5])
 
 
-def _smo_fwd(data, label):
-    p = jax.nn.softmax(data, axis=1)
+def _smo_fwd(data, label, opts):
+    p = jax.nn.softmax(data, axis=opts[5])
     return p, (p, label)
 
 
-def _smo_bwd(res, g):
+def _smo_bwd(opts, res, g):
+    """MXNet loss-op semantics (ref softmax_output-inl.h): grad w.r.t.
+    data is (p - onehot(label)) with grad_scale / ignore_label /
+    normalization / label smoothing applied, independent of the
+    incoming cotangent."""
+    grad_scale, ignore_label, use_ignore, normalization, smooth_alpha, \
+        axis = opts
     p, label = res
-    # MXNet loss-op semantics: grad w.r.t. data is (p - onehot(label)),
-    # independent of the incoming cotangent (ref: softmax_output.cc).
+    C = p.shape[axis]
+    lab_ids = None
     if label.ndim == p.ndim - 1:
-        oh = jax.nn.one_hot(label.astype(jnp.int32), p.shape[1], axis=1,
-                            dtype=p.dtype)
+        lab_ids = label.astype(jnp.int32)
+        oh = jax.nn.one_hot(lab_ids, C, axis=axis, dtype=p.dtype)
     else:
         oh = label
-    scale = 1.0 / p.shape[0]
-    return ((p - oh) * scale, jnp.zeros_like(label))
+    if smooth_alpha > 0:
+        oh = oh * (1.0 - smooth_alpha) + (1.0 - oh) * \
+            (smooth_alpha / max(C - 1, 1))
+    grad = p - oh
+    valid = None
+    if use_ignore and lab_ids is not None:
+        valid = (lab_ids != int(ignore_label)).astype(p.dtype)
+        grad = grad * jnp.expand_dims(valid, axis=axis)
+    if normalization == "batch":
+        grad = grad / p.shape[0]
+    elif normalization == "valid":
+        n = valid.sum() if valid is not None else \
+            float(lab_ids.size if lab_ids is not None else p.shape[0])
+        grad = grad / jnp.maximum(n, 1.0)
+    # 'null': no normalization (reference default; Module folds 1/batch
+    # into the optimizer's rescale_grad instead)
+    return grad * grad_scale, jnp.zeros_like(label)
 
 
 _softmax_output_core.defvjp(_smo_fwd, _smo_bwd)
@@ -472,29 +493,37 @@ def _k_softmax_output(data, label, *, grad_scale=1.0, ignore_label=-1.0,
                       multi_output=False, use_ignore=False,
                       preserve_shape=False, normalization="null",
                       out_grad=False, smooth_alpha=0.0):
-    return _softmax_output_core(data, label)
+    if normalization not in ("null", "batch", "valid"):
+        raise ValueError(f"SoftmaxOutput normalization must be one of "
+                         f"null/batch/valid, got {normalization!r}")
+    axis = -1 if preserve_shape else (1 if data.ndim > 1 else -1)
+    opts = (float(grad_scale), float(ignore_label), bool(use_ignore),
+            str(normalization), float(smooth_alpha), axis)
+    return _softmax_output_core(data, label, opts)
 
 register("SoftmaxOutput", _k_softmax_output, arg_names=("data", "label"),
          aliases=("softmax_output",))
 
 
 def _k_linear_regression_output(data, label, *, grad_scale=1.0):
-    return _linreg_core(data, label)
+    return _linreg_core(data, label, float(grad_scale))
 
 
-@jax.custom_vjp
-def _linreg_core(data, label):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _linreg_core(data, label, grad_scale):
     return data
 
 
-def _linreg_fwd(data, label):
+def _linreg_fwd(data, label, grad_scale):
     return data, (data, label)
 
 
-def _linreg_bwd(res, g):
+def _linreg_bwd(grad_scale, res, g):
+    # per-example gradients * grad_scale (ref regression_output-inl.h);
+    # the 1/batch mean lives in the optimizer's rescale_grad
     data, label = res
-    scale = 1.0 / data.shape[0]
-    return ((data - label.reshape(data.shape)) * scale, jnp.zeros_like(label))
+    return ((data - label.reshape(data.shape)) * grad_scale,
+            jnp.zeros_like(label))
 
 
 _linreg_core.defvjp(_linreg_fwd, _linreg_bwd)
@@ -503,49 +532,48 @@ register("LinearRegressionOutput", _k_linear_regression_output,
          arg_names=("data", "label"))
 
 
-@jax.custom_vjp
-def _logreg_core(data, label):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _logreg_core(data, label, grad_scale):
     return jax.nn.sigmoid(data)
 
 
-def _logreg_fwd(data, label):
+def _logreg_fwd(data, label, grad_scale):
     p = jax.nn.sigmoid(data)
     return p, (p, label)
 
 
-def _logreg_bwd(res, g):
+def _logreg_bwd(grad_scale, res, g):
     p, label = res
-    scale = 1.0 / p.shape[0]
-    return ((p - label.reshape(p.shape)) * scale, jnp.zeros_like(label))
+    return ((p - label.reshape(p.shape)) * grad_scale,
+            jnp.zeros_like(label))
 
 
 _logreg_core.defvjp(_logreg_fwd, _logreg_bwd)
 
 
 def _k_logistic_regression_output(data, label, *, grad_scale=1.0):
-    return _logreg_core(data, label)
+    return _logreg_core(data, label, float(grad_scale))
 
 register("LogisticRegressionOutput", _k_logistic_regression_output,
          arg_names=("data", "label"))
 
 
 def _k_mae_regression_output(data, label, *, grad_scale=1.0):
-    return _mae_core(data, label)
+    return _mae_core(data, label, float(grad_scale))
 
 
-@jax.custom_vjp
-def _mae_core(data, label):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _mae_core(data, label, grad_scale):
     return data
 
 
-def _mae_fwd(data, label):
+def _mae_fwd(data, label, grad_scale):
     return data, (data, label)
 
 
-def _mae_bwd(res, g):
+def _mae_bwd(grad_scale, res, g):
     data, label = res
-    scale = 1.0 / data.shape[0]
-    return (jnp.sign(data - label.reshape(data.shape)) * scale,
+    return (jnp.sign(data - label.reshape(data.shape)) * grad_scale,
             jnp.zeros_like(label))
 
 

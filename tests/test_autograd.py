@@ -130,7 +130,9 @@ def test_softmax_output_bwd():
     p.backward()
     p_np = np.exp(x.asnumpy()) / np.exp(x.asnumpy()).sum(1, keepdims=True)
     oh = np.eye(3, dtype=np.float32)[label.asnumpy().astype(int)]
-    assert np.allclose(x.grad.asnumpy(), (p_np - oh) / 4, atol=1e-5)
+    # normalization='null' (reference default): per-example grads, no
+    # 1/batch — Module folds that into the optimizer's rescale_grad
+    assert np.allclose(x.grad.asnumpy(), p_np - oh, atol=1e-5)
 
 
 def test_custom_function():

@@ -367,3 +367,67 @@ def test_reshape_magic_codes():
         nd.reshape(x, shape=(0, -4, 2, -1, 0))
     with pytest.raises(ValueError, match="factors must be positive"):
         nd.reshape(x, shape=(0, -4, -1, 0, 0))
+
+
+def test_softmax_output_full_semantics():
+    """Ref softmax_output-inl.h: grad_scale, use_ignore, normalization
+    ('null'/'batch'/'valid'), label smoothing."""
+    x = nd.array(np.random.RandomState(0).rand(4, 3).astype(np.float32))
+    label = nd.array([0, 1, -1, 1])
+    p_np = np.exp(x.asnumpy()) / np.exp(x.asnumpy()).sum(1, keepdims=True)
+    oh = np.zeros((4, 3), np.float32)
+    for i, l in enumerate([0, 1, -1, 1]):
+        if l >= 0:
+            oh[i, l] = 1
+
+    def grad_of(**kw):
+        xx = nd.array(x.asnumpy())
+        xx.attach_grad()
+        with autograd.record():
+            out = nd.SoftmaxOutput(xx, label, **kw)
+        out.backward()
+        return xx.grad.asnumpy()
+
+    # ignore: row with label==ignore_label contributes zero gradient
+    g = grad_of(use_ignore=True, ignore_label=-1)
+    assert np.allclose(g[2], 0.0)
+    assert np.allclose(g[0], p_np[0] - oh[0], atol=1e-5)
+    # valid normalization divides by the non-ignored count (3)
+    gv = grad_of(use_ignore=True, ignore_label=-1, normalization="valid")
+    assert np.allclose(gv[0], (p_np[0] - oh[0]) / 3, atol=1e-5)
+    # batch normalization divides by batch (4)
+    gb = grad_of(normalization="batch")
+    assert np.allclose(gb[1], (p_np[1] - oh[1]) / 4, atol=1e-5)
+    # grad_scale multiplies
+    gs = grad_of(grad_scale=0.5)
+    assert np.allclose(gs[0], (p_np[0] - oh[0]) * 0.5, atol=1e-5)
+    # label smoothing softens the one-hot target
+    ga = grad_of(smooth_alpha=0.1)
+    sm = oh * 0.9 + (1 - oh) * 0.05
+    assert np.allclose(ga[0], p_np[0] - sm[0], atol=1e-5)
+
+
+def test_regression_outputs_per_example_grads():
+    """Ref regression_output-inl.h: grad = (pred - label) * grad_scale,
+    per example — the 1/batch mean belongs to the optimizer's
+    rescale_grad (Module folds it in automatically)."""
+    x_np = np.random.RandomState(1).rand(4, 3).astype(np.float32)
+    l_np = np.random.RandomState(2).rand(4, 3).astype(np.float32)
+
+    def grad_of(op, **kw):
+        x = nd.array(x_np)
+        x.attach_grad()
+        with autograd.record():
+            out = op(x, nd.array(l_np), **kw)
+        out.backward()
+        return x.grad.asnumpy()
+
+    g = grad_of(nd.LinearRegressionOutput)
+    assert np.allclose(g, x_np - l_np, atol=1e-5)
+    g2 = grad_of(nd.LinearRegressionOutput, grad_scale=0.5)
+    assert np.allclose(g2, (x_np - l_np) * 0.5, atol=1e-5)
+    p = 1 / (1 + np.exp(-x_np))
+    gl = grad_of(nd.LogisticRegressionOutput)
+    assert np.allclose(gl, p - l_np, atol=1e-5)
+    gm = grad_of(nd.MAERegressionOutput)
+    assert np.allclose(gm, np.sign(x_np - l_np), atol=1e-5)
