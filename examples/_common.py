@@ -1,0 +1,21 @@
+"""Shared example-script plumbing (backend selection).
+
+Every example accepts --cpu to skip the TPU tunnel and run on the CPU
+backend (tests, laptops, CI). The flag must take effect BEFORE first
+device use, which is why examples call apply_backend(args) immediately
+after parse_args().
+"""
+
+
+def add_cpu_flag(parser):
+    parser.add_argument(
+        "--cpu", action="store_true",
+        help="force the CPU backend (skip the TPU tunnel)")
+    return parser
+
+
+def apply_backend(args):
+    if getattr(args, "cpu", False):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

@@ -15,6 +15,9 @@ import sys
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from _common import add_cpu_flag, apply_backend  # noqa: E402
 
 import numpy as np
 
@@ -66,7 +69,13 @@ def main():
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--lr", type=float, default=1e-4)
     p.add_argument("--disp", type=int, default=10)
+    add_cpu_flag(p)
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize activations per child block "
+                        "(jax.checkpoint): more FLOPs for less HBM "
+                        "when activations don't fit")
     args = p.parse_args()
+    apply_backend(args)
     if args.model == "tiny":
         args.vocab_size = min(args.vocab_size, 1000)
 
@@ -89,7 +98,7 @@ def main():
 
     trainer = data_parallel.DataParallelTrainer(
         net, _Identity(), "adamw",
-        {"learning_rate": args.lr, "wd": 0.01})
+        {"learning_rate": args.lr, "wd": 0.01}, remat=args.remat)
 
     tic, tic_n = time.time(), 0
     for step in range(args.steps):

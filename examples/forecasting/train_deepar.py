@@ -13,6 +13,9 @@ import sys
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from _common import add_cpu_flag, apply_backend  # noqa: E402
 
 import numpy as np
 
@@ -42,7 +45,9 @@ def main():
     p.add_argument("--disp", type=int, default=10)
     p.add_argument("--predict", action="store_true",
                    help="sample forecasts after training")
+    add_cpu_flag(p)
     args = p.parse_args()
+    apply_backend(args)
 
     mx.random.seed(0)
     rng = np.random.RandomState(0)

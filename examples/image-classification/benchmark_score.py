@@ -14,6 +14,9 @@ import sys
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from _common import add_cpu_flag, apply_backend  # noqa: E402
 
 import numpy as np
 
@@ -44,7 +47,9 @@ def main():
                    help="comma list of model_zoo.vision builders")
     p.add_argument("--batch-sizes", default="1,16,64")
     p.add_argument("--image-shape", default="3,224,224")
+    add_cpu_flag(p)
     args = p.parse_args()
+    apply_backend(args)
     shape = tuple(int(v) for v in args.image_shape.split(","))
 
     for name in args.network.split(","):

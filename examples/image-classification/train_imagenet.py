@@ -25,6 +25,9 @@ import sys
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from _common import add_cpu_flag, apply_backend  # noqa: E402
 
 import numpy as np
 
@@ -105,7 +108,9 @@ def main():
     p.add_argument("--data-nthreads", type=int, default=8)
     p.add_argument("--disp-batches", type=int, default=20)
     p.add_argument("--model-prefix", default="")
+    add_cpu_flag(p)
     args = p.parse_args()
+    apply_backend(args)
     if not args.benchmark and not args.data_train:
         p.error("--data-train is required unless --benchmark 1")
 

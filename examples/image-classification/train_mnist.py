@@ -14,6 +14,9 @@ import sys
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from _common import add_cpu_flag, apply_backend  # noqa: E402
 
 import numpy as np
 
@@ -66,7 +69,9 @@ def main():
     p.add_argument("--epochs", type=int, default=2)
     p.add_argument("--lr", type=float, default=0.05)
     p.add_argument("--hybridize", type=int, default=1)
+    add_cpu_flag(p)
     args = p.parse_args()
+    apply_backend(args)
 
     mx.random.seed(42)
     train_iter, val_iter = get_iters(args)

@@ -15,6 +15,9 @@ import sys
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from _common import add_cpu_flag, apply_backend  # noqa: E402
 
 import numpy as np
 
@@ -73,7 +76,9 @@ def main():
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--disp", type=int, default=10)
+    add_cpu_flag(p)
     args = p.parse_args()
+    apply_backend(args)
     if args.model == "tiny":
         args.src_vocab = min(args.src_vocab, 1000)
         args.tgt_vocab = min(args.tgt_vocab, 1000)

@@ -14,6 +14,9 @@ import sys
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from _common import add_cpu_flag, apply_backend  # noqa: E402
 
 import numpy as np
 
@@ -102,14 +105,9 @@ def main():
     p.add_argument("--steps", type=int, default=600)
     p.add_argument("--lr", type=float, default=1e-2)
     p.add_argument("--log-every", type=int, default=20)
-    p.add_argument("--cpu", action="store_true",
-                   help="force the CPU backend (skip the TPU tunnel)")
+    add_cpu_flag(p)
     args = p.parse_args()
-
-    if args.cpu:
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+    apply_backend(args)
 
     mx.random.seed(0)
     rng = np.random.RandomState(0)
