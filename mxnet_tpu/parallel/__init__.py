@@ -1,12 +1,15 @@
 """Parallelism subsystems: mesh SPMD data-parallel, distributed runtime,
-sequence parallelism (ref: §2.3 of SURVEY.md — kvstore comm, ps-lite,
-DataParallelExecutorGroup; plus capability upgrades beyond the
-reference: sharded SPMD training, ring attention)."""
+and the full axis alphabet (ref: §2.3 of SURVEY.md — kvstore comm,
+ps-lite, DataParallelExecutorGroup; plus capability upgrades beyond the
+reference): dp (compiled step w/ in-graph psum), tp (sharded params),
+sp (ring + Ulysses attention), pp (GPipe microbatch pipeline over
+ppermute), ep (GShard-style MoE with experts sharded over 'ep')."""
 from . import dist  # noqa: F401
 
 
 def __getattr__(name):
-    if name in ("mesh", "data_parallel", "ring_attention", "ulysses"):
+    if name in ("mesh", "data_parallel", "ring_attention", "ulysses",
+                "pipeline", "moe"):
         import importlib
 
         mod = importlib.import_module("." + name, __name__)

@@ -1,0 +1,110 @@
+"""Expert parallelism: GShard-style Mixture-of-Experts FFN.
+
+Ref capability: ABSENT in the reference (SURVEY §2.3 'EP: ABSENT — no
+MoE ops in-tree this era'); capability upgrade alongside TP/SP/PP.
+
+TPU-native design (the Mesh-TensorFlow/GShard einsum formulation):
+routing builds one-hot dispatch/combine tensors (tokens x experts x
+capacity) and expert compute is three einsums whose expert dimension is
+sharded over the 'ep' mesh axis — GSPMD inserts the all_to_all
+exchanges from the sharding annotations alone; no hand-written
+collectives.  Capacity-limited top-1 routing keeps every shape static
+(XLA requirement): tokens beyond an expert's capacity are dropped and
+pass through the residual path, exactly like GShard/Switch.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+
+
+def moe_ffn(x, router_w, w1, b1, w2, b2, mesh=None, axis="ep",
+            capacity_factor=1.25):
+    """Top-1 (Switch) MoE feed-forward.
+
+    x (S, M) tokens; router_w (M, E); w1 (E, M, H); b1 (E, H);
+    w2 (E, H, M); b2 (E, M).  Returns (y (S, M), aux_loss scalar).
+    Shard w1/b1/w2/b2 leading dim over `axis` for real EP.
+    """
+    S, M = x.shape
+    E = router_w.shape[1]
+    C = max(1, int(capacity_factor * S / E))
+
+    logits = x @ router_w                           # (S, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)             # (S,)
+    gate = jnp.max(probs, axis=-1)                  # (S,)
+
+    # position of each token within its expert's capacity buffer
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)    # (S, E)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1          # (S, E)
+    pos_in_expert = pos.max(axis=-1)                       # (S,)
+    keep = pos_in_expert < C
+    gate = gate * keep
+
+    # dispatch (S, E, C) one-hot; combine = dispatch * gate
+    dispatch = (jax.nn.one_hot(expert, E, dtype=x.dtype)[:, :, None] *
+                jax.nn.one_hot(jnp.clip(pos_in_expert, 0, C - 1), C,
+                               dtype=x.dtype)[:, None, :] *
+                keep[:, None, None].astype(x.dtype))
+    combine = dispatch * gate[:, None, None]
+
+    if mesh is not None and axis in mesh.axis_names:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        def ep(t, spec):
+            return jax.lax.with_sharding_constraint(
+                t, NamedSharding(mesh, PartitionSpec(*spec)))
+    else:
+        def ep(t, spec):
+            return t
+
+    # expert compute: einsums with the E dim sharded over 'ep' — GSPMD
+    # emits the token all_to_all from these constraints
+    expert_in = ep(jnp.einsum("sec,sm->ecm", dispatch, x),
+                   (axis, None, None))
+    h = jax.nn.relu(ep(jnp.einsum("ecm,emh->ech", expert_in, w1)
+                       + b1[:, None, :], (axis, None, None)))
+    expert_out = ep(jnp.einsum("ech,ehm->ecm", h, w2)
+                    + b2[:, None, :], (axis, None, None))
+    y = jnp.einsum("sec,ecm->sm", combine, expert_out)
+
+    # load-balancing auxiliary loss (Switch/GShard): mean gate fraction
+    # x mean dispatch fraction per expert, scaled by E
+    me = probs.mean(axis=0)                          # (E,)
+    ce = onehot.astype(x.dtype).mean(axis=0)         # (E,)
+    aux = E * jnp.sum(me * ce)
+    return y, aux
+
+
+class MoEBlock:
+    """Parameter container + init for moe_ffn (functional style: pass
+    .params() into a jitted step; build shardings with
+    ``[NamedSharding(mesh, s) for s in MoEBlock.param_specs("ep")]``)."""
+
+    def __init__(self, num_experts, d_model, d_hidden, seed=0):
+        if num_experts < 2:
+            raise MXNetError("MoE needs >= 2 experts")
+        k = jax.random.PRNGKey(seed)
+        k1, k2, k3 = jax.random.split(k, 3)
+        s1 = (2.0 / d_model) ** 0.5
+        self.router_w = jax.random.normal(k1, (d_model, num_experts)) * s1
+        self.w1 = jax.random.normal(k2, (num_experts, d_model,
+                                         d_hidden)) * s1
+        self.b1 = jnp.zeros((num_experts, d_hidden))
+        self.w2 = jax.random.normal(k3, (num_experts, d_hidden,
+                                         d_model)) * (2.0 / d_hidden) ** 0.5
+        self.b2 = jnp.zeros((num_experts, d_model))
+
+    def params(self):
+        return (self.router_w, self.w1, self.b1, self.w2, self.b2)
+
+    @staticmethod
+    def param_specs(axis="ep"):
+        from jax.sharding import PartitionSpec
+
+        return (PartitionSpec(), PartitionSpec(axis, None, None),
+                PartitionSpec(axis, None), PartitionSpec(axis, None, None),
+                PartitionSpec(axis, None))
