@@ -45,3 +45,38 @@ class SparseEmbedding(Embedding):
         super().__init__(input_dim, output_dim, dtype=dtype,
                          weight_initializer=weight_initializer,
                          sparse_grad=True, **kwargs)
+
+
+class MoEFFN(HybridBlock):
+    """Mixture-of-Experts feed-forward (Switch-style top-1 routing with
+    static capacity; GShard einsum dispatch — see parallel/moe.py for
+    the expert-parallel sharded form).
+
+    Input (batch, d_model) -> (output (batch, d_model), aux_loss (1,)).
+    Add ``aux_weight * aux_loss`` to the training objective for load
+    balancing.
+    """
+
+    def __init__(self, num_experts, d_model, d_hidden,
+                 capacity_factor=1.25, weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        if num_experts < 2:
+            raise ValueError("MoEFFN needs >= 2 experts")
+        self._cf = float(capacity_factor)
+        self.router_weight = self.params.get(
+            "router_weight", shape=(d_model, num_experts),
+            init=weight_initializer)
+        self.w1 = self.params.get(
+            "w1", shape=(num_experts, d_model, d_hidden),
+            init=weight_initializer)
+        self.b1 = self.params.get("b1", shape=(num_experts, d_hidden),
+                                  init="zeros")
+        self.w2 = self.params.get(
+            "w2", shape=(num_experts, d_hidden, d_model),
+            init=weight_initializer)
+        self.b2 = self.params.get("b2", shape=(num_experts, d_model),
+                                  init="zeros")
+
+    def hybrid_forward(self, F, x, router_weight, w1, b1, w2, b2):
+        return F._contrib_MoEFFN(x, router_weight, w1, b1, w2, b2,
+                                 capacity_factor=self._cf)

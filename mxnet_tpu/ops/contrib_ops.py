@@ -679,3 +679,25 @@ register("_contrib_MultiBoxTarget", _k_multibox_target,
 register("_contrib_MultiBoxDetection", _k_multibox_detection,
          arg_names=("cls_prob", "loc_pred", "anchor"), nondiff=True,
          doc=_k_multibox_detection.__doc__)
+
+
+# ---------------------------------------------------------------------------
+# MoE feed-forward as a registered op so gluon blocks can use expert
+# layers (the sharded-EP path lives in parallel/moe.py; this op is the
+# same math with mesh=None — under a DataParallelTrainer the 'ep'
+# constraint is applied by sharding the expert-stacked params)
+
+def _k_moe_ffn(data, router_w, w1, b1, w2, b2, *, capacity_factor=1.25):
+    """Switch-style top-1 MoE FFN: data (S, M) -> (y (S, M), aux (1,)).
+    See parallel/moe.py for the GShard einsum formulation and EP
+    sharding."""
+    from ..parallel.moe import moe_ffn
+
+    y, aux = moe_ffn(data, router_w, w1, b1, w2, b2, mesh=None,
+                     capacity_factor=capacity_factor)
+    return y, aux.reshape(1)
+
+
+register("_contrib_MoEFFN", _k_moe_ffn,
+         arg_names=("data", "router_w", "w1", "b1", "w2", "b2"),
+         num_outputs=2, doc=_k_moe_ffn.__doc__)

@@ -24,10 +24,15 @@ def moe_ffn(x, router_w, w1, b1, w2, b2, mesh=None, axis="ep",
             capacity_factor=1.25):
     """Top-1 (Switch) MoE feed-forward.
 
-    x (S, M) tokens; router_w (M, E); w1 (E, M, H); b1 (E, H);
-    w2 (E, H, M); b2 (E, M).  Returns (y (S, M), aux_loss scalar).
-    Shard w1/b1/w2/b2 leading dim over `axis` for real EP.
+    x (..., M) tokens (leading dims — batch, sequence — are flattened
+    into one token axis and restored); router_w (M, E); w1 (E, M, H);
+    b1 (E, H); w2 (E, H, M); b2 (E, M).  Returns (y shaped like x,
+    aux_loss scalar).  Shard w1/b1/w2/b2 leading dim over `axis` for
+    real EP.
     """
+    lead = x.shape[:-1]
+    if x.ndim != 2:
+        x = x.reshape(-1, x.shape[-1])
     S, M = x.shape
     E = router_w.shape[1]
     C = max(1, int(capacity_factor * S / E))
@@ -76,7 +81,7 @@ def moe_ffn(x, router_w, w1, b1, w2, b2, mesh=None, axis="ep",
     me = probs.mean(axis=0)                          # (E,)
     ce = onehot.astype(x.dtype).mean(axis=0)         # (E,)
     aux = E * jnp.sum(me * ce)
-    return y, aux
+    return y.reshape(lead + (M,)), aux
 
 
 class MoEBlock:

@@ -116,3 +116,41 @@ def test_moe_gradients_finite_and_balanced_loss():
         assert np.isfinite(arr).all()
     # router must receive gradient (through gate and aux loss)
     assert np.abs(np.asarray(g[0])).max() > 0
+
+
+def test_gluon_moe_block_trains():
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+
+    mx.random.seed(0)
+    moe = gluon.contrib.nn.MoEFFN(num_experts=4, d_model=8, d_hidden=16)
+    moe.initialize(mx.init.Xavier())
+    moe.hybridize()
+    x = nd.random.uniform(shape=(32, 8))
+    target = nd.array(np.sin(x.asnumpy() * 2))
+    tr = gluon.Trainer(moe.collect_params(), "adam",
+                       {"learning_rate": 1e-2})
+    losses = []
+    for _ in range(30):
+        with autograd.record():
+            y, aux = moe(x)
+            loss = ((y - target) ** 2).mean() + 0.01 * aux.sum()
+        loss.backward()
+        tr.step(1)
+        losses.append(float(loss.asscalar()))
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+    with pytest.raises(ValueError):
+        gluon.contrib.nn.MoEFFN(num_experts=1, d_model=4, d_hidden=4)
+
+
+def test_moe_accepts_sequence_input():
+    """(batch, seq, d_model) transformer activations flatten through
+    the token axis and come back in shape."""
+    blk = MoEBlock(num_experts=4, d_model=8, d_hidden=16, seed=4)
+    x3 = jnp.asarray(np.random.RandomState(3).randn(2, 16, 8)
+                     .astype(np.float32))
+    y3, aux = moe_ffn(x3, *blk.params())
+    assert y3.shape == (2, 16, 8)
+    y2, _ = moe_ffn(x3.reshape(32, 8), *blk.params())
+    assert np.allclose(np.asarray(y3).reshape(32, 8), np.asarray(y2),
+                       atol=1e-6)
