@@ -389,3 +389,29 @@ def add(lhs, rhs):
 
 
 elemwise_add = add
+
+
+def getnnz(data, axis=None):
+    """Stored-value count of a sparse array (ref:
+    src/operator/contrib/nnz.cc — CSR only there; row_sparse also
+    supported here).  axis=None: total; axis=0: per column; axis=1:
+    per row (CSR indptr diff)."""
+    if isinstance(data, CSRNDArray):
+        if axis is None:
+            return _wrap(jnp.asarray([data._values.shape[0]],
+                                     jnp.int32))
+        if axis == 0:
+            counts = jnp.zeros((data.shape[1],), jnp.int32).at[
+                data._indices].add(1)
+            return _wrap(counts)
+        if axis == 1:
+            return _wrap((data._indptr[1:]
+                          - data._indptr[:-1]).astype(jnp.int32))
+        raise MXNetError(f"getnnz: invalid axis {axis} for csr")
+    if isinstance(data, RowSparseNDArray):
+        if axis is None:
+            n = int(np.prod(data._values.shape))
+            return _wrap(jnp.asarray([n], jnp.int32))
+        raise MXNetError("getnnz on row_sparse supports axis=None only")
+    raise MXNetError(
+        f"getnnz expects a sparse NDArray, got {type(data).__name__}")

@@ -701,3 +701,24 @@ def _k_moe_ffn(data, router_w, w1, b1, w2, b2, *, capacity_factor=1.25):
 register("_contrib_MoEFFN", _k_moe_ffn,
          arg_names=("data", "router_w", "w1", "b1", "w2", "b2"),
          num_outputs=2, doc=_k_moe_ffn.__doc__)
+
+
+def _getnnz_wrapper(data, axis=None, out=None, **kwargs):
+    """Custom wrapper: getnnz consumes SPARSE NDArrays, which bypass
+    the dense jit dispatch (the reference's FComputeEx path).  Handles
+    the standard nd-op conveniences itself: string attrs normalize and
+    out= receives the result."""
+    from ..ndarray.ops import _norm_attr
+    from ..ndarray import sparse as _sparse
+
+    res = _sparse.getnnz(data, axis=_norm_attr(axis))
+    if out is not None:
+        out._data = res._data
+        return out
+    return res
+
+
+register("_contrib_getnnz", _getnnz_wrapper, arg_names=("data",),
+         wrapper=_getnnz_wrapper, aliases=("getnnz",), nondiff=True,
+         doc="Stored-value count of a sparse array (csr: axis "
+             "None/0/1; row_sparse: None). Ref contrib/nnz.cc.")

@@ -1052,10 +1052,26 @@ def _make_nn_wrapper(entry):
 
 import sys as _sys  # noqa: E402
 
+def _unsupported_symbolically(entry):
+    def raiser(*a, **kw):
+        raise MXNetError(
+            f"sym.{entry.name} is not supported symbolically (it "
+            f"operates on sparse/host objects outside the traced graph);"
+            f" use the mx.nd form")
+    raiser.__name__ = entry.name
+    return raiser
+
+
 _this = _sys.modules[__name__]
 for _name_, _entry in list(_registry.canonical_items()):
-    w = _make_nn_wrapper(_entry) if _entry.name in _NN_PARAM_SUFFIX \
-        else _sym_wrapper(_entry)
+    if _entry.wrapper is not None:
+        # python-level wrapper ops (sparse getnnz etc.) bypass the
+        # traced-graph machinery entirely — fail clearly at build time
+        w = _unsupported_symbolically(_entry)
+    elif _entry.name in _NN_PARAM_SUFFIX:
+        w = _make_nn_wrapper(_entry)
+    else:
+        w = _sym_wrapper(_entry)
     for alias in (_name_,) + _entry.aliases:
         if not hasattr(_this, alias):
             setattr(_this, alias, w)

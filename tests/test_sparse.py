@@ -322,3 +322,27 @@ def test_row_sparse_array_device_path_matches_numpy():
     np.testing.assert_allclose(via_nd.asnumpy(), via_np.asnumpy())
     np.testing.assert_array_equal(np.asarray(via_nd.indices.asnumpy()),
                                   np.asarray(via_np.indices.asnumpy()))
+
+
+def test_getnnz():
+    """Ref contrib/nnz.cc: stored-value counts for csr."""
+    from mxnet_tpu.ndarray import sparse
+
+    m = np.array([[1, 0, 2], [0, 0, 3], [4, 5, 0]], np.float32)
+    csr = sparse.cast_storage(nd.array(m), "csr")
+    assert nd.contrib.getnnz(csr).asnumpy()[0] == 5
+    assert list(nd.contrib.getnnz(csr, axis=1).asnumpy()) == [2, 1, 2]
+    assert list(nd.contrib.getnnz(csr, axis=0).asnumpy()) == [2, 1, 2]
+    rs = sparse.cast_storage(nd.array(m), "row_sparse")
+    assert nd.contrib.getnnz(rs).asnumpy()[0] == 9  # stored elements
+    from mxnet_tpu.base import MXNetError
+
+    with pytest.raises(MXNetError, match="expects a sparse"):
+        nd.contrib.getnnz(nd.array(m))  # dense rejected
+    out = nd.zeros((3,))
+    got = nd.contrib.getnnz(csr, axis="1", out=out)  # string attr + out=
+    assert got is out and list(out.asnumpy()) == [2, 1, 2]
+    import mxnet_tpu as _mx
+
+    with pytest.raises(MXNetError, match="not supported symbolically"):
+        _mx.sym.getnnz(_mx.sym.Variable("d"))
