@@ -415,3 +415,29 @@ def getnnz(data, axis=None):
         raise MXNetError("getnnz on row_sparse supports axis=None only")
     raise MXNetError(
         f"getnnz expects a sparse NDArray, got {type(data).__name__}")
+
+
+def edge_id(data, u, v):
+    """Edge weights of (u, v) pairs in a CSR adjacency matrix (ref:
+    src/operator/contrib/dgl_graph.cc _contrib_edge_id): returns
+    data[u[i], v[i]] where stored, -1 (in the data dtype) where no
+    edge.  O(Q log nnz): column indices are sorted within each row, so
+    ``row * ncols + col`` keys are globally sorted and one
+    searchsorted answers every query."""
+    if not isinstance(data, CSRNDArray):
+        raise MXNetError("edge_id expects a csr NDArray")
+    u_ = _as_jnp(u, jnp.int32)
+    v_ = _as_jnp(v, jnp.int32)
+    indptr, indices, values = data._indptr, data._indices, data._values
+    nnz = indices.shape[0]
+    miss = jnp.asarray(-1, values.dtype)
+    if nnz == 0:
+        return _wrap(jnp.full(u_.shape, miss, values.dtype))
+    ncols = data.shape[1]
+    row_of = (jnp.searchsorted(indptr, jnp.arange(nnz), side="right")
+              - 1).astype(jnp.int32)
+    keys = row_of * ncols + indices.astype(jnp.int32)
+    qk = u_ * ncols + v_
+    pos = jnp.clip(jnp.searchsorted(keys, qk), 0, nnz - 1)
+    found = keys[pos] == qk
+    return _wrap(jnp.where(found, values[pos], miss))

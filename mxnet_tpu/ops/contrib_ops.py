@@ -722,3 +722,17 @@ register("_contrib_getnnz", _getnnz_wrapper, arg_names=("data",),
          wrapper=_getnnz_wrapper, aliases=("getnnz",), nondiff=True,
          doc="Stored-value count of a sparse array (csr: axis "
              "None/0/1; row_sparse: None). Ref contrib/nnz.cc.")
+
+
+def _edge_id_wrapper(data, u, v, **kwargs):
+    """Custom wrapper (sparse input bypasses dense jit dispatch)."""
+    from ..ndarray import sparse as _sparse
+
+    return _sparse.edge_id(data, u, v)
+
+
+register("_contrib_edge_id", _edge_id_wrapper,
+         arg_names=("data", "u", "v"), wrapper=_edge_id_wrapper,
+         aliases=("edge_id",), nondiff=True,
+         doc="Edge weights of (u,v) pairs in a CSR adjacency matrix; "
+             "-1 where no edge. Ref contrib/dgl_graph.cc.")

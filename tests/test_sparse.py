@@ -346,3 +346,34 @@ def test_getnnz():
 
     with pytest.raises(MXNetError, match="not supported symbolically"):
         _mx.sym.getnnz(_mx.sym.Variable("d"))
+
+
+def test_edge_id():
+    """Ref _contrib_edge_id: CSR adjacency lookup, -1 for absent."""
+    from mxnet_tpu.ndarray import sparse
+
+    adj = np.array([[0, 5, 0], [7, 0, 0], [0, 0, 9]], np.float32)
+    csr = sparse.cast_storage(nd.array(adj), "csr")
+    out = nd.contrib.edge_id(csr, nd.array([0, 1, 2, 0]),
+                             nd.array([1, 0, 2, 0])).asnumpy()
+    assert list(out) == [5.0, 7.0, 9.0, -1.0]
+    from mxnet_tpu.base import MXNetError
+
+    with pytest.raises(MXNetError, match="csr"):
+        nd.contrib.edge_id(nd.array(adj), nd.array([0]), nd.array([0]))
+
+
+def test_edge_id_empty_and_dtype():
+    from mxnet_tpu.ndarray import sparse
+
+    empty = sparse.cast_storage(nd.zeros((3, 3)), "csr")
+    out = nd.contrib.edge_id(empty, nd.array([0, 2]), nd.array([1, 2]))
+    assert list(out.asnumpy()) == [-1.0, -1.0]
+    # integer edge ids keep their dtype (no float promotion)
+    csr = sparse.csr_matrix((np.array([10, 20], np.int32),
+                             np.array([1, 0]), np.array([0, 1, 2])),
+                            shape=(2, 2), dtype="int32")
+    out = nd.contrib.edge_id(csr, nd.array([0, 1, 1]),
+                             nd.array([1, 0, 1]))
+    assert out.dtype == np.int32
+    assert list(out.asnumpy()) == [10, 20, -1]
