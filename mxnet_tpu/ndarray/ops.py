@@ -24,6 +24,7 @@ from ..ops import vision as _vision_ops  # noqa: F401
 from ..ops import multi as _multi_ops  # noqa: F401
 from ..ops import contrib_ops as _contrib_ops  # noqa: F401
 from ..ops import random_ops as _random_ops  # noqa: F401
+from ..ops import optimizer_ops as _optimizer_ops  # noqa: F401
 from ..ops import descriptors as _descriptors  # noqa: F401 (param docs)
 from .ndarray import NDArray, array
 

@@ -433,11 +433,27 @@ def edge_id(data, u, v):
     miss = jnp.asarray(-1, values.dtype)
     if nnz == 0:
         return _wrap(jnp.full(u_.shape, miss, values.dtype))
-    ncols = data.shape[1]
-    row_of = (jnp.searchsorted(indptr, jnp.arange(nnz), side="right")
-              - 1).astype(jnp.int32)
-    keys = row_of * ncols + indices.astype(jnp.int32)
-    qk = u_ * ncols + v_
-    pos = jnp.clip(jnp.searchsorted(keys, qk), 0, nnz - 1)
-    found = keys[pos] == qk
-    return _wrap(jnp.where(found, values[pos], miss))
+    nrows, ncols = data.shape
+
+    def one(ui, vi):
+        # binary search for vi inside row ui's sorted column slice
+        # (ref: per-row lookup in contrib/dgl_graph.cc — no row*ncols
+        # key products, so no overflow at graph scale)
+        in_bounds = (ui >= 0) & (ui < nrows) & (vi >= 0) & (vi < ncols)
+        ui_c = jnp.clip(ui, 0, nrows - 1)
+        lo, hi = indptr[ui_c], indptr[ui_c + 1]
+
+        def body(_, lohi):
+            lo, hi = lohi
+            mid = (lo + hi) // 2
+            col = indices[jnp.clip(mid, 0, nnz - 1)]
+            go_right = (col < vi) & (lo < hi)
+            return (jnp.where(go_right, mid + 1, lo),
+                    jnp.where(go_right | (lo >= hi), hi, mid))
+
+        lo, hi = jax.lax.fori_loop(0, 32, body, (lo, hi))
+        found = in_bounds & (lo < indptr[ui_c + 1]) & \
+            (indices[jnp.clip(lo, 0, nnz - 1)] == vi)
+        return jnp.where(found, values[jnp.clip(lo, 0, nnz - 1)], miss)
+
+    return _wrap(jax.vmap(one)(u_, v_))

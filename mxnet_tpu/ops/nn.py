@@ -306,8 +306,8 @@ def _k_batch_norm(data, gamma, beta, moving_mean, moving_var, *,
 
 register("BatchNorm", _k_batch_norm,
          arg_names=("data", "gamma", "beta", "moving_mean", "moving_var"),
-         aliases=("batch_norm",), train_aware=True, num_outputs=3,
-         mutate_aux=((3, 1), (4, 2)))
+         aliases=("batch_norm", "BatchNorm_v1"), train_aware=True,
+         num_outputs=3, mutate_aux=((3, 1), (4, 2)))
 
 
 def _k_layer_norm(data, gamma, beta, *, axis=-1, eps=1e-5,
@@ -502,7 +502,9 @@ def _k_softmax_output(data, label, *, grad_scale=1.0, ignore_label=-1.0,
     return _softmax_output_core(data, label, opts)
 
 register("SoftmaxOutput", _k_softmax_output, arg_names=("data", "label"),
-         aliases=("softmax_output",))
+         aliases=("softmax_output", "Softmax"))
+# "Softmax" (capital S) is the reference's deprecated alias of
+# SoftmaxOutput; the lowercase activation op keeps the name "softmax"
 
 
 def _k_linear_regression_output(data, label, *, grad_scale=1.0):

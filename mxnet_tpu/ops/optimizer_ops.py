@@ -1,0 +1,191 @@
+"""Standalone optimizer update operators (ref: src/operator/
+optimizer_op.cc — sgd_update, adam_update & co, the ops
+mx.optimizer drives through the op interface).
+
+The python Optimizer tier (mxnet_tpu/optimizer.py) runs its own fused
+jitted kernels; these op forms exist for parity with user code that
+calls ``nd.sgd_update(w, g, lr=...)`` directly.  Semantics mirror the
+reference: the updated weight is RETURNED (write it back with out=w or
+assignment) and state tensors (mom/mean/var/history) are updated
+in place via mutate_aux.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _prep(g, w, rescale_grad, clip_gradient, wd):
+    g = g * rescale_grad
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * w
+
+
+def _k_sgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, lazy_update=True):
+    g = _prep(grad, weight, rescale_grad, clip_gradient, wd)
+    return weight - lr * g
+
+
+def _k_sgd_mom_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0,
+                      rescale_grad=1.0, clip_gradient=-1.0,
+                      lazy_update=True):
+    g = _prep(grad, weight, rescale_grad, clip_gradient, wd)
+    new_mom = momentum * mom - lr * g
+    return weight + new_mom, new_mom
+
+
+def _k_nag_mom_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0,
+                      rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, weight, rescale_grad, clip_gradient, wd)
+    new_mom = momentum * mom + g
+    return weight - lr * (g + momentum * new_mom), new_mom
+
+
+def _k_mp_sgd_update(weight, grad, weight32, *, lr, wd=0.0,
+                     rescale_grad=1.0, clip_gradient=-1.0,
+                     lazy_update=True):
+    g = _prep(grad.astype(jnp.float32), weight32, rescale_grad,
+              clip_gradient, wd)
+    new_w32 = weight32 - lr * g
+    return new_w32.astype(weight.dtype), new_w32
+
+
+def _k_mp_sgd_mom_update(weight, grad, mom, weight32, *, lr,
+                         momentum=0.0, wd=0.0, rescale_grad=1.0,
+                         clip_gradient=-1.0, lazy_update=True):
+    g = _prep(grad.astype(jnp.float32), weight32, rescale_grad,
+              clip_gradient, wd)
+    new_mom = momentum * mom - lr * g
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+def _k_adam_update(weight, grad, mean, var, *, lr, beta1=0.9,
+                   beta2=0.999, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, lazy_update=True):
+    g = _prep(grad, weight, rescale_grad, clip_gradient, wd)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    w = weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return w, new_mean, new_var
+
+
+def _k_rmsprop_update(weight, grad, n, *, lr, gamma1=0.95, epsilon=1e-8,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                      clip_weights=-1.0):
+    g = _prep(grad, weight, rescale_grad, clip_gradient, wd)
+    new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    w = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, new_n
+
+
+def _k_rmspropalex_update(weight, grad, n, g_state, delta, *, lr,
+                          gamma1=0.95, gamma2=0.9, epsilon=1e-8, wd=0.0,
+                          rescale_grad=1.0, clip_gradient=-1.0,
+                          clip_weights=-1.0):
+    g = _prep(grad, weight, rescale_grad, clip_gradient, wd)
+    new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    new_g = (1 - gamma1) * g + gamma1 * g_state
+    new_delta = gamma2 * delta - lr * g / jnp.sqrt(
+        new_n - jnp.square(new_g) + epsilon)
+    w = weight + new_delta
+    if clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, new_n, new_g, new_delta
+
+
+def _k_ftrl_update(weight, grad, z, n, *, lr, lamda1=0.01, beta=1.0,
+                   wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    w = jnp.where(
+        jnp.abs(new_z) <= lamda1, jnp.zeros_like(weight),
+        -(new_z - jnp.sign(new_z) * lamda1) /
+        ((beta + jnp.sqrt(new_n)) / lr + wd))
+    return w, new_z, new_n
+
+
+def _k_signsgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0,
+                      clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+def _k_signum_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0,
+                     rescale_grad=1.0, clip_gradient=-1.0,
+                     wd_lh=0.0):
+    g = _prep(grad, weight, rescale_grad, clip_gradient, wd)
+    new_mom = momentum * mom - (1 - momentum) * g
+    w = (1 - lr * wd_lh) * weight + lr * jnp.sign(new_mom)
+    return w, new_mom
+
+
+def _k_ftml_update(weight, grad, d, v, z, *, lr, beta1=0.6, beta2=0.999,
+                   epsilon=1e-8, t, wd=0.0, rescale_grad=1.0,
+                   clip_grad=-1.0):
+    g = grad * rescale_grad + wd * weight
+    if clip_grad > 0:
+        g = jnp.clip(g, -clip_grad, clip_grad)
+    new_v = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_t = (1 - beta1 ** t) / lr * (
+        jnp.sqrt(new_v / (1 - beta2 ** t)) + epsilon)
+    sigma = d_t - beta1 * d
+    new_z = beta1 * z + (1 - beta1) * g - sigma * weight
+    w = -new_z / d_t
+    return w, d_t, new_v, new_z
+
+
+def _k_adagrad_update(weight, grad, history, *, lr, epsilon=1e-7,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_hist = history + jnp.square(g)
+    w = weight - lr * (g / jnp.sqrt(new_hist + epsilon) + wd * weight)
+    return w, new_hist
+
+
+# (name, kernel, input names, (state_input_idx -> output_idx) pairs)
+_UPDATES = [
+    ("sgd_update", _k_sgd_update, ("weight", "grad"), ()),
+    ("sgd_mom_update", _k_sgd_mom_update, ("weight", "grad", "mom"),
+     ((2, 1),)),
+    ("nag_mom_update", _k_nag_mom_update, ("weight", "grad", "mom"),
+     ((2, 1),)),
+    ("mp_sgd_update", _k_mp_sgd_update, ("weight", "grad", "weight32"),
+     ((2, 1),)),
+    ("mp_sgd_mom_update", _k_mp_sgd_mom_update,
+     ("weight", "grad", "mom", "weight32"), ((2, 1), (3, 2))),
+    ("adam_update", _k_adam_update, ("weight", "grad", "mean", "var"),
+     ((2, 1), (3, 2))),
+    ("rmsprop_update", _k_rmsprop_update, ("weight", "grad", "n"),
+     ((2, 1),)),
+    ("rmspropalex_update", _k_rmspropalex_update,
+     ("weight", "grad", "n", "g", "delta"), ((2, 1), (3, 2), (4, 3))),
+    ("ftrl_update", _k_ftrl_update, ("weight", "grad", "z", "n"),
+     ((2, 1), (3, 2))),
+    ("signsgd_update", _k_signsgd_update, ("weight", "grad"), ()),
+    ("signum_update", _k_signum_update, ("weight", "grad", "mom"),
+     ((2, 1),)),
+    ("ftml_update", _k_ftml_update, ("weight", "grad", "d", "v", "z"),
+     ((2, 1), (3, 2), (4, 3))),
+    ("adagrad_update", _k_adagrad_update, ("weight", "grad", "history"),
+     ((2, 1),)),
+]
+
+for _name, _fn, _args, _aux in _UPDATES:
+    register(_name, _fn, arg_names=_args, nondiff=True,
+             num_outputs=1 + len(_aux),
+             mutate_aux=_aux if _aux else None,
+             doc=_fn.__doc__ or f"{_name} (ref optimizer_op.cc)")

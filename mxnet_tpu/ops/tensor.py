@@ -378,7 +378,8 @@ def _k_add_n(*args):
         out = out + a
     return out
 
-register("add_n", _k_add_n, variadic=True, aliases=("ElementWiseSum",))
+register("add_n", _k_add_n, variadic=True,
+         aliases=("ElementWiseSum", "elemwise_sum"))
 
 
 def _k_broadcast_axis(data, *, axis, size):
@@ -818,3 +819,61 @@ def _k_crop(data, *, offset=(0, 0), h_w=(0, 0), center_crop=False):
     return data[:, :, y0:y0 + ch, x0:x0 + cw]
 
 register("Crop", _k_crop, aliases=("crop_legacy",))
+
+
+def _k_reshape_like(lhs, rhs, *, lhs_begin=None, lhs_end=None,
+                    rhs_begin=None, rhs_end=None):
+    """Reshape lhs to rhs's shape; the *_begin/*_end attrs reshape only
+    the [lhs_begin, lhs_end) axes of lhs onto the [rhs_begin, rhs_end)
+    axes of rhs (ref matrix_op reshape_like)."""
+    if lhs_begin is None and lhs_end is None and rhs_begin is None \
+            and rhs_end is None:
+        return jnp.reshape(lhs, rhs.shape)
+    lb = 0 if lhs_begin is None else int(lhs_begin) % (lhs.ndim + 1)
+    le = lhs.ndim if lhs_end is None else int(lhs_end) % (lhs.ndim + 1)
+    rb = 0 if rhs_begin is None else int(rhs_begin) % (rhs.ndim + 1)
+    re_ = rhs.ndim if rhs_end is None else int(rhs_end) % (rhs.ndim + 1)
+    new_shape = lhs.shape[:lb] + rhs.shape[rb:re_] + lhs.shape[le:]
+    return jnp.reshape(lhs, new_shape)
+
+register("reshape_like", _k_reshape_like, arg_names=("lhs", "rhs"),
+         doc=_k_reshape_like.__doc__)
+
+
+@jax.custom_vjp
+def _kl_sparse_core(data, opts_dummy):
+    return data
+
+
+def _kl_fwd(data, opts_dummy):
+    return data, (data, opts_dummy)
+
+
+def _kl_bwd(res, g):
+    data, opts = res
+    target, scale = opts[0], opts[1]
+    # ref identity_attach_KL_sparse_reg-inl.h: the input IS the sigmoid
+    # activation; rho = batch mean, penalty gradient added directly
+    rho = jnp.clip(jnp.mean(data, axis=0), 1e-6, 1 - 1e-6)
+    dkl = (-target / rho + (1 - target) / (1 - rho)) * scale
+    reg = jnp.broadcast_to(dkl, data.shape).astype(g.dtype)
+    return g + reg, jnp.zeros_like(opts)
+
+
+_kl_sparse_core.defvjp(_kl_fwd, _kl_bwd)
+
+
+def _k_identity_attach_kl_sparse_reg(data, *, sparseness_target=0.1,
+                                     penalty=0.001, momentum=0.9):
+    """Identity forward; backward adds the KL-sparseness penalty
+    gradient pushing the batch-mean of the (already-sigmoid) input
+    toward sparseness_target (ref:
+    identity_attach_KL_sparse_reg-inl.h; the reference's moving-average
+    rho estimate is not kept — rho is the current batch mean)."""
+    opts = jnp.asarray([sparseness_target, penalty], jnp.float32)
+    return _kl_sparse_core(data, opts)
+
+
+register("IdentityAttachKLSparseReg", _k_identity_attach_kl_sparse_reg,
+         arg_names=("data",), jit_compile=False,
+         doc=_k_identity_attach_kl_sparse_reg.__doc__)

@@ -1,0 +1,122 @@
+"""Standalone optimizer update ops (ref: src/operator/optimizer_op.cc)
+— numpy oracles; state tensors mutate in place, updated weight is
+returned."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _wg():
+    w = nd.array(np.ones((4,), np.float32))
+    g = nd.array(np.full((4,), 0.5, np.float32))
+    return w, g
+
+
+def test_sgd_update_oracle():
+    w, g = _wg()
+    out = nd.sgd_update(w, g, lr=0.1, wd=0.01)
+    assert np.allclose(out.asnumpy(), 1 - 0.1 * (0.5 + 0.01 * 1.0))
+    # rescale + clip
+    out = nd.sgd_update(w, g, lr=0.1, rescale_grad=10.0,
+                        clip_gradient=1.0)
+    assert np.allclose(out.asnumpy(), 1 - 0.1 * 1.0)
+
+
+def test_sgd_mom_and_nag():
+    w, g = _wg()
+    mom = nd.zeros((4,))
+    out = nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9)
+    assert np.allclose(mom.asnumpy(), -0.05)       # state mutated
+    assert np.allclose(out.asnumpy(), 0.95)
+    w, g = _wg()
+    mom = nd.zeros((4,))
+    out = nd.nag_mom_update(w, g, mom, lr=0.1, momentum=0.9)
+    # mom = 0.9*0 + g = 0.5; w -= lr*(g + 0.9*mom)
+    assert np.allclose(mom.asnumpy(), 0.5)
+    assert np.allclose(out.asnumpy(), 1 - 0.1 * (0.5 + 0.45))
+
+
+def test_mp_sgd_keeps_fp32_master():
+    w16 = nd.array(np.ones((4,), np.float16))
+    g16 = nd.array(np.full((4,), 0.5, np.float16))
+    w32 = nd.array(np.ones((4,), np.float32))
+    out = nd.mp_sgd_update(w16, g16, w32, lr=0.1)
+    assert out.dtype == np.float16
+    assert w32.dtype == np.float32 and np.allclose(w32.asnumpy(), 0.95)
+
+
+def test_adam_update_oracle():
+    w, g = _wg()
+    mean, var = nd.zeros((4,)), nd.zeros((4,))
+    out = nd.adam_update(w, g, mean, var, lr=0.01, beta1=0.9,
+                         beta2=0.999, epsilon=1e-8)
+    m = 0.1 * 0.5
+    v = 0.001 * 0.25
+    assert np.allclose(mean.asnumpy(), m, atol=1e-7)
+    assert np.allclose(var.asnumpy(), v, atol=1e-9)
+    assert np.allclose(out.asnumpy(), 1 - 0.01 * m / (np.sqrt(v) + 1e-8),
+                       atol=1e-6)
+
+
+def test_rmsprop_variants():
+    w, g = _wg()
+    n = nd.zeros((4,))
+    out = nd.rmsprop_update(w, g, n, lr=0.1, gamma1=0.9)
+    assert np.allclose(n.asnumpy(), 0.1 * 0.25, atol=1e-7)
+    assert np.isfinite(out.asnumpy()).all()
+    w, g = _wg()
+    n, gs, d = nd.zeros((4,)), nd.zeros((4,)), nd.zeros((4,))
+    out = nd.rmspropalex_update(w, g, n, gs, d, lr=0.1)
+    assert np.isfinite(out.asnumpy()).all()
+    assert (np.abs(d.asnumpy()) > 0).all()  # delta state updated
+
+
+def test_ftrl_sparsifies():
+    w, g = _wg()
+    z, n = nd.zeros((4,)), nd.zeros((4,))
+    out = nd.ftrl_update(w, g, z, n, lr=0.1, lamda1=10.0)
+    # with huge l1, weights snap to zero
+    assert np.allclose(out.asnumpy(), 0.0)
+
+
+def test_signsgd_signum():
+    w, g = _wg()
+    out = nd.signsgd_update(w, g, lr=0.1)
+    assert np.allclose(out.asnumpy(), 0.9)
+    w, g = _wg()
+    mom = nd.zeros((4,))
+    out = nd.signum_update(w, g, mom, lr=0.1, momentum=0.9)
+    assert np.allclose(mom.asnumpy(), -0.05)
+    assert np.allclose(out.asnumpy(), 1 + 0.1 * np.sign(-0.05))
+
+
+def test_ftml_and_adagrad():
+    w, g = _wg()
+    d, v, z = nd.zeros((4,)), nd.zeros((4,)), nd.zeros((4,))
+    out = nd.ftml_update(w, g, d, v, z, lr=0.1, t=1)
+    assert np.isfinite(out.asnumpy()).all()
+    assert (v.asnumpy() > 0).all()
+    w, g = _wg()
+    h = nd.zeros((4,))
+    out = nd.adagrad_update(w, g, h, lr=0.1)
+    assert np.allclose(h.asnumpy(), 0.25)
+    assert np.allclose(out.asnumpy(),
+                       1 - 0.1 * 0.5 / np.sqrt(0.25 + 1e-7), atol=1e-5)
+
+
+def test_training_loop_with_update_ops():
+    """A hand-rolled loop using the op forms converges (the reference's
+    pattern before gluon.Trainer existed)."""
+    rng = np.random.RandomState(0)
+    X = rng.rand(64, 5).astype(np.float32)
+    true_w = rng.randn(5).astype(np.float32)
+    y = X @ true_w
+    w = nd.zeros((5,))
+    mean, var = nd.zeros((5,)), nd.zeros((5,))
+    for _ in range(200):
+        pred = (nd.array(X) * w.reshape((1, 5))).sum(axis=1)
+        grad = nd.array(2 * X.T @ (pred.asnumpy() - y) / 64)
+        w = nd.adam_update(w, grad, mean, var, lr=0.05)
+    assert np.allclose(w.asnumpy(), true_w, atol=0.05)

@@ -377,3 +377,15 @@ def test_edge_id_empty_and_dtype():
                              nd.array([1, 0, 1]))
     assert out.dtype == np.int32
     assert list(out.asnumpy()) == [10, 20, -1]
+
+
+def test_edge_id_out_of_range_queries():
+    """v >= ncols / u >= nrows must miss (-1), never alias into a
+    neighbouring row's key space."""
+    from mxnet_tpu.ndarray import sparse
+
+    adj = np.array([[0, 5, 0], [7, 0, 0], [0, 0, 9]], np.float32)
+    csr = sparse.cast_storage(nd.array(adj), "csr")
+    out = nd.contrib.edge_id(csr, nd.array([0, 3, 0]),
+                             nd.array([3, 0, -1])).asnumpy()
+    assert list(out) == [-1.0, -1.0, -1.0]
