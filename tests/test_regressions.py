@@ -210,3 +210,36 @@ def test_eager_dispatch_overhead_bounded():
     # cached eager add on CPU runs ~20-60us; 1000us catches a regression
     # to retrace-per-call while staying robust on loaded CI machines
     assert per_op_us < 1000, f"eager dispatch {per_op_us:.0f}us/op"
+
+
+def test_every_registered_op_renders_docs():
+    """help(mx.nd.X) must work for the whole registry: build_doc and
+    param introspection cannot crash for any op (the dmlc parameter.h
+    self-documentation contract)."""
+    from mxnet_tpu.ops import registry
+
+    n = 0
+    for name, entry in registry.canonical_items():
+        doc = entry.build_doc()
+        assert isinstance(doc, str) and doc, f"{name} doc is {doc!r}"
+        entry.param_descriptors()
+        n += 1
+    assert n > 250, f"registry shrank? {n} canonical ops"
+
+
+def test_generated_wrappers_importable_and_named():
+    """Every generated nd.* wrapper carries its op name (stable repr
+    for tooling and error messages)."""
+    import mxnet_tpu.ndarray.ops as gen
+    from mxnet_tpu.ops import registry
+
+    for name, entry in registry.canonical_items():
+        w = getattr(gen, name, None)
+        if w is None:
+            # internal scalar ops (_plus_scalar...) register lazily
+            # during hybridize tracing — no public wrapper by design
+            assert name.startswith("_"), f"{name} missing from nd.*"
+            continue
+        assert callable(w)
+        if entry.wrapper is None:
+            assert w.__name__ == name
