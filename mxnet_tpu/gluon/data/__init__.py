@@ -2,6 +2,6 @@
 from .dataset import (Dataset, SimpleDataset, ArrayDataset,  # noqa: F401
                       RecordFileDataset)
 from .sampler import (Sampler, SequentialSampler, RandomSampler,  # noqa: F401
-                      BatchSampler)
+                      BatchSampler, FilterSampler)
 from .dataloader import DataLoader  # noqa: F401
 from . import vision  # noqa: F401

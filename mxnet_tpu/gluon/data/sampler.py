@@ -69,3 +69,18 @@ class BatchSampler(Sampler):
         if self._last_batch == "discard":
             return len(self._sampler) // self._batch_size
         return (len(self._sampler) + len(self._prev)) // self._batch_size
+
+
+class FilterSampler(Sampler):
+    """Indices of samples for which fn(dataset[i]) is truthy
+    (ref: gluon.data.FilterSampler)."""
+
+    def __init__(self, fn, dataset):
+        self._indices = [i for i in range(len(dataset))
+                         if fn(dataset[i])]
+
+    def __iter__(self):
+        return iter(self._indices)
+
+    def __len__(self):
+        return len(self._indices)

@@ -2,7 +2,8 @@
 from .basic_layers import (Sequential, HybridSequential, Dense, Dropout,  # noqa: F401
                            Flatten, Activation, LeakyReLU, PReLU, ELU, SELU,
                            GELU, Swish, Embedding, BatchNorm, LayerNorm,
-                           InstanceNorm, Lambda, HybridLambda)
+                           InstanceNorm, GroupNorm, Lambda,
+                           HybridLambda)
 from .conv_layers import (Conv1D, Conv2D, Conv3D, Conv1DTranspose,  # noqa: F401
                           Conv2DTranspose, MaxPool1D, MaxPool2D, MaxPool3D,
                           AvgPool1D, AvgPool2D, AvgPool3D, GlobalMaxPool1D,

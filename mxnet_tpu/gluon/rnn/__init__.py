@@ -1,5 +1,6 @@
 """gluon.rnn (ref: python/mxnet/gluon/rnn/)."""
 from .rnn_layer import RNN, LSTM, GRU  # noqa: F401
 from .rnn_cell import (RecurrentCell, RNNCell, LSTMCell, GRUCell,  # noqa: F401
-                       SequentialRNNCell, DropoutCell, ResidualCell,
+                       SequentialRNNCell, HybridSequentialRNNCell,
+                       BidirectionalCell, DropoutCell, ResidualCell,
                        ModifierCell, ZoneoutCell)

@@ -292,3 +292,64 @@ class ZoneoutCell(ModifierCell):
             next_states = [zone(self.zoneout_states, n, o)
                            for n, o in zip(next_states, states)]
         return out, next_states
+
+
+class BidirectionalCell(RecurrentCell):
+    """Run one cell forward and another backward over the sequence,
+    concatenating outputs per step (ref: rnn_cell.BidirectionalCell —
+    unroll-only, like the reference)."""
+
+    def __init__(self, l_cell, r_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+        self._l, self._r = l_cell, r_cell
+
+    def state_info(self, batch_size=0):
+        return self._l.state_info(batch_size) + \
+            self._r.state_info(batch_size)
+
+    def __call__(self, x, states=None, **kwargs):
+        raise NotImplementedError(
+            "BidirectionalCell cannot step one timestep at a time "
+            "(the backward direction needs the full sequence); "
+            "call unroll() (reference behavior)")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ... import ndarray as F
+
+        axis = 1 if layout == "NTC" else 0
+
+        def _rev(seq):
+            """Time-reverse, honoring valid_length padding."""
+            if valid_length is None:
+                return F.reverse(seq, axis=axis)
+            out = F.SequenceReverse(
+                seq if layout == "TNC" else seq.swapaxes(0, 1),
+                valid_length, use_sequence_length=True)
+            return out.swapaxes(0, 1) if layout == "NTC" else out
+
+        nl = len(self._l.state_info())
+        if begin_state is None:
+            bs = inputs.shape[0] if layout == "NTC" else inputs.shape[1]
+            begin_state = self.begin_state(bs)
+        l_out, l_states = self._l.unroll(
+            length, inputs, begin_state[:nl], layout=layout,
+            merge_outputs=True, valid_length=valid_length)
+        r_out, r_states = self._r.unroll(
+            length, _rev(inputs), begin_state[nl:], layout=layout,
+            merge_outputs=True, valid_length=valid_length)
+        out = F.concat(l_out, _rev(r_out), dim=2)
+        states = l_states + r_states
+        if merge_outputs is False:
+            steps = [out[:, t] if layout == "NTC" else out[t]
+                     for t in range(length)]
+            return steps, states
+        return out, states
+
+
+class HybridSequentialRNNCell(SequentialRNNCell):
+    """Hybridizable stacked cells (ref: HybridSequentialRNNCell — same
+    stacking semantics; hybridization happens through the containing
+    block here)."""

@@ -320,3 +320,44 @@ def test_lenet_mnist_convergence():
     acc.update(nd.array(y), net(nd.array(X)))
     assert acc.get()[1] > 0.95, (acc.get(), losses[:5], losses[-5:])
     assert losses[-1] < losses[0] * 0.5
+
+
+def test_groupnorm_block():
+    gn = gluon.nn.GroupNorm(num_groups=2)
+    gn.initialize()
+    x = np.random.RandomState(0).randn(2, 4, 3, 3).astype(np.float32)
+    y = gn(nd.array(x)).asnumpy()
+    xg = x.reshape(2, 2, 2, 3, 3)
+    ref = (xg - xg.mean(axis=(2, 3, 4), keepdims=True)) / np.sqrt(
+        xg.var(axis=(2, 3, 4), keepdims=True) + 1e-5)
+    assert np.allclose(y, ref.reshape(x.shape), atol=1e-4)
+
+
+def test_bidirectional_cell_unroll():
+    mx.random.seed(0)
+    l, r = gluon.rnn.LSTMCell(6), gluon.rnn.LSTMCell(6)
+    bi = gluon.rnn.BidirectionalCell(l, r)
+    bi.initialize(mx.init.Xavier())
+    seq = nd.random.uniform(shape=(2, 5, 4))
+    out, states = bi.unroll(5, seq)
+    assert out.shape == (2, 5, 12) and len(states) == 4
+    lo, _ = l.unroll(5, seq)
+    ro, _ = r.unroll(5, nd.reverse(seq, axis=1))
+    manual = nd.concat(lo, nd.reverse(ro, axis=1), dim=2)
+    assert np.allclose(out.asnumpy(), manual.asnumpy(), atol=1e-5)
+    with pytest.raises(NotImplementedError):
+        bi(nd.zeros((2, 4)))
+
+
+def test_hybrid_sequential_rnn_cell_and_filter_sampler():
+    hs = gluon.rnn.HybridSequentialRNNCell()
+    hs.add(gluon.rnn.GRUCell(5))
+    hs.add(gluon.rnn.GRUCell(5))
+    hs.initialize(mx.init.Xavier())
+    o, st = hs.unroll(4, nd.random.uniform(shape=(2, 4, 3)))
+    assert o.shape == (2, 4, 5)
+    ds = gluon.data.ArrayDataset(nd.array(np.arange(10,
+                                                    dtype=np.float32)))
+    fs = gluon.data.FilterSampler(
+        lambda v: float(v.asscalar()) % 2 == 0, ds)
+    assert list(fs) == [0, 2, 4, 6, 8] and len(fs) == 5
