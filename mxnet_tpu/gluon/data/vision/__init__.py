@@ -1,4 +1,4 @@
 """gluon.data.vision (ref: python/mxnet/gluon/data/vision/)."""
 from . import transforms  # noqa: F401
-from .datasets import (MNIST, FashionMNIST, CIFAR10,  # noqa: F401
+from .datasets import (MNIST, FashionMNIST, CIFAR10, CIFAR100,  # noqa: F401
                        ImageRecordDataset, ImageFolderDataset)

@@ -191,3 +191,43 @@ class ImageFolderDataset(Dataset):
         if self._transform is not None:
             return self._transform(data, label)
         return data, np.float32(label)
+
+
+class CIFAR100(CIFAR10):
+    """CIFAR100 (ref: gluon.data.vision.CIFAR100). fine_label=False
+    gives the 20 coarse labels."""
+
+    def __init__(self, root="~/.mxnet/datasets/cifar100",
+                 fine_label=False, train=True, transform=None,
+                 synthetic=False):
+        # reference signature: (root, fine_label=False, train=True, ...)
+        self._fine = fine_label
+        super().__init__(root=root, train=train, transform=transform,
+                         synthetic=synthetic)
+
+    def _get_data(self):
+        import pickle
+
+        from ....ndarray import ndarray as _nd
+
+        base = os.path.join(self._root, "cifar-100-python")
+        if not os.path.exists(base):
+            if self._synthetic:
+                n = 1024 if self._train else 256
+                rng = np.random.RandomState(11)
+                data = rng.randint(0, 255, (n, 32, 32, 3)) \
+                    .astype(np.uint8)
+                self._data = _nd.array(data, dtype=np.uint8)
+                k = 100 if self._fine else 20
+                self._label = rng.randint(0, k, n).astype(np.int32)
+                return
+            raise MXNetError(
+                f"CIFAR100 batches not found under {base} (no egress)")
+        fn = "train" if self._train else "test"
+        with open(os.path.join(base, fn), "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        key = b"fine_labels" if self._fine else b"coarse_labels"
+        self._data = _nd.array(
+            d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1),
+            dtype=np.uint8)
+        self._label = np.asarray(d[key], np.int32)

@@ -160,3 +160,103 @@ class RandomContrast(Block):
         xf = x.astype("float32")
         mean = xf.mean()
         return ((xf - mean) * f + mean).clip(0, 255)
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation):
+        super().__init__()
+        self._s = saturation
+
+    def forward(self, x):
+        f = 1.0 + np.random.uniform(-self._s, self._s)
+        xf = x.astype("float32")
+        gray = (xf * _nd.array(np.array([0.299, 0.587, 0.114],
+                                        np.float32))).sum(
+            axis=-1, keepdims=True)
+        return (xf * f + gray * (1 - f)).clip(0, 255)
+
+
+class RandomHue(Block):
+    def __init__(self, hue):
+        super().__init__()
+        self._h = hue
+
+    def forward(self, x):
+        # the reference's YIQ rotation matrix (image_random-inl.h)
+        alpha = np.random.uniform(-self._h, self._h)
+        theta = alpha * np.pi
+        cs, sn = np.cos(theta), np.sin(theta)
+        t_yiq = np.array([[0.299, 0.587, 0.114],
+                          [0.596, -0.274, -0.321],
+                          [0.211, -0.523, 0.311]], np.float32)
+        t_rgb = np.array([[1.0, 0.956, 0.621],
+                          [1.0, -0.272, -0.647],
+                          [1.0, -1.107, 1.705]], np.float32)
+        rot = np.array([[1, 0, 0], [0, cs, -sn], [0, sn, cs]],
+                       np.float32)
+        m = t_rgb @ rot @ t_yiq
+        xf = x.astype("float32")
+        return (xf.reshape((-1, 3)).dot(_nd.array(m.T))
+                .reshape(xf.shape)).clip(0, 255)
+
+
+class RandomColorJitter(Block):
+    """brightness -> contrast -> saturation -> hue, each optional
+    (ref: transforms.RandomColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._stages = []
+        if brightness:
+            self._stages.append(RandomBrightness(brightness))
+        if contrast:
+            self._stages.append(RandomContrast(contrast))
+        if saturation:
+            self._stages.append(RandomSaturation(saturation))
+        if hue:
+            self._stages.append(RandomHue(hue))
+
+    def forward(self, x):
+        for s in self._stages:
+            x = s(x)
+        return x
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA noise (ref: transforms.RandomLighting)."""
+
+    _eigval = np.array([55.46, 4.794, 1.148], np.float32)
+    _eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.8140],
+                        [-0.5836, -0.6948, 0.4203]], np.float32)
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        a = np.random.normal(0, self._alpha, 3).astype(np.float32)
+        noise = (self._eigvec * a * self._eigval).sum(axis=1)
+        return (x.astype("float32") + _nd.array(noise)).clip(0, 255)
+
+
+class CropResize(Block):
+    """Crop (x, y, w, h) then optionally resize (ref:
+    transforms.CropResize)."""
+
+    def __init__(self, x, y, width, height, size=None, interpolation=1):
+        super().__init__()
+        self._box = (x, y, width, height)
+        self._size = size
+        self._interp = interpolation
+
+    def forward(self, data):
+        from .... import image as _image
+
+        x, y, w, h = self._box
+        s = None
+        if self._size:
+            s = self._size if isinstance(self._size, (tuple, list)) \
+                else (self._size, self._size)
+        return _image.fixed_crop(data, x, y, w, h, size=s,
+                                 interp=self._interp)

@@ -304,27 +304,21 @@ class GroupNorm(HybridBlock):
 
     def __init__(self, num_groups=1, epsilon=1e-5, center=True,
                  scale=True, beta_initializer="zeros",
-                 gamma_initializer="ones", in_channels=0, **kwargs):
+                 gamma_initializer="ones", **kwargs):
         super().__init__(**kwargs)
         self._num_groups = num_groups
         self._eps = epsilon
-        # center/scale=False: the affine param exists but stays fixed
+        # affine params are PER GROUP (reference group_norm.cc);
+        # center/scale=False: the param exists but stays fixed
         # (grad_req null) — the same convention BatchNorm uses above
-        self.gamma = self.params.get("gamma", shape=(in_channels,),
+        self.gamma = self.params.get("gamma", shape=(num_groups,),
                                      init=gamma_initializer,
-                                     allow_deferred_init=True,
                                      grad_req="write" if scale
                                      else "null")
-        self.beta = self.params.get("beta", shape=(in_channels,),
+        self.beta = self.params.get("beta", shape=(num_groups,),
                                     init=beta_initializer,
-                                    allow_deferred_init=True,
                                     grad_req="write" if center
                                     else "null")
-
-    def infer_shape(self, x, *args):
-        c = x.shape[1]
-        self.gamma.shape = (c,)
-        self.beta.shape = (c,)
 
     def hybrid_forward(self, F, x, gamma, beta):
         return F.GroupNorm(x, gamma, beta, num_groups=self._num_groups,

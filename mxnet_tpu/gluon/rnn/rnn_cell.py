@@ -301,8 +301,9 @@ class BidirectionalCell(RecurrentCell):
 
     def __init__(self, l_cell, r_cell, **kwargs):
         super().__init__(**kwargs)
-        self.register_child(l_cell, "l_cell")
-        self.register_child(r_cell, "r_cell")
+        # plain attribute assignment auto-registers Block children
+        # (ModifierCell pattern) — register_child here would double-
+        # register and duplicate every weight in checkpoints
         self._l, self._r = l_cell, r_cell
 
     def state_info(self, batch_size=0):

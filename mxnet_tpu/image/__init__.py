@@ -2,4 +2,6 @@
 from .image import (imdecode, imread, imresize, resize_short, fixed_crop,  # noqa: F401
                     center_crop, random_crop, color_normalize, Augmenter,
                     ResizeAug, CenterCropAug, RandomCropAug,
-                    HorizontalFlipAug, CastAug, CreateAugmenter, ImageIter)
+                    HorizontalFlipAug, CastAug, ColorNormalizeAug,
+                    ForceResizeAug, SequentialAug, RandomOrderAug,
+                    CreateAugmenter, ImageIter)

@@ -234,3 +234,54 @@ class ImageIter:
             labels[i] = label
             data[i] = img.asnumpy().transpose(2, 0, 1)
         return self._db([_nd.array(data)], [_nd.array(labels)])
+
+
+class ColorNormalizeAug(Augmenter):
+    """mean/std normalization augmenter (ref: ColorNormalizeAug)."""
+
+    def __init__(self, mean, std):
+        self._mean = mean
+        self._std = std
+
+    def __call__(self, src):
+        return color_normalize(src, self._mean, self._std)
+
+
+class ForceResizeAug(Augmenter):
+    """Resize to an exact (w, h), ignoring aspect (ref: ForceResizeAug)."""
+
+    def __init__(self, size, interp=2):
+        self._size = size
+        self._interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self._size[0], self._size[1],
+                        interp=self._interp)
+
+
+class SequentialAug(Augmenter):
+    """Apply augmenters in order (ref: SequentialAug)."""
+
+    def __init__(self, ts):
+        self._ts = list(ts)
+
+    def __call__(self, src):
+        for t in self._ts:
+            src = t(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    """Apply augmenters in a random order (ref: RandomOrderAug)."""
+
+    def __init__(self, ts):
+        self._ts = list(ts)
+
+    def __call__(self, src):
+        import random as _pyrandom
+
+        order = list(self._ts)
+        _pyrandom.shuffle(order)
+        for t in order:
+            src = t(src)
+        return src

@@ -336,15 +336,17 @@ register("InstanceNorm", _k_instance_norm,
 
 
 def _k_group_norm(data, gamma, beta, *, num_groups=1, eps=1e-5):
+    """gamma/beta are PER GROUP, shape (num_groups,) — the reference's
+    group_norm.cc convention (not per channel)."""
     n, c = data.shape[:2]
     x = data.reshape((n, num_groups, c // num_groups) + data.shape[2:])
     red = tuple(range(2, x.ndim))
     mean = jnp.mean(x, axis=red, keepdims=True)
     var = jnp.var(x, axis=red, keepdims=True)
     x = (x - mean) * lax.rsqrt(var + eps)
-    x = x.reshape(data.shape)
-    shape = (1, -1) + (1,) * (data.ndim - 2)
-    return x * gamma.reshape(shape) + beta.reshape(shape)
+    gshape = (1, num_groups) + (1,) * (x.ndim - 2)
+    x = x * gamma.reshape(gshape) + beta.reshape(gshape)
+    return x.reshape(data.shape)
 
 register("GroupNorm", _k_group_norm, arg_names=("data", "gamma", "beta"))
 

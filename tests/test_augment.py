@@ -120,3 +120,37 @@ def test_native_and_python_agree_statistically(tmp_path):
         stats[use_native] = (b.mean(), b.std())
     assert abs(stats[True][0] - stats[False][0]) < 12.0, stats
     assert abs(stats[True][1] - stats[False][1]) < 12.0, stats
+
+
+def test_vision_surface_fills():
+    """CIFAR100, color-jitter transforms, composite augmenters."""
+    import mxnet_tpu.gluon.data.vision.transforms as T
+    import mxnet_tpu.image as img
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon.data import vision
+
+    ds = vision.CIFAR100(synthetic=True)
+    x, y = ds[0]
+    assert x.shape == (32, 32, 3) and 0 <= y < 100
+    ds20 = vision.CIFAR100(synthetic=True, fine_label=False)
+    assert all(0 <= ds20[i][1] < 20 for i in range(10))
+
+    im = nd.array(np.random.RandomState(0).randint(
+        0, 255, (8, 8, 3)).astype(np.uint8))
+    for t in (T.RandomSaturation(0.3), T.RandomHue(0.2),
+              T.RandomColorJitter(0.2, 0.2, 0.2, 0.1),
+              T.RandomLighting(0.1)):
+        out = t(im).asnumpy()
+        assert np.isfinite(out).all() and out.min() >= 0
+    assert T.CropResize(1, 1, 5, 5, size=4)(im).shape == (4, 4, 3)
+    assert T.CropResize(1, 1, 5, 5)(im).shape == (5, 5, 3)
+    # saturation=0 factor path: identity up to dtype
+    sat = T.RandomSaturation(0.0)(im).asnumpy()
+    assert np.allclose(sat, im.asnumpy().astype(np.float32), atol=1e-3)
+
+    seq = img.SequentialAug([img.CastAug(), img.ColorNormalizeAug(
+        nd.array(np.zeros(3, np.float32)),
+        nd.array(np.ones(3, np.float32)))])
+    assert seq(im).dtype == np.float32
+    assert img.ForceResizeAug((4, 6))(im).shape == (6, 4, 3)
+    assert img.RandomOrderAug([img.CastAug()])(im).dtype == np.float32

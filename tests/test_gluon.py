@@ -331,6 +331,12 @@ def test_groupnorm_block():
     ref = (xg - xg.mean(axis=(2, 3, 4), keepdims=True)) / np.sqrt(
         xg.var(axis=(2, 3, 4), keepdims=True) + 1e-5)
     assert np.allclose(y, ref.reshape(x.shape), atol=1e-4)
+    # affine params are per group (reference group_norm.cc): scaling
+    # group 0's gamma rescales exactly channels 0..C/G
+    gn.gamma.set_data(nd.array(np.array([2.0, 1.0], np.float32)))
+    y2 = gn(nd.array(x)).asnumpy()
+    assert np.allclose(y2[:, :2], 2 * y[:, :2], atol=1e-4)
+    assert np.allclose(y2[:, 2:], y[:, 2:], atol=1e-5)
 
 
 def test_bidirectional_cell_unroll():
@@ -338,6 +344,9 @@ def test_bidirectional_cell_unroll():
     l, r = gluon.rnn.LSTMCell(6), gluon.rnn.LSTMCell(6)
     bi = gluon.rnn.BidirectionalCell(l, r)
     bi.initialize(mx.init.Xavier())
+    # children registered exactly once (no checkpoint duplication)
+    assert len(bi.collect_params()) == len(l.collect_params()) + \
+        len(r.collect_params())
     seq = nd.random.uniform(shape=(2, 5, 4))
     out, states = bi.unroll(5, seq)
     assert out.shape == (2, 5, 12) and len(states) == 4
