@@ -44,6 +44,10 @@ _LAZY = {
     "rnn": ".rnn",
     "viz": ".visualization",
     "visualization": ".visualization",
+    "attribute": ".attribute",
+    "runtime": ".runtime",
+    "library": ".library",
+    "registry": ".registry",
 }
 
 

@@ -31,6 +31,16 @@ class _DownloadedDataset(Dataset):
             return self._transform(self._data[idx], self._label[idx])
         return self._data[idx], self._label[idx]
 
+    def _make_synthetic(self, image_shape, num_classes, seed):
+        """Shared no-egress fallback: deterministic random images."""
+        from ....ndarray import ndarray as _nd
+
+        n = 1024 if self._train else 256
+        rng = np.random.RandomState(seed)
+        data = rng.randint(0, 255, (n,) + image_shape).astype(np.uint8)
+        self._data = _nd.array(data, dtype=np.uint8)
+        self._label = rng.randint(0, num_classes, n).astype(np.int32)
+
     def __len__(self):
         return len(self._label)
 
@@ -63,12 +73,7 @@ class MNIST(_DownloadedDataset):
                 p += ".gz"
         if not (os.path.exists(img_path) or os.path.exists(img_path + ".gz")):
             if self._synthetic:
-                n = 1024 if self._train else 256
-                rng = np.random.RandomState(42)
-                data = rng.randint(0, 255, (n, 28, 28, 1)).astype(np.uint8)
-                label = rng.randint(0, 10, n).astype(np.int32)
-                self._data = _nd.array(data, dtype=np.uint8)
-                self._label = label
+                self._make_synthetic((28, 28, 1), 10, 42)
                 return
             raise MXNetError(
                 f"MNIST raw files not found under {self._root} "
@@ -108,11 +113,7 @@ class CIFAR10(_DownloadedDataset):
             else ["test_batch"]
         if not os.path.exists(base):
             if self._synthetic:
-                n = 1024 if self._train else 256
-                rng = np.random.RandomState(7)
-                data = rng.randint(0, 255, (n, 32, 32, 3)).astype(np.uint8)
-                self._data = _nd.array(data, dtype=np.uint8)
-                self._label = rng.randint(0, 10, n).astype(np.int32)
+                self._make_synthetic((32, 32, 3), 10, 7)
                 return
             raise MXNetError(
                 f"CIFAR10 batches not found under {base} (no egress)")
@@ -213,13 +214,8 @@ class CIFAR100(CIFAR10):
         base = os.path.join(self._root, "cifar-100-python")
         if not os.path.exists(base):
             if self._synthetic:
-                n = 1024 if self._train else 256
-                rng = np.random.RandomState(11)
-                data = rng.randint(0, 255, (n, 32, 32, 3)) \
-                    .astype(np.uint8)
-                self._data = _nd.array(data, dtype=np.uint8)
-                k = 100 if self._fine else 20
-                self._label = rng.randint(0, k, n).astype(np.int32)
+                self._make_synthetic((32, 32, 3),
+                                     100 if self._fine else 20, 11)
                 return
             raise MXNetError(
                 f"CIFAR100 batches not found under {base} (no egress)")

@@ -163,16 +163,17 @@ class RandomContrast(Block):
 
 
 class RandomSaturation(Block):
+    _gray_w = np.array([0.299, 0.587, 0.114], np.float32)
+
     def __init__(self, saturation):
         super().__init__()
         self._s = saturation
+        self._gw = _nd.array(self._gray_w)
 
     def forward(self, x):
         f = 1.0 + np.random.uniform(-self._s, self._s)
         xf = x.astype("float32")
-        gray = (xf * _nd.array(np.array([0.299, 0.587, 0.114],
-                                        np.float32))).sum(
-            axis=-1, keepdims=True)
+        gray = (xf * self._gw).sum(axis=-1, keepdims=True)
         return (xf * f + gray * (1 - f)).clip(0, 255)
 
 
@@ -181,20 +182,20 @@ class RandomHue(Block):
         super().__init__()
         self._h = hue
 
+    # the reference's YIQ transform matrices (image_random-inl.h)
+    _t_yiq = np.array([[0.299, 0.587, 0.114],
+                       [0.596, -0.274, -0.321],
+                       [0.211, -0.523, 0.311]], np.float32)
+    _t_rgb = np.array([[1.0, 0.956, 0.621],
+                       [1.0, -0.272, -0.647],
+                       [1.0, -1.107, 1.705]], np.float32)
+
     def forward(self, x):
-        # the reference's YIQ rotation matrix (image_random-inl.h)
-        alpha = np.random.uniform(-self._h, self._h)
-        theta = alpha * np.pi
+        theta = np.random.uniform(-self._h, self._h) * np.pi
         cs, sn = np.cos(theta), np.sin(theta)
-        t_yiq = np.array([[0.299, 0.587, 0.114],
-                          [0.596, -0.274, -0.321],
-                          [0.211, -0.523, 0.311]], np.float32)
-        t_rgb = np.array([[1.0, 0.956, 0.621],
-                          [1.0, -0.272, -0.647],
-                          [1.0, -1.107, 1.705]], np.float32)
         rot = np.array([[1, 0, 0], [0, cs, -sn], [0, sn, cs]],
                        np.float32)
-        m = t_rgb @ rot @ t_yiq
+        m = self._t_rgb @ rot @ self._t_yiq
         xf = x.astype("float32")
         return (xf.reshape((-1, 3)).dot(_nd.array(m.T))
                 .reshape(xf.shape)).clip(0, 255)

@@ -301,10 +301,20 @@ class BidirectionalCell(RecurrentCell):
 
     def __init__(self, l_cell, r_cell, **kwargs):
         super().__init__(**kwargs)
-        # plain attribute assignment auto-registers Block children
-        # (ModifierCell pattern) — register_child here would double-
-        # register and duplicate every weight in checkpoints
-        self._l, self._r = l_cell, r_cell
+        # a plain list bypasses Block.__setattr__ auto-registration, so
+        # each cell registers exactly once under the reference's child
+        # names (l_cell/r_cell) — checkpoint keys stay compatible
+        self._cells = [l_cell, r_cell]
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+
+    @property
+    def _l(self):
+        return self._cells[0]
+
+    @property
+    def _r(self):
+        return self._cells[1]
 
     def state_info(self, batch_size=0):
         return self._l.state_info(batch_size) + \

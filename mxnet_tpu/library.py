@@ -1,0 +1,64 @@
+"""External operator libraries (ref: python/mxnet/library.py —
+mx.library.load() for dynamic custom-op libs).
+
+The reference dlopens a .so that registers ops through the C API; the
+TPU-native analogue is a python plugin module that calls
+``mxnet_tpu.ops.registry.register`` (pure-jax kernels need no ABI).
+``load`` accepts a path to such a .py file, imports it (registration
+side effects run), and regenerates the nd/sym wrappers so the new ops
+appear on both fronts.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+from .base import MXNetError
+
+
+def load(path, verbose=True):
+    """Import a plugin file; its register() calls add ops to the shared
+    registry. Returns the loaded module."""
+    path = os.path.expanduser(path)
+    if not os.path.exists(path):
+        raise MXNetError(f"library not found: {path}")
+    if not path.endswith(".py"):
+        raise MXNetError(
+            "mxnet_tpu custom-op libraries are python plugin modules "
+            f"(pure-jax kernels), got {path!r}; see docs/MIGRATION.md")
+    name = "mxtpu_plugin_" + os.path.splitext(os.path.basename(path))[0]
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    from .ops import registry
+
+    before = set(registry.list_ops())
+    spec.loader.exec_module(mod)
+    added = sorted(set(registry.list_ops()) - before)
+    # surface the new ops (and their aliases) on BOTH generated fronts,
+    # mirroring the import-time codegen loops
+    from .ndarray import ops as _gen
+    from .ops.registry import get as _get
+    from .symbol import symbol as _sym
+
+    seen = set()
+    for op in added:
+        entry = _get(op)
+        if id(entry) in seen:
+            continue
+        seen.add(id(entry))
+        w = entry.wrapper or _gen.make_op_wrapper(entry)
+        if entry.wrapper is not None:
+            sw = _sym._unsupported_symbolically(entry)
+        elif entry.name in _sym._NN_PARAM_SUFFIX:
+            sw = _sym._make_nn_wrapper(entry)
+        else:
+            sw = _sym._sym_wrapper(entry)
+        for n in (entry.name,) + entry.aliases:
+            setattr(_gen, n, w)
+            if not hasattr(_sym, n):
+                setattr(_sym, n, sw)
+    if verbose and added:
+        print(f"loaded {len(added)} ops from {path}: {added}")
+    return mod

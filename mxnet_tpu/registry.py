@@ -1,0 +1,52 @@
+"""Generic class registries (ref: python/mxnet/registry.py — the
+get_register_func/get_create_func machinery behind mx.optimizer.register
+and friends)."""
+from __future__ import annotations
+
+from .base import MXNetError
+
+_REGISTRIES = {}
+
+
+def get_registry(base_class):
+    return dict(_REGISTRIES.setdefault(base_class, {}))
+
+
+def get_register_func(base_class, nickname):
+    reg = _REGISTRIES.setdefault(base_class, {})
+
+    def register(klass, name=None):
+        key = (name or klass.__name__).lower()
+        reg[key] = klass
+        return klass
+
+    register.__name__ = f"register_{nickname}"
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    def alias(*aliases):
+        def wrapper(klass):
+            reg = _REGISTRIES.setdefault(base_class, {})
+            for a in aliases:
+                reg[a.lower()] = klass
+            return klass
+
+        return wrapper
+
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    def create(name, *args, **kwargs):
+        if isinstance(name, base_class):
+            return name
+        reg = _REGISTRIES.setdefault(base_class, {})
+        key = str(name).lower()
+        if key not in reg:
+            raise MXNetError(
+                f"unknown {nickname} {name!r}; registered: {sorted(reg)}")
+        return reg[key](*args, **kwargs)
+
+    create.__name__ = f"create_{nickname}"
+    return create

@@ -1,0 +1,80 @@
+"""Runtime feature detection (ref: python/mxnet/runtime.py —
+mx.runtime.Features() / feature_list()).
+
+The reference reports compile-time flags (CUDA, MKLDNN, OPENCV...);
+here features are probed live: backend platforms, native C++
+libraries, Pallas availability.
+"""
+from __future__ import annotations
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self.enabled = bool(enabled)
+
+    def __repr__(self):
+        return f"[{'✔' if self.enabled else '✖'} {self.name}]"
+
+
+def _probe():
+    feats = {}
+    try:
+        import jax
+
+        platforms = {d.platform for d in jax.devices()}
+    except Exception:
+        platforms = set()
+    feats["TPU"] = "tpu" in platforms
+    feats["CPU"] = True
+    try:
+        from .utils import native
+
+        feats["NATIVE_IO"] = native.load() is not None
+    except Exception:
+        feats["NATIVE_IO"] = False
+    try:
+        from .utils import native_engine
+
+        feats["NATIVE_ENGINE"] = native_engine.load() is not None
+    except Exception:
+        feats["NATIVE_ENGINE"] = False
+    try:
+        from .storage import Storage
+
+        feats["NATIVE_STORAGE"] = Storage.get().native is not None
+    except Exception:
+        feats["NATIVE_STORAGE"] = False
+    import os
+
+    feats["CAPI"] = os.path.exists(os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "lib", "libmxtpu_capi.so"))
+    try:
+        import jax.experimental.pallas  # noqa: F401
+
+        feats["PALLAS"] = True
+    except Exception:
+        feats["PALLAS"] = False
+    feats["BF16"] = True
+    feats["INT8_QUANTIZATION"] = True
+    feats["DIST_KVSTORE"] = True
+    return feats
+
+
+class Features(dict):
+    """Mapping name -> Feature (ref: runtime.Features)."""
+
+    def __init__(self):
+        super().__init__({k: Feature(k, v) for k, v in _probe().items()})
+
+    def is_enabled(self, name):
+        key = name.upper()
+        if key not in self:
+            raise RuntimeError(f"unknown feature {name!r}; "
+                               f"known: {sorted(self)}")
+        return self[key].enabled
+
+
+def feature_list():
+    return list(Features().values())

@@ -240,3 +240,63 @@ def test_resource_manager():
         r.get_key()
     with _pytest.raises(mx.MXNetError):
         resource.request("bogus")
+
+
+def test_runtime_features():
+    """Ref: mx.runtime.Features — live capability probing."""
+    f = mx.runtime.Features()
+    assert f.is_enabled("CPU")
+    assert "NATIVE_ENGINE" in f and "PALLAS" in f
+    assert repr(f["CPU"]).startswith("[")
+    with pytest.raises(Exception):
+        f.is_enabled("WARP_DRIVE")
+    assert len(mx.runtime.feature_list()) == len(f)
+
+
+def test_library_plugin_load(tmp_path):
+    """Ref: mx.library.load — plugin ops land on the nd front."""
+    p = tmp_path / "plugops.py"
+    p.write_text(
+        "import jax.numpy as jnp\n"
+        "from mxnet_tpu.ops.registry import register\n"
+        "def _k_triple(a):\n"
+        "    return 3 * a\n"
+        "register('triple_test_op', _k_triple)\n")
+    mx.library.load(str(p), verbose=False)
+    out = nd.triple_test_op(nd.ones((3,)))
+    assert np.allclose(out.asnumpy(), 3.0)
+    from mxnet_tpu.base import MXNetError
+
+    with pytest.raises(MXNetError):
+        mx.library.load(str(tmp_path / "missing.py"))
+    so = tmp_path / "x.so"
+    so.write_bytes(b"\x7fELF")
+    with pytest.raises(MXNetError, match="python plugin"):
+        mx.library.load(str(so))
+
+
+def test_generic_registry():
+    """Ref: mx.registry register/create machinery."""
+
+    class Base:
+        pass
+
+    reg = mx.registry.get_register_func(Base, "widget")
+    alias = mx.registry.get_alias_func(Base, "widget")
+    create = mx.registry.get_create_func(Base, "widget")
+
+    @alias("frob")
+    @reg
+    class Foo(Base):
+        def __init__(self, v=1):
+            self.v = v
+
+    assert create("foo", v=7).v == 7
+    assert create("frob").v == 1
+    inst = Foo(3)
+    assert create(inst) is inst
+    from mxnet_tpu.base import MXNetError
+
+    with pytest.raises(MXNetError):
+        create("nope")
+    assert mx.attribute.AttrScope is mx.AttrScope
