@@ -48,6 +48,7 @@ _LAZY = {
     "runtime": ".runtime",
     "library": ".library",
     "registry": ".registry",
+    "kvstore_server": ".kvstore_server",
 }
 
 

@@ -2,6 +2,7 @@
 from __future__ import annotations
 
 import logging
+import sys
 import time
 
 
@@ -82,3 +83,21 @@ class BatchEndParam:
         self.nbatch = nbatch
         self.eval_metric = eval_metric
         self.locals = locals
+
+
+class ProgressBar:
+    """Text progress bar per batch (ref: mx.callback.ProgressBar —
+    `nbatch` is the 0-based batch index Module.fit emits)."""
+
+    def __init__(self, total, length=80):
+        self.total = max(int(total), 1)
+        self.length = int(length)
+
+    def __call__(self, param):
+        count = (param.nbatch % self.total) + 1
+        filled = int(self.length * count / self.total)
+        bar = "#" * filled + "-" * (self.length - filled)
+        sys.stdout.write(f"\r[{bar}] {count}/{self.total}")
+        if count == self.total:
+            sys.stdout.write("\n")
+        sys.stdout.flush()

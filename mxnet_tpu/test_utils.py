@@ -150,3 +150,15 @@ def list_gpus():
 
 def download(url, fname=None, dirname=None, overwrite=False):
     raise MXNetError("download unavailable: no network egress")
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    """Random 2-D shape (ref: test_utils.rand_shape_2d)."""
+    return (np.random.randint(1, dim0 + 1),
+            np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (np.random.randint(1, dim0 + 1),
+            np.random.randint(1, dim1 + 1),
+            np.random.randint(1, dim2 + 1))

@@ -300,3 +300,23 @@ def test_generic_registry():
     with pytest.raises(MXNetError):
         create("nope")
     assert mx.attribute.AttrScope is mx.AttrScope
+
+
+def test_progress_bar_and_rand_shapes():
+    import contextlib
+    import io as _io
+
+    from mxnet_tpu.callback import BatchEndParam, ProgressBar
+
+    pb = ProgressBar(total=4, length=10)
+    buf = _io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        # Module.fit emits 0-based nbatch (enumerate)
+        for i in range(4):
+            pb(BatchEndParam(epoch=0, nbatch=i, eval_metric=None,
+                             locals=None))
+    out = buf.getvalue()
+    assert "1/4" in out and "4/4" in out and "#" * 10 in out
+    assert len(mx.test_utils.rand_shape_2d()) == 2
+    assert len(mx.test_utils.rand_shape_3d()) == 3
+    assert hasattr(mx.kvstore_server, "main")
