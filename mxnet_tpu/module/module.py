@@ -142,9 +142,13 @@ class Module(BaseModule):
         self._symbol = symbol
         self._data_names = list(data_names)
         self._label_names = list(label_names or [])
-        self._context = context or current_context()
-        if isinstance(self._context, (list, tuple)):
-            self._context = self._context[0]  # multi-device via kvstore TODO
+        ctxs = context or current_context()
+        # multi-context = the reference's DataParallelExecutorGroup: one
+        # executor per device, batch split along axis 0, gradients
+        # summed across replicas in update()
+        self._contexts = list(ctxs) if isinstance(ctxs, (list, tuple)) \
+            else [ctxs]
+        self._context = self._contexts[0]
         self._fixed_param_names = set(fixed_param_names or [])
         # ref: Module(group2ctxs=...) → Executor::Bind group2ctx
         if isinstance(group2ctxs, (list, tuple)):
@@ -194,24 +198,37 @@ class Module(BaseModule):
         self._label_shapes = [_as_desc(l) for l in (label_shapes or [])]
         shape_kwargs = {d.name: d.shape for d in self._data_shapes}
         shape_kwargs.update({l.name: l.shape for l in self._label_shapes})
+        K = len(self._contexts)
+        if K > 1:
+            for d in self._data_shapes + self._label_shapes:
+                if d.shape and d.shape[0] % K:
+                    raise MXNetError(
+                        f"batch dim {d.shape[0]} of {d.name} must divide "
+                        f"across {K} contexts")
+            shape_kwargs = {
+                n: ((sh[0] // K,) + tuple(sh[1:])) if sh else sh
+                for n, sh in shape_kwargs.items()}
         arg_shapes, _, aux_shapes = self._symbol.infer_shape(**shape_kwargs)
         arg_names = self._symbol.list_arguments()
         aux_names = self._symbol.list_auxiliary_states()
-        args, grads = {}, {}
         input_names = set(self._data_names) | set(self._label_names)
-        req = {}
-        for name, shape in zip(arg_names, arg_shapes):
-            args[name] = _nd.zeros(shape, ctx=self._context)
-            if for_training and name not in input_names \
-                    and name not in self._fixed_param_names:
-                grads[name] = _nd.zeros(shape, ctx=self._context)
-                req[name] = grad_req
-            else:
-                req[name] = "null"
-        aux = {n: _nd.zeros(s, ctx=self._context)
-               for n, s in zip(aux_names, aux_shapes)}
-        self._exec = self._symbol.bind(self._context, args, grads, req, aux,
-                                       group2ctx=self._group2ctxs)
+        self._execs = []
+        for ctx in self._contexts:
+            # input shapes were already sliced via shape_kwargs above
+            args, grads, req = {}, {}, {}
+            for name, shape in zip(arg_names, arg_shapes):
+                args[name] = _nd.zeros(shape, ctx=ctx)
+                if for_training and name not in input_names \
+                        and name not in self._fixed_param_names:
+                    grads[name] = _nd.zeros(shape, ctx=ctx)
+                    req[name] = grad_req
+                else:
+                    req[name] = "null"
+            aux = {n: _nd.zeros(s, ctx=ctx)
+                   for n, s in zip(aux_names, aux_shapes)}
+            self._execs.append(self._symbol.bind(
+                ctx, args, grads, req, aux, group2ctx=self._group2ctxs))
+        self._exec = self._execs[0]
         self.binded = True
         self.for_training = for_training
         if shared_module is not None and shared_module.params_initialized:
@@ -241,7 +258,22 @@ class Module(BaseModule):
                     self._context)._data
             else:
                 initializer(name, arr)
+        self._sync_params_to_replicas()
         self.params_initialized = True
+
+    def _sync_params_to_replicas(self):
+        """Broadcast executor 0's params/aux to the other replicas
+        (ref: DataParallelExecutorGroup's param broadcast)."""
+        input_names = set(self._data_names) | set(self._label_names)
+        for ex in self._execs[1:]:
+            for name, arr in self._exec.arg_dict.items():
+                if name in input_names:
+                    continue  # batch slices are per-replica by design
+                ex.arg_dict[name]._data = arr.as_in_context(
+                    ex._ctx)._data
+            for name, arr in self._exec.aux_dict.items():
+                ex.aux_dict[name]._data = arr.as_in_context(
+                    ex._ctx)._data
 
     def get_params(self):
         input_names = set(self._data_names) | set(self._label_names)
@@ -294,35 +326,89 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized
         if is_train is None:
             is_train = self.for_training
-        feed = {}
+        K = len(self._execs)
+
+        def _slices(arr):
+            if arr.shape[0] % K:
+                raise MXNetError(
+                    f"batch of {arr.shape[0]} does not divide across "
+                    f"{K} contexts")
+            n = arr.shape[0] // K
+            return [arr[k * n:(k + 1) * n] for k in range(K)]
+
+        feeds = [{} for _ in range(K)]
         for name, arr in zip(self._data_names, data_batch.data):
-            feed[name] = arr
+            for k, piece in enumerate(_slices(arr) if K > 1 else [arr]):
+                feeds[k][name] = piece
         if data_batch.label is not None:
             for name, arr in zip(self._label_names, data_batch.label):
-                feed[name] = arr
-        self._exec.forward(is_train=is_train, **feed)
+                for k, piece in enumerate(_slices(arr) if K > 1
+                                          else [arr]):
+                    feeds[k][name] = piece
+        for ex, feed in zip(self._execs, feeds):
+            ex.forward(is_train=is_train, **feed)
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
-        self._exec.backward(out_grads)
+        K = len(self._execs)
+        if out_grads is None or K == 1:
+            for ex in self._execs:
+                ex.backward(out_grads)
+            return
+        # slice head cotangents per replica (ref:
+        # DataParallelExecutorGroup slices out_grads per device)
+        og = out_grads if isinstance(out_grads, (list, tuple)) \
+            else [out_grads]
+        n = og[0].shape[0] // K
+        for k, ex in enumerate(self._execs):
+            ex.backward([g[k * n:(k + 1) * n] for g in og])
 
     def update(self):
         assert self.optimizer_initialized
         input_names = set(self._data_names) | set(self._label_names)
+        multi = len(self._execs) > 1
         for i, name in enumerate(self._exec._arg_names):
             if name in input_names or name not in self._exec.grad_dict:
                 continue
-            self._updater(i, self._exec.grad_dict[name],
-                          self._exec.arg_dict[name])
+            if multi:
+                grad = _kvstore._reduce_sum(
+                    [ex.grad_dict[name] for ex in self._execs],
+                    self._context)
+            else:
+                grad = self._exec.grad_dict[name]
+            self._updater(i, grad, self._exec.arg_dict[name])
+        if multi:
+            self._sync_params_to_replicas()
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
         eval_metric.update(labels, self.get_outputs())
 
     def get_outputs(self, merge_multi_context=True):
-        return self._exec.outputs
+        if len(self._execs) == 1:
+            return self._exec.outputs
+        if not merge_multi_context:
+            # ref: list (per output) of lists (per context)
+            return [[ex.outputs[i] for ex in self._execs]
+                    for i in range(len(self._exec.outputs))]
+        from ..ndarray import concat
+
+        return [concat(*(ex.outputs[i].as_in_context(self._context)
+                         for ex in self._execs), dim=0)
+                for i in range(len(self._exec.outputs))]
 
     def get_input_grads(self, merge_multi_context=True):
-        return [self._exec.grad_dict.get(n) for n in self._data_names]
+        if len(self._execs) == 1:
+            return [self._exec.grad_dict.get(n)
+                    for n in self._data_names]
+        if not merge_multi_context:
+            return [[ex.grad_dict.get(n) for ex in self._execs]
+                    for n in self._data_names]
+        from ..ndarray import concat
+
+        return [concat(*(ex.grad_dict[n].as_in_context(self._context)
+                         for ex in self._execs), dim=0)
+                if self._exec.grad_dict.get(n) is not None else None
+                for n in self._data_names]
 
     # -- checkpoints (ref: module.py save_checkpoint/load) ------------------
 

@@ -596,15 +596,21 @@ class Executor:
 
     def forward(self, is_train=False, **kwargs):
         for k, v in kwargs.items():
+            # commit fed inputs to this executor's device (replica
+            # executors in a multi-context Module receive host slices)
             if k in self.arg_dict:
-                self.arg_dict[k]._data = _as_nd(v)._data
+                self.arg_dict[k]._data = _as_nd(v).as_in_context(
+                    self._ctx)._data
             else:
-                self.arg_dict[k] = _as_nd(v)
+                self.arg_dict[k] = _as_nd(v).as_in_context(self._ctx)
         if self._group2ctx:
             return self._forward_placed(is_train)
         feed = {n: self.arg_dict[n]._data for n in self._arg_names}
         feed.update({n: self.aux_dict[n]._data for n in self._aux_names})
-        key = _random.next_key()
+        import jax as _jax
+
+        key = _jax.device_put(_random.next_key(),
+                              self._ctx.jax_device())
         fn = _graph_fn(self._symbol, is_train)
         names = tuple(sorted(feed))
         raws = [feed[n] for n in names]

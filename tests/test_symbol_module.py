@@ -198,3 +198,54 @@ def test_export_and_symbolblock(tmp_path):
     ex.copy_params_from(arg_params, aux_params)
     ex.forward(data=x)
     assert np.allclose(ex.outputs[0].asnumpy(), ref, atol=1e-5)
+
+
+def test_module_multi_context_data_parallel():
+    """Ref: Module(context=[...]) — the DataParallelExecutorGroup role:
+    batch split across executors, grads summed, params broadcast.
+    Multi-ctx training must match single-ctx math exactly."""
+    import jax
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(128, 10).astype(np.float32)
+    W = rng.randn(10, 3).astype(np.float32)
+    Y = (X @ W).argmax(1).astype(np.float32)
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=8,
+                             name="mc_fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=3, name="mc_fc2")
+    net = sym.SoftmaxOutput(net, sym.var("softmax_label"),
+                            name="softmax")
+    ctxs = [mx.Context("cpu", i) for i in range(4)]
+
+    def run(ctx):
+        mx.random.seed(5)
+        np.random.seed(5)
+        it = NDArrayIter(X, Y, batch_size=32, shuffle=False)
+        m = Module(net, label_names=("softmax_label",), context=ctx)
+        m.fit(it, num_epoch=3, optimizer="sgd",
+              optimizer_params={"learning_rate": 0.5})
+        return m
+
+    m1, m4 = run(None), run(ctxs)
+    a1, a4 = m1.get_params()[0], m4.get_params()[0]
+    for k in a1:
+        assert np.allclose(a1[k].asnumpy(), a4[k].asnumpy(),
+                           atol=1e-4), k
+    # merged outputs keep the full batch on the primary context
+    from mxnet_tpu.io import DataBatch
+
+    m4.forward(DataBatch([nd.array(X[:32])],
+                             [nd.array(Y[:32])]), is_train=False)
+    out = m4.get_outputs()[0]
+    assert out.shape == (32, 3)
+    # per-replica view: list (per output) of lists (per context)
+    unmerged = m4.get_outputs(merge_multi_context=False)
+    assert len(unmerged) == 1 and len(unmerged[0]) == 4
+    assert unmerged[0][0].shape == (8, 3)
+    # indivisible batch rejected at bind
+    bad = Module(net, label_names=("softmax_label",),
+                 context=ctxs[:3])
+    with pytest.raises(Exception):
+        bad.bind(data_shapes=[("data", (32, 10))],
+                 label_shapes=[("softmax_label", (32,))])
