@@ -73,35 +73,85 @@ class MXRecordIO:
         assert not self.writable
         self.record.seek(pos)
 
+    def _write_chunk(self, chunk, cflag):
+        if len(chunk) > _LEN_MASK:
+            raise MXNetError(
+                "record chunk too large (>512MB between aligned magic "
+                "words) — the recordio length field cannot represent it")
+        lrec = (cflag << 29) | len(chunk)
+        self.record.write(struct.pack("<II", KMAGIC, lrec))
+        self.record.write(chunk)
+        pad = (4 - len(chunk) % 4) % 4
+        if pad:
+            self.record.write(b"\x00" * pad)
+
     def write(self, buf):
         assert self.writable
         if isinstance(buf, str):
             buf = buf.encode()
-        n = len(buf)
-        # single record, no continuation chunks (cflag=0); the reference
-        # splits >2^29 records into chunks — enforce the same limit
-        if n > _LEN_MASK:
-            raise MXNetError("record too large (>512MB); chunking TODO")
-        self.record.write(struct.pack("<II", KMAGIC, n))
-        self.record.write(buf)
-        pad = (4 - n % 4) % 4
-        if pad:
-            self.record.write(b"\x00" * pad)
+        # dmlc recordio escaping: the payload must never contain the
+        # magic word at a 4-byte boundary, so the writer splits the
+        # record at each aligned magic occurrence (dropping those 4
+        # bytes — the reader re-inserts them) and marks the pieces with
+        # the cflag in the top 3 bits of the length word
+        # (0 whole, 1 begin, 2 middle, 3 end)
+        view = memoryview(buf)
+        magic = struct.pack("<I", KMAGIC)
+        splits = [i for i in range(0, len(buf) - 3, 4)
+                  if buf[i:i + 4] == magic]
+        if not splits:
+            self._write_chunk(view, 0)
+            return
+        bounds = [0] + [p + 4 for p in splits]
+        ends = splits + [len(buf)]
+        n_chunks = len(bounds)
+        for i, (b, e) in enumerate(zip(bounds, ends)):
+            flag = 1 if i == 0 else (3 if i == n_chunks - 1 else 2)
+            self._write_chunk(view[b:e], flag)
 
-    def read(self):
-        assert not self.writable
+    def _read_chunk(self):
         header = self.record.read(8)
         if len(header) < 8:
-            return None
+            return None, 0
         magic, lrec = struct.unpack("<II", header)
         if magic != KMAGIC:
             raise MXNetError(f"{self.uri}: bad record magic {magic:#x}")
         n = lrec & _LEN_MASK
         data = self.record.read(n)
+        if len(data) != n:
+            raise MXNetError(
+                f"{self.uri}: truncated record (wanted {n} bytes, got "
+                f"{len(data)})")
         pad = (4 - n % 4) % 4
         if pad:
             self.record.read(pad)
-        return data
+        return data, lrec >> 29
+
+    def read(self):
+        assert not self.writable
+        data, cflag = self._read_chunk()
+        if data is None:
+            return None
+        if cflag == 0:
+            return data
+        if cflag != 1:
+            raise MXNetError(
+                f"{self.uri}: dangling continuation chunk (cflag={cflag})")
+        # the writer removed an aligned magic word at every split point;
+        # reassembly re-inserts it (dmlc RecordIOReader behavior)
+        magic = struct.pack("<I", KMAGIC)
+        parts = [data]
+        while True:
+            piece, cf = self._read_chunk()
+            if piece is None:
+                raise MXNetError(f"{self.uri}: truncated chunked record")
+            parts.append(magic)
+            parts.append(piece)
+            if cf == 3:
+                return b"".join(parts)
+            if cf != 2:
+                raise MXNetError(
+                    f"{self.uri}: bad continuation cflag {cf}")
 
 
 class MXIndexedRecordIO(MXRecordIO):

@@ -217,15 +217,35 @@ struct ImagePipeline {
   }
 
   bool ReadRecordAt(uint64_t off, std::vector<char>* buf) {
-    // thread-safe independent reads via pread on the raw fd
-    uint32_t hdr[2];
+    // thread-safe independent reads via pread on the raw fd.
+    // cflag continuation chunks (dmlc magic-escape splitting) are
+    // reassembled with the removed magic word re-inserted.
     int fd = fileno(f);
-    if (pread(fd, hdr, 8, off) != 8) return false;
-    if (hdr[0] != kMagic) return false;
-    uint32_t len = hdr[1] & kLenMask;
-    buf->resize(len);
-    return pread(fd, buf->data(), len, off + 8) ==
-           static_cast<ssize_t>(len);
+    buf->clear();
+    bool first = true;
+    while (true) {
+      uint32_t hdr[2];
+      if (pread(fd, hdr, 8, off) != 8) return false;
+      if (hdr[0] != kMagic) return false;
+      uint32_t len = hdr[1] & kLenMask;
+      uint32_t cflag = hdr[1] >> 29;
+      if (first && cflag != 0 && cflag != 1) return false;
+      if (!first) {
+        if (cflag != 2 && cflag != 3) return false;
+        uint32_t magic_word = kMagic;
+        const char* m = reinterpret_cast<const char*>(&magic_word);
+        buf->insert(buf->end(), m, m + 4);
+      }
+      size_t base = buf->size();
+      buf->resize(base + len);
+      if (pread(fd, buf->data() + base, len, off + 8) !=
+          static_cast<ssize_t>(len)) {
+        return false;
+      }
+      if (cflag == 0 || cflag == 3) return true;
+      off += 8 + len + ((4 - len % 4) % 4);
+      first = false;
+    }
   }
 
   void DecodeOne(const std::vector<char>& rec, float* out, float* label,
@@ -516,16 +536,33 @@ void* MXTPURecordIOReaderCreate(const char* path) {
 // returns length, 0 on EOF, -1 on error; data pointer valid until next call
 int64_t MXTPURecordIORead(void* handle, const char** out) {
   auto* r = static_cast<RecordReader*>(handle);
-  uint32_t hdr[2];
-  if (fread(hdr, 1, 8, r->f) != 8) return 0;
-  if (hdr[0] != kMagic) return -1;
-  uint32_t len = hdr[1] & kLenMask;
-  r->buf.resize(len);
-  if (fread(r->buf.data(), 1, len, r->f) != len) return -1;
-  size_t p = (4 - len % 4) % 4;
-  if (p) fseek(r->f, static_cast<long>(p), SEEK_CUR);
-  *out = r->buf.data();
-  return static_cast<int64_t>(len);
+  r->buf.clear();
+  bool first = true;
+  while (true) {
+    uint32_t hdr[2];
+    if (fread(hdr, 1, 8, r->f) != 8) return first ? 0 : -1;
+    if (hdr[0] != kMagic) return -1;
+    uint32_t len = hdr[1] & kLenMask;
+    uint32_t cflag = hdr[1] >> 29;
+    if (first && cflag != 0 && cflag != 1) return -1;
+    if (!first) {
+      if (cflag != 2 && cflag != 3) return -1;
+      // re-insert the magic word the writer removed at the split
+      uint32_t magic_word = kMagic;
+      const char* m = reinterpret_cast<const char*>(&magic_word);
+      r->buf.insert(r->buf.end(), m, m + 4);
+    }
+    size_t base = r->buf.size();
+    r->buf.resize(base + len);
+    if (fread(r->buf.data() + base, 1, len, r->f) != len) return -1;
+    size_t p = (4 - len % 4) % 4;
+    if (p) fseek(r->f, static_cast<long>(p), SEEK_CUR);
+    if (cflag == 0 || cflag == 3) {
+      *out = r->buf.data();
+      return static_cast<int64_t>(r->buf.size());
+    }
+    first = false;
+  }
 }
 
 void MXTPURecordIOSeek(void* handle, uint64_t pos) {

@@ -216,3 +216,33 @@ def test_synthetic_mnist_dataset():
     assert x.shape == (28, 28, 1)
     assert 0 <= int(y) <= 9
     assert len(ds) == 256
+
+
+def test_recordio_magic_escape_chunking(tmp_path):
+    """dmlc recordio escaping: payloads containing the magic word at a
+    4-byte boundary split into cflag continuation chunks (0 whole,
+    1 begin, 2 middle, 3 end); the reader re-inserts the removed magic
+    on reassembly."""
+    import struct
+
+    import mxnet_tpu.io.recordio as R
+
+    magic = struct.pack("<I", R.KMAGIC)
+    p = str(tmp_path / "escape.rec")
+    payloads = [
+        b"plain",
+        magic + b"lead",                    # magic at offset 0
+        b"abcd" + magic + b"tail",          # aligned interior magic
+        b"ab" + magic + b"cd",              # UNaligned: no split
+        b"wxyz" + magic + magic + b"end",   # consecutive magics
+        magic,                              # the whole record IS magic
+    ]
+    w = recordio.MXRecordIO(p, "w")
+    for pay in payloads:
+        w.write(pay)
+    w.close()
+    r = recordio.MXRecordIO(p, "r")
+    for pay in payloads:
+        assert r.read() == pay
+    assert r.read() is None
+    r.close()

@@ -165,3 +165,37 @@ def test_storage_bad_pool_type(monkeypatch):
     monkeypatch.setenv("MXTPU_MEM_POOL_TYPE", "Bogus")
     with pytest.raises(mx.MXNetError):
         storage.Storage()
+
+
+def test_native_reader_reassembles_chunked_records(tmp_path):
+    """The C++ reader must agree with the python writer on dmlc
+    magic-escape chunking (payloads containing the aligned magic word
+    split into cflag chunks; readers re-insert the magic)."""
+    import ctypes
+    import struct
+
+    from mxnet_tpu.io import recordio
+    from mxnet_tpu.utils import native
+
+    lib = native.load()
+    if lib is None:
+        pytest.skip("native io unavailable")
+    magic = struct.pack("<I", recordio.KMAGIC)
+    payloads = [b"plain", b"abcd" + magic + b"tail",
+                magic + magic + b"x", b"last"]
+    p = str(tmp_path / "esc.rec")
+    w = recordio.MXRecordIO(p, "w")
+    for pay in payloads:
+        w.write(pay)
+    w.close()
+    h = lib.MXTPURecordIOReaderCreate(p.encode())
+    assert h
+    try:
+        out = ctypes.c_char_p()
+        for pay in payloads:
+            n = lib.MXTPURecordIORead(h, ctypes.byref(out))
+            assert n == len(pay)
+            assert ctypes.string_at(out, n) == pay
+        assert lib.MXTPURecordIORead(h, ctypes.byref(out)) == 0
+    finally:
+        lib.MXTPURecordIOReaderFree(h)
