@@ -504,15 +504,49 @@ void* MXTPURecordIOWriterCreate(const char* path) {
   return w;
 }
 
+static bool WriteChunk(FILE* f, const char* data, uint64_t len,
+                       uint32_t cflag) {
+  if (len > kLenMask) return false;
+  uint32_t hdr[2] = {kMagic,
+                     (cflag << 29) | static_cast<uint32_t>(len)};
+  if (fwrite(hdr, 1, 8, f) != 8) return false;
+  if (len && fwrite(data, 1, len, f) != len) return false;
+  static const char pad[4] = {0, 0, 0, 0};
+  size_t p = (4 - len % 4) % 4;
+  if (p && fwrite(pad, 1, p, f) != p) return false;
+  return true;
+}
+
 int64_t MXTPURecordIOWrite(void* handle, const char* buf, uint64_t len) {
   auto* w = static_cast<RecordWriter*>(handle);
   int64_t pos = ftell(w->f);
-  uint32_t hdr[2] = {kMagic, static_cast<uint32_t>(len) & kLenMask};
-  if (fwrite(hdr, 1, 8, w->f) != 8) return -1;
-  if (fwrite(buf, 1, len, w->f) != len) return -1;
-  static const char pad[4] = {0, 0, 0, 0};
-  size_t p = (4 - len % 4) % 4;
-  if (p && fwrite(pad, 1, p, w->f) != p) return -1;
+  // dmlc magic-escape splitting, mirroring the python writer: split at
+  // every 4-byte-aligned magic occurrence in the payload
+  std::vector<uint64_t> splits;
+  for (uint64_t i = 0; i + 4 <= len; i += 4) {
+    uint32_t word;
+    std::memcpy(&word, buf + i, 4);
+    if (word == kMagic) splits.push_back(i);
+  }
+  if (splits.empty()) {
+    if (len > kLenMask) return -1;
+    if (!WriteChunk(w->f, buf, len, 0)) return -1;
+    return pos;
+  }
+  // validate every chunk before writing anything
+  uint64_t prev = 0;
+  for (size_t i = 0; i <= splits.size(); ++i) {
+    uint64_t end = (i < splits.size()) ? splits[i] : len;
+    if (end - prev > kLenMask) return -1;
+    prev = (i < splits.size()) ? splits[i] + 4 : end;
+  }
+  prev = 0;
+  for (size_t i = 0; i <= splits.size(); ++i) {
+    uint64_t end = (i < splits.size()) ? splits[i] : len;
+    uint32_t flag = (i == 0) ? 1u : (i == splits.size() ? 3u : 2u);
+    if (!WriteChunk(w->f, buf + prev, end - prev, flag)) return -1;
+    prev = (i < splits.size()) ? splits[i] + 4 : end;
+  }
   return pos;
 }
 

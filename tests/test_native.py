@@ -199,3 +199,30 @@ def test_native_reader_reassembles_chunked_records(tmp_path):
         assert lib.MXTPURecordIORead(h, ctypes.byref(out)) == 0
     finally:
         lib.MXTPURecordIOReaderFree(h)
+
+
+def test_native_writer_escapes_chunks(tmp_path):
+    """The C ABI writer must emit the same magic-escape chunking the
+    python writer does; the python reader verifies round-trip."""
+    import ctypes
+    import struct
+
+    from mxnet_tpu.io import recordio
+    from mxnet_tpu.utils import native
+
+    lib = native.load()
+    if lib is None:
+        pytest.skip("native io unavailable")
+    magic = struct.pack("<I", recordio.KMAGIC)
+    payloads = [b"plain", b"abcd" + magic + b"tail", magic + b"x"]
+    p = str(tmp_path / "nesc.rec")
+    h = lib.MXTPURecordIOWriterCreate(p.encode())
+    assert h
+    for pay in payloads:
+        assert lib.MXTPURecordIOWrite(h, pay, len(pay)) >= 0
+    lib.MXTPURecordIOWriterFree(h)
+    r = recordio.MXRecordIO(p, "r")
+    for pay in payloads:
+        assert r.read() == pay
+    assert r.read() is None
+    r.close()

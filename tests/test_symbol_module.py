@@ -249,3 +249,43 @@ def test_module_multi_context_data_parallel():
     with pytest.raises(Exception):
         bad.bind(data_shapes=[("data", (32, 10))],
                  label_shapes=[("softmax_label", (32,))])
+
+
+def test_bucketing_module_multi_context():
+    """BucketingModule passes context through to each bucket's Module,
+    so multi-device data parallelism composes with bucketing."""
+    from mxnet_tpu.io import DataBatch
+
+    def gen(bucket_key):
+        # params (embedding + head) are bucket-independent; only the
+        # sequence length varies — the shareable-weights contract
+        d = sym.Variable("data")
+        emb = sym.Embedding(d, input_dim=10, output_dim=6,
+                            name="bk_embed")
+        pooled = sym.mean(emb, axis=1)
+        net = sym.FullyConnected(pooled, num_hidden=4, name="bk_fc")
+        net = sym.SoftmaxOutput(net, sym.var("softmax_label"),
+                                name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    ctxs = [mx.Context("cpu", i) for i in range(2)]
+    bm = BucketingModule(gen, default_bucket_key=16, context=ctxs)
+    bm.bind(data_shapes=[("data", (8, 16))],
+            label_shapes=[("softmax_label", (8,))])
+    bm.init_params()
+    bm.init_optimizer(optimizer_params={"learning_rate": 0.1})
+    rng = np.random.RandomState(0)
+    for key in (16, 8, 16):
+        X = rng.randint(0, 10, (8, key)).astype(np.float32)
+        Y = rng.randint(0, 4, 8).astype(np.float32)
+        bm.switch_bucket(key, [("data", (8, key))],
+                         [("softmax_label", (8,))])
+        batch = DataBatch([nd.array(X)], [nd.array(Y)],
+                          bucket_key=key,
+                          provide_data=[("data", (8, key))],
+                          provide_label=[("softmax_label", (8,))])
+        bm.forward(batch)
+        bm.backward()
+        bm.update()
+        out = bm.get_outputs()[0]
+        assert out.shape == (8, 4)
