@@ -30,6 +30,8 @@ extern int MXTPUImperativeInvoke(const char* op_name, NDArrayHandle* in,
                                  const char** vals, int num_kwargs,
                                  NDArrayHandle* out, int* num_out);
 extern int MXTPUWaitAll(void);
+extern int MXTPUNDArraySave(const char* fname, NDArrayHandle* handles,
+                            const char** keys, int num);
 
 #define CHECK(cond, msg)                                            \
   do {                                                              \
@@ -60,7 +62,8 @@ static void* thread_invoke(void* arg) {
   return NULL;
 }
 
-int main(void) {
+int main(int argc, char** argv) {
+  const char* save_path = argc > 1 ? argv[1] : "/tmp/capi_saved.params";
   CHECK(MXTPUCAPIInit("cpu") == 0, "init");
 
   int n_ops = 0;
@@ -135,6 +138,12 @@ int main(void) {
                               &n_out) != 0, "bad act_type rejected");
 
   CHECK(MXTPUWaitAll() == 0, "waitall");
+
+  /* save in the reference-compatible .params container */
+  const char* save_keys[] = {"weight_a", "weight_b"};
+  NDArrayHandle pair[] = {a, b};
+  CHECK(MXTPUNDArraySave(save_path, pair, save_keys, 2) == 0,
+        "ndarray save");
 
   /* any-thread contract: a second OS thread must be able to call in
    * (the embedded interpreter's GIL is released between calls) */

@@ -49,7 +49,18 @@ def test_c_frontend_drives_the_framework(tmp_path):
     # the driver pins jax to cpu itself (MXTPUCAPIInit("cpu")); make sure
     # the axon plugin's env pin doesn't fight that in the subprocess
     env.pop("JAX_PLATFORMS", None)
-    r = subprocess.run([exe], capture_output=True, text=True, timeout=600,
-                       env=env, cwd=REPO)
+    save_path = str(tmp_path / "capi_saved.params")
+    r = subprocess.run([exe, save_path], capture_output=True, text=True,
+                       timeout=600, env=env, cwd=REPO)
     assert r.returncode == 0, (r.stdout[-1000:], r.stderr[-2000:])
     assert "CAPI_DRIVER_OK" in r.stdout
+    # the C frontend's save must be loadable by the python frontend
+    # (backend/path setup already done by conftest)
+    import numpy as np
+
+    from mxnet_tpu.ndarray import ndarray as _nd
+
+    loaded = _nd.load(save_path)
+    assert set(loaded) == {"weight_a", "weight_b"}
+    assert np.allclose(loaded["weight_a"].asnumpy(),
+                       np.arange(1, 7).reshape(2, 3))
