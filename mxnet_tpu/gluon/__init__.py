@@ -1,5 +1,6 @@
 """Gluon frontend (ref: python/mxnet/gluon/)."""
 from .block import Block, HybridBlock, CachedOp  # noqa: F401
+from .symbol_block import SymbolBlock  # noqa: F401
 from .parameter import (Parameter, ParameterDict, Constant,  # noqa: F401
                         DeferredInitializationError)
 from .trainer import Trainer  # noqa: F401

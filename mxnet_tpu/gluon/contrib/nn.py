@@ -2,6 +2,7 @@
 basic_layers.py)."""
 from __future__ import annotations
 
+from .. import nn as _nn
 from ..block import HybridBlock
 from ..nn import Embedding
 
@@ -80,3 +81,109 @@ class MoEFFN(HybridBlock):
     def hybrid_forward(self, F, x, router_weight, w1, b1, w2, b2):
         return F._contrib_MoEFFN(x, router_weight, w1, b1, w2, b2,
                                  capacity_factor=self._cf)
+
+
+class SyncBatchNorm(_nn.BatchNorm):
+    """Cross-device Batch Normalization (ref: contrib.nn.SyncBatchNorm,
+    src/operator/contrib/sync_batch_norm.cc).
+
+    TPU-native: under the compiled SPMD step the batch axis is sharded,
+    so the stats reductions are already global — this block then equals
+    BatchNorm.  Pass ``axis_name`` to pmean the per-shard statistics
+    when running under an explicit ``shard_map``/``pmap`` axis instead.
+    ``num_devices`` is accepted for API parity (the reference uses it to
+    size the key-value reduction); it does not change the math here.
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", axis_name=None,
+                 **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         center=center, scale=scale,
+                         use_global_stats=use_global_stats,
+                         beta_initializer=beta_initializer,
+                         gamma_initializer=gamma_initializer,
+                         running_mean_initializer=running_mean_initializer,
+                         running_variance_initializer=
+                         running_variance_initializer,
+                         in_channels=in_channels, **kwargs)
+        self._kwargs = {"eps": epsilon, "momentum": momentum,
+                        "fix_gamma": not scale,
+                        "use_global_stats": use_global_stats,
+                        "ndev": num_devices or 1}
+        if axis_name is not None:
+            self._kwargs["axis_name"] = axis_name
+
+    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        return F.contrib.SyncBatchNorm(x, gamma, beta, running_mean,
+                                       running_var, **self._kwargs)
+
+
+class PixelShuffle1D(HybridBlock):
+    """Upsample 1D by rearranging channels into length
+    (ref: contrib.nn.PixelShuffle1D).  (N, C*f, W) -> (N, C, W*f)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        self._factor = int(factor)
+
+    def hybrid_forward(self, F, x):
+        f = self._factor
+        x = F.reshape(x, shape=(0, -4, -1, f, 0))      # (N, C, f, W)
+        x = F.transpose(x, axes=(0, 1, 3, 2))          # (N, C, W, f)
+        return F.reshape(x, shape=(0, 0, -3))          # (N, C, W*f)
+
+    def __repr__(self):
+        return f"PixelShuffle1D({self._factor})"
+
+
+class PixelShuffle2D(HybridBlock):
+    """Upsample 2D: (N, C*f1*f2, H, W) -> (N, C, H*f1, W*f2)
+    (ref: contrib.nn.PixelShuffle2D)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        try:
+            f1, f2 = factor
+        except TypeError:
+            f1 = f2 = factor
+        self._factors = (int(f1), int(f2))
+
+    def hybrid_forward(self, F, x):
+        f1, f2 = self._factors
+        # (N, C, f1, f2, H, W) -> (N, C, H, f1, W, f2) -> merge
+        x = F.reshape(x, shape=(0, -4, -1, f1 * f2, 0, 0))
+        x = F.reshape(x, shape=(0, 0, -4, f1, f2, 0, 0))
+        x = F.transpose(x, axes=(0, 1, 4, 2, 5, 3))
+        return F.reshape(x, shape=(0, 0, -3, -3))
+
+    def __repr__(self):
+        return f"PixelShuffle2D({self._factors})"
+
+
+class PixelShuffle3D(HybridBlock):
+    """Upsample 3D: (N, C*f1*f2*f3, D, H, W) -> (N, C, D*f1, H*f2, W*f3)
+    (ref: contrib.nn.PixelShuffle3D)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        try:
+            f1, f2, f3 = factor
+        except TypeError:
+            f1 = f2 = f3 = factor
+        self._factors = (int(f1), int(f2), int(f3))
+
+    def hybrid_forward(self, F, x):
+        f1, f2, f3 = self._factors
+        x = F.reshape(x, shape=(0, -4, -1, f1 * f2 * f3, 0, 0, 0))
+        x = F.reshape(x, shape=(0, 0, -4, f1, f2 * f3, 0, 0, 0))
+        x = F.reshape(x, shape=(0, 0, 0, -4, f2, f3, 0, 0, 0))
+        x = F.transpose(x, axes=(0, 1, 5, 2, 6, 3, 7, 4))
+        return F.reshape(x, shape=(0, 0, -3, -3, -3))
+
+    def __repr__(self):
+        return f"PixelShuffle3D({self._factors})"

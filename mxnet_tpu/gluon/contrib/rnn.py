@@ -6,7 +6,7 @@ VariationalDropoutCell applies the SAME dropout mask at every time step
 from __future__ import annotations
 
 from ...base import MXNetError
-from ..rnn.rnn_cell import ModifierCell
+from ..rnn.rnn_cell import ModifierCell, RecurrentCell
 
 
 class VariationalDropoutCell(ModifierCell):
@@ -63,3 +63,210 @@ class VariationalDropoutCell(ModifierCell):
             if mo is not None:
                 out = out * mo
         return out, states
+
+
+# ---------------------------------------------------------------------------
+# Convolutional recurrent cells (ref: python/mxnet/gluon/contrib/rnn/
+# conv_rnn_cell.py — _BaseConvRNNCell and the Conv{1,2,3}D{RNN,LSTM,GRU}
+# Cell family).  Recurrence over feature maps: i2h and h2h are
+# convolutions instead of dense projections; h2h is SAME-padded so the
+# state keeps its spatial shape.
+
+
+class _BaseConvRNNCell(RecurrentCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, i2h_dilate, h2h_dilate, activation, num_gates,
+                 dims, num_states=1, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_channels = hidden_channels
+        self._input_shape = tuple(input_shape)  # (C, *spatial)
+        self._activation = activation
+        self._num_gates = num_gates
+        self._num_states = num_states
+        self._dims = dims
+
+        def _tup(v):
+            return (v,) * dims if isinstance(v, int) else tuple(v)
+
+        self._i2h_kernel = _tup(i2h_kernel)
+        self._i2h_pad = _tup(i2h_pad)
+        self._i2h_dilate = _tup(i2h_dilate)
+        self._h2h_kernel = _tup(h2h_kernel)
+        for k in self._h2h_kernel:
+            if k % 2 == 0:
+                raise MXNetError(
+                    f"h2h_kernel must be odd to preserve the state's "
+                    f"spatial shape, got {self._h2h_kernel}")
+        self._h2h_dilate = _tup(h2h_dilate)
+        self._h2h_pad = tuple(d * (k - 1) // 2 for k, d in
+                              zip(self._h2h_kernel, self._h2h_dilate))
+        c_in, *spatial = self._input_shape
+        # stride-1 conv output size
+        self._state_spatial = tuple(
+            s + 2 * p - d * (k - 1)
+            for s, p, d, k in zip(spatial, self._i2h_pad,
+                                  self._i2h_dilate, self._i2h_kernel))
+        g = num_gates * hidden_channels
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(g, c_in) + self._i2h_kernel,
+            init=i2h_weight_initializer)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(g, hidden_channels) + self._h2h_kernel,
+            init=h2h_weight_initializer)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(g,), init=i2h_bias_initializer)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(g,), init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        shape = (batch_size, self._hidden_channels) + self._state_spatial
+        return [{"shape": shape}] * self._num_states
+
+    def _conv_gates(self, F, x, h, i2h_weight, h2h_weight, i2h_bias,
+                    h2h_bias):
+        g = self._num_gates * self._hidden_channels
+        i2h = F.Convolution(x, i2h_weight, i2h_bias, kernel=self._i2h_kernel,
+                            num_filter=g, pad=self._i2h_pad,
+                            dilate=self._i2h_dilate)
+        h2h = F.Convolution(h, h2h_weight, h2h_bias,
+                            kernel=self._h2h_kernel, num_filter=g,
+                            pad=self._h2h_pad, dilate=self._h2h_dilate)
+        return i2h, h2h
+
+
+class _ConvRNNCell(_BaseConvRNNCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, i2h_dilate, h2h_dilate, activation, dims,
+                 **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, i2h_dilate, h2h_dilate,
+                         activation, 1, dims, **kwargs)
+
+    def hybrid_forward(self, F, x, h, i2h_weight, h2h_weight, i2h_bias,
+                       h2h_bias):
+        i2h, h2h = self._conv_gates(F, x, h, i2h_weight, h2h_weight,
+                                    i2h_bias, h2h_bias)
+        out = F.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class _ConvLSTMCell(_BaseConvRNNCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, i2h_dilate, h2h_dilate, activation, dims,
+                 **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, i2h_dilate, h2h_dilate,
+                         activation, 4, dims, num_states=2, **kwargs)
+
+    def hybrid_forward(self, F, x, h, c, i2h_weight, h2h_weight, i2h_bias,
+                       h2h_bias):
+        i2h, h2h = self._conv_gates(F, x, h, i2h_weight, h2h_weight,
+                                    i2h_bias, h2h_bias)
+        gates = i2h + h2h
+        i, f, g, o = F.split(gates, num_outputs=4, axis=1)
+        c_new = F.sigmoid(f) * c + F.sigmoid(i) * \
+            F.Activation(g, act_type=self._activation)
+        h_new = F.sigmoid(o) * F.Activation(c_new,
+                                            act_type=self._activation)
+        return h_new, [h_new, c_new]
+
+
+class _ConvGRUCell(_BaseConvRNNCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, i2h_dilate, h2h_dilate, activation, dims,
+                 **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, i2h_dilate, h2h_dilate,
+                         activation, 3, dims, **kwargs)
+
+    def hybrid_forward(self, F, x, h, i2h_weight, h2h_weight, i2h_bias,
+                       h2h_bias):
+        i2h, h2h = self._conv_gates(F, x, h, i2h_weight, h2h_weight,
+                                    i2h_bias, h2h_bias)
+        ir, iz, inn = F.split(i2h, num_outputs=3, axis=1)
+        hr, hz, hn = F.split(h2h, num_outputs=3, axis=1)
+        r = F.sigmoid(ir + hr)
+        z = F.sigmoid(iz + hz)
+        n = F.Activation(inn + r * hn, act_type=self._activation)
+        h_new = (1 - z) * n + z * h
+        return h_new, [h_new]
+
+
+def _make_conv_cell(base, dims, gate_kind):
+    class Cell(base):
+        def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                     h2h_kernel, i2h_pad=0, i2h_dilate=1, h2h_dilate=1,
+                     activation="tanh", **kwargs):
+            super().__init__(input_shape, hidden_channels, i2h_kernel,
+                             h2h_kernel, i2h_pad, i2h_dilate, h2h_dilate,
+                             activation, dims, **kwargs)
+
+    Cell.__name__ = f"Conv{dims}D{gate_kind}Cell"
+    Cell.__qualname__ = Cell.__name__
+    Cell.__doc__ = (f"Ref: contrib.rnn.Conv{dims}D{gate_kind}Cell "
+                    f"(conv_rnn_cell.py): {gate_kind} recurrence whose "
+                    f"i2h/h2h projections are {dims}D convolutions.")
+    return Cell
+
+
+Conv1DRNNCell = _make_conv_cell(_ConvRNNCell, 1, "RNN")
+Conv2DRNNCell = _make_conv_cell(_ConvRNNCell, 2, "RNN")
+Conv3DRNNCell = _make_conv_cell(_ConvRNNCell, 3, "RNN")
+Conv1DLSTMCell = _make_conv_cell(_ConvLSTMCell, 1, "LSTM")
+Conv2DLSTMCell = _make_conv_cell(_ConvLSTMCell, 2, "LSTM")
+Conv3DLSTMCell = _make_conv_cell(_ConvLSTMCell, 3, "LSTM")
+Conv1DGRUCell = _make_conv_cell(_ConvGRUCell, 1, "GRU")
+Conv2DGRUCell = _make_conv_cell(_ConvGRUCell, 2, "GRU")
+Conv3DGRUCell = _make_conv_cell(_ConvGRUCell, 3, "GRU")
+
+
+class LSTMPCell(RecurrentCell):
+    """LSTM with a projection layer on the hidden state (ref:
+    contrib.rnn.LSTMPCell, after Sak et al. 2014): the recurrent state r
+    is a lower-dim projection of the cell output, shrinking h2h and the
+    downstream layers.  Gate order (i, f, g, o) matches LSTMCell."""
+
+    def __init__(self, hidden_size, projection_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        h, p = hidden_size, projection_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(4 * h, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(4 * h, p),
+            init=h2h_weight_initializer)
+        self.h2r_weight = self.params.get(
+            "h2r_weight", shape=(p, h), init=h2r_weight_initializer)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(4 * h,), init=i2h_bias_initializer,
+            allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(4 * h,), init=h2h_bias_initializer,
+            allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size)},
+                {"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, x, r, c, i2h_weight, h2h_weight,
+                       h2r_weight, i2h_bias, h2h_bias):
+        gates = F.FullyConnected(x, i2h_weight, i2h_bias,
+                                 num_hidden=4 * self._hidden_size) + \
+            F.FullyConnected(r, h2h_weight, h2h_bias,
+                             num_hidden=4 * self._hidden_size)
+        i, f, g, o = F.split(gates, num_outputs=4, axis=-1)
+        c_new = F.sigmoid(f) * c + F.sigmoid(i) * F.tanh(g)
+        h_new = F.sigmoid(o) * F.tanh(c_new)
+        r_new = F.FullyConnected(h_new, h2r_weight, no_bias=True,
+                                 num_hidden=self._projection_size)
+        return r_new, [r_new, c_new]

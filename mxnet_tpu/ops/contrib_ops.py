@@ -736,3 +736,43 @@ register("_contrib_edge_id", _edge_id_wrapper,
          aliases=("edge_id",), nondiff=True,
          doc="Edge weights of (u,v) pairs in a CSR adjacency matrix; "
              "-1 where no edge. Ref contrib/dgl_graph.cc.")
+
+
+def _k_sync_batch_norm(data, gamma, beta, moving_mean, moving_var, *,
+                       eps=1e-3, momentum=0.9, fix_gamma=True,
+                       use_global_stats=False, output_mean_var=False,
+                       ndev=1, key=None, axis_name=None, _train=False):
+    """Cross-replica BatchNorm (ref: src/operator/contrib/sync_batch_norm
+    .cc — per-device stats reduced across the kvstore key ``key`` over
+    ``ndev`` devices).
+
+    TPU-native semantics: batch statistics are global over however the
+    batch is distributed.  Two regimes cover every parallel path here:
+
+    - GSPMD (DataParallelTrainer): the batch axis is *sharded*, not
+      replicated, so the fp32 stats reductions already produce the
+      global mean/var — XLA inserts the cross-chip collective.  ``ndev``
+      and ``key`` are accepted for API parity and not needed.
+    - shard_map/pmap with a named axis (``axis_name=...``): the local
+      (mean, E[x^2]) pair is ``lax.pmean``-ed over the axis — the
+      explicit analogue of the reference's engine-level reduce.
+
+    The math is _k_batch_norm's (ops/nn.py) with the reference's fixed
+    channel axis 1; only the axis_name plumbing differs.
+    """
+    from .nn import _k_batch_norm
+
+    return _k_batch_norm(data, gamma, beta, moving_mean, moving_var,
+                         eps=eps, momentum=momentum, fix_gamma=fix_gamma,
+                         use_global_stats=use_global_stats, axis=1,
+                         axis_name=axis_name, _train=_train)
+
+
+register("_contrib_SyncBatchNorm", _k_sync_batch_norm,
+         arg_names=("data", "gamma", "beta", "moving_mean", "moving_var"),
+         aliases=("SyncBatchNorm",), train_aware=True, num_outputs=3,
+         mutate_aux=((3, 1), (4, 2)),
+         doc="BatchNorm with cross-replica statistics. Under GSPMD the "
+             "sharded-batch reduction is already global; under shard_map "
+             "pass axis_name= to pmean the stats. Ref "
+             "contrib/sync_batch_norm.cc.")

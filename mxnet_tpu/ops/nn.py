@@ -241,13 +241,14 @@ def _bn_stats_use_pallas():
 def _k_batch_norm(data, gamma, beta, moving_mean, moving_var, *,
                   eps=1e-3, momentum=0.9, fix_gamma=True,
                   use_global_stats=False, output_mean_var=False, axis=1,
-                  cudnn_off=False, _train=False):
+                  cudnn_off=False, axis_name=None, _train=False):
     """Returns (out, new_moving_mean, new_moving_var).
 
     Functional form of the reference's stateful BatchNorm: the caller (nd
     wrapper or gluon layer) commits the updated moving stats.  Cross-
-    replica sync-BN is handled at the parallel layer via psum of
-    (sum, sqsum) — see parallel/.
+    replica sync-BN: pass ``axis_name`` to pmean the fp32 (mean, E[x^2])
+    stats over a shard_map/pmap axis (_contrib_SyncBatchNorm wraps this);
+    under GSPMD a sharded batch axis already reduces globally.
     """
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     axis = axis % data.ndim  # normalize negative axis (NHWC uses -1)
@@ -285,6 +286,8 @@ def _k_batch_norm(data, gamma, beta, moving_mean, moving_var, *,
             mean = jnp.mean(data, axis=red, dtype=jnp.float32)
             sumsq_mean = jnp.mean(jnp.square(data), axis=red,
                                   dtype=jnp.float32)
+        if axis_name:
+            mean, sumsq_mean = lax.pmean((mean, sumsq_mean), axis_name)
         # E[x^2]-E[x]^2 can cancel slightly negative in fp32; clamp so
         # rsqrt(var+eps) can't NaN on near-constant channels
         var = jnp.maximum(sumsq_mean - jnp.square(mean), 0.0)
