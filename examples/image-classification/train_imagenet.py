@@ -107,6 +107,11 @@ def main():
     p.add_argument("--lr-step-epochs", default="30,60,80")
     p.add_argument("--data-nthreads", type=int, default=8)
     p.add_argument("--disp-batches", type=int, default=20)
+    p.add_argument("--bulk-steps", type=int, default=1,
+                   help="run K steps per dispatch as one XLA "
+                        "computation (lax.scan bulk execution; the "
+                        "MXNET_EXEC_BULK_EXEC_TRAIN equivalent) — "
+                        "amortizes host dispatch latency")
     p.add_argument("--model-prefix", default="")
     add_cpu_flag(p)
     args = p.parse_args()
@@ -130,12 +135,28 @@ def main():
         if epoch in lr_steps:
             trainer.set_learning_rate(trainer.learning_rate * 0.1)
         tic, tic_n = time.time(), 0
-        for i in range(args.steps_per_epoch):
-            x, y = next(src)
-            loss = trainer.step(x, y)
-            step += 1
-            tic_n += args.batch_size
-            if i % args.disp_batches == 0 and i:
+        i = 0
+        while i < args.steps_per_epoch:
+            k = min(args.bulk_steps, args.steps_per_epoch - i)
+            if k > 1 and args.benchmark:
+                # synthetic batch: repeat mode transfers ONE batch
+                x, y = next(src)
+                loss = trainer.step_many(x, y, n_steps=k)[-1]
+            elif k > 1:
+                pairs = [next(src) for _ in range(k)]
+                xs = np.stack([p[0].asnumpy() if hasattr(p[0], "asnumpy")
+                               else np.asarray(p[0]) for p in pairs])
+                ys = np.stack([p[1].asnumpy() if hasattr(p[1], "asnumpy")
+                               else np.asarray(p[1]) for p in pairs])
+                loss = trainer.step_many(xs, ys)[-1]
+            else:
+                x, y = next(src)
+                loss = trainer.step(x, y)
+            prev = i
+            i += k
+            step += k
+            tic_n += args.batch_size * k
+            if i // args.disp_batches > prev // args.disp_batches:
                 loss.wait_to_read()
                 ips = tic_n / (time.time() - tic)
                 print(f"epoch {epoch} batch {i} loss "

@@ -163,6 +163,15 @@ class SymbolBlock(HybridBlock):
         (the reference composes via Symbol.__call__)."""
         from ..symbol.symbol import Symbol, _Node, _topo_order
 
+        if len(args) != len(self._in_names):
+            raise MXNetError(
+                f"SymbolBlock expects {len(self._in_names)} inputs "
+                f"({self._in_names}), got {len(args)}")
+        for a in args:
+            if not isinstance(a, Symbol):
+                raise MXNetError(
+                    "SymbolBlock symbolic compose expects all-Symbol "
+                    f"inputs, got {type(a).__name__}")
         sub = dict(zip(self._in_names, [a._node for a in args]))
         memo = {}
         for n in _topo_order([self._out_sym._node]):

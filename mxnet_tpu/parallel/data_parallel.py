@@ -67,6 +67,7 @@ class DataParallelTrainer:
         # (the reference's closest analogue is mirror/memonger).
         self._remat = bool(remat)
         self._step_fn = None
+        self._many_fns = {}
         self._n_inputs = 1
         self._named = None      # [(name, Parameter)]
         self._params = None     # list of raw jax arrays (device, sharded)
@@ -276,9 +277,62 @@ class DataParallelTrainer:
         # in_shardings check rejects them
         out_shardings = (repl, tuple(self._param_shardings), state_sh)
         donate = (0, 1) if self._donate else ()
+        self._step_core = step
+        self._in_shardings = in_shardings
+        self._out_shardings = out_shardings
         self._step_fn = jax.jit(step, in_shardings=in_shardings,
                                 out_shardings=out_shardings,
                                 donate_argnums=donate)
+        self._many_fns = {}
+
+    def _build_step_many(self, n_steps, stacked):
+        """Jit a lax.scan over `n_steps` applications of the step body —
+        the bulk-execution path (ref: MXNET_EXEC_BULK_EXEC_TRAIN pushes
+        whole graph segments to the engine in one go; here the whole
+        K-step TRAINING RUN is one XLA computation, so per-dispatch
+        latency — dominant through the remote device tunnel — is paid
+        once per K steps instead of every step).
+
+        `stacked`: True → x/y carry a leading (K,) axis with one
+        minibatch per step; False → the same device-resident batch is
+        reused every step (synthetic benchmark semantics).
+        """
+        step = self._step_core
+        (param_sh, state_sh, x_sh, y_sh, repl, _, _) = self._in_shardings
+
+        def many(params, states, x, y, keys, lr, t0):
+            def body(carry, inp):
+                params, states, t = carry
+                if stacked:
+                    key, xi, yi = inp
+                else:
+                    key = inp
+                    xi, yi = x, y
+                loss, params, states = step(params, states, xi, yi,
+                                            key, lr, t)
+                return (params, states, t + 1.0), loss
+            xs = (keys, x, y) if stacked else keys
+            (params, states, _), losses = jax.lax.scan(
+                body, (params, states, t0), xs)
+            return losses, params, states
+
+        if stacked:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def _stack_sh(sh):
+                return NamedSharding(self.mesh,
+                                     PartitionSpec(None, *sh.spec))
+            x_in = jax.tree.map(_stack_sh, x_sh)
+            y_in = _stack_sh(y_sh)
+        else:
+            x_in, y_in = x_sh, y_sh
+        fn = jax.jit(
+            many,
+            in_shardings=(param_sh, state_sh, x_in, y_in, repl, repl, repl),
+            out_shardings=(repl, param_sh, state_sh),
+            donate_argnums=(0, 1) if self._donate else ())
+        self._many_fns[(n_steps, stacked)] = fn
+        return fn
 
     def _apply_child_remat(self):
         """Wrap each eligible direct child block's forward in
@@ -369,6 +423,62 @@ class DataParallelTrainer:
             jnp.asarray(self._lr, jnp.float32),
             jnp.asarray(float(self._t), jnp.float32))
         return _wrap(loss)
+
+    def step_many(self, x, y, n_steps=None):
+        """Run K training steps as ONE compiled XLA computation
+        (lax.scan over the step body); returns the per-step losses as a
+        (K,) NDArray.
+
+        Two calling modes:
+        - ``step_many(xs, ys, n_steps=None)`` where ``xs``/``ys`` carry
+          a leading (K,) axis: one minibatch per scanned step (bulk
+          training over K pre-staged batches).
+        - ``step_many(x, y, n_steps=K)`` with plain batch shapes: the
+          same batch is re-used K times (synthetic-benchmark semantics,
+          ref: benchmark_score.py --benchmark 1).
+
+        Numerically identical to K ``step()`` calls — the same PRNG key
+        sequence is consumed — but per-dispatch latency is paid once.
+        """
+        multi = isinstance(x, (tuple, list))
+        if multi:
+            x = tuple(v._data if isinstance(v, NDArray) else v for v in x)
+        elif isinstance(x, NDArray):
+            x = x._data
+        if isinstance(y, NDArray):
+            y = y._data
+        stacked = n_steps is None
+        if stacked:
+            n_steps = int((x[0] if multi else x).shape[0])
+        if n_steps < 1:
+            raise MXNetError(f"step_many needs n_steps >= 1, got {n_steps}")
+        # build the single-step path first (shapes from ONE minibatch)
+        probe = tuple(v[0] for v in x) if (stacked and multi) else \
+            (x[0] if stacked else x)
+        self.build(probe)
+        fn = self._many_fns.get((n_steps, stacked)) or \
+            self._build_step_many(n_steps, stacked)
+        data_sh = mesh_mod.batch_sharding(self.mesh)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        if stacked:
+            put_sh = NamedSharding(self.mesh,
+                                   PartitionSpec(None, *data_sh.spec))
+        else:
+            put_sh = data_sh
+        if multi:
+            x = tuple(jax.device_put(jnp.asarray(v), put_sh) for v in x)
+        else:
+            x = jax.device_put(jnp.asarray(x), put_sh)
+        y = jax.device_put(jnp.asarray(y), put_sh)
+        # consume the SAME key sequence n individual step() calls would
+        keys = jnp.stack([_random.next_key() for _ in range(n_steps)])
+        t0 = jnp.asarray(float(self._t + 1), jnp.float32)
+        self._t += n_steps
+        losses, self._params, self._states = fn(
+            self._params, self._states, x, y, keys,
+            jnp.asarray(self._lr, jnp.float32), t0)
+        return _wrap(losses)
 
     @property
     def learning_rate(self):

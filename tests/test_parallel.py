@@ -290,3 +290,50 @@ def test_spmd_remat_matches_exact():
     for a, b in zip(params[False], params[True]):
         assert np.allclose(a, b, atol=1e-6)
     assert losses[True][-1] < losses[True][0]
+
+
+def test_step_many_matches_stepwise():
+    """step_many(K) is ONE XLA computation (lax.scan bulk execution,
+    ref: MXNET_EXEC_BULK_EXEC_TRAIN) and must reproduce K individual
+    step() calls exactly — same PRNG key sequence, same optimizer-state
+    trajectory."""
+
+    def build():
+        mx.random.seed(11)
+        np.random.seed(11)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(3))
+        net.initialize(mx.init.Xavier())
+        return data_parallel.DataParallelTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+            {"learning_rate": 0.01})
+
+    rng = np.random.RandomState(5)
+    K, bs, d = 4, 16, 8
+    xs = rng.rand(K, bs, d).astype(np.float32)
+    ys = rng.randint(0, 3, (K, bs)).astype(np.float32)
+
+    tr_a = build()
+    losses_a = [float(tr_a.step(xs[i], ys[i]).asscalar())
+                for i in range(K)]
+
+    # stacked mode: one minibatch per scanned step
+    tr_b = build()
+    losses_b = tr_b.step_many(xs, ys).asnumpy()
+    assert np.allclose(losses_a, losses_b, atol=1e-6), (losses_a, losses_b)
+    for a, b in zip(tr_a._params, tr_b._params):
+        assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    assert tr_a._t == tr_b._t == K
+
+    # repeat mode: same batch K times == K step() calls on that batch
+    tr_c = build()
+    losses_c1 = [float(tr_c.step(xs[0], ys[0]).asscalar())
+                 for i in range(K)]
+    tr_d = build()
+    losses_c2 = tr_d.step_many(xs[0], ys[0], n_steps=K).asnumpy()
+    assert np.allclose(losses_c1, losses_c2, atol=1e-6)
+
+    # interleaving with step() continues the same trajectory
+    more_a = float(tr_a.step(xs[0], ys[0]).asscalar())
+    more_b = float(tr_b.step(xs[0], ys[0]).asscalar())
+    assert np.allclose(more_a, more_b, atol=1e-6)
