@@ -431,3 +431,53 @@ def test_regression_outputs_per_example_grads():
     assert np.allclose(gl, p - l_np, atol=1e-5)
     gm = grad_of(nd.MAERegressionOutput)
     assert np.allclose(gm, np.sign(x_np - l_np), atol=1e-5)
+
+
+def test_batch_norm_fused_matches_autodiff(monkeypatch):
+    """The hand-written BN train fwd/bwd (one variadic reduce per
+    direction; default on) must match the autodiff reference path
+    (MXTPU_BN_FUSED=0) for out, moving stats, and all three grads."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import nn as opnn
+
+    rng = np.random.RandomState(42)
+    x = jnp.asarray(rng.randn(4, 5, 6, 7).astype(np.float32))
+    gamma = jnp.asarray(rng.rand(7).astype(np.float32) + 0.5)
+    beta = jnp.asarray(rng.randn(7).astype(np.float32))
+    mm = jnp.asarray(rng.randn(7).astype(np.float32))
+    mv = jnp.asarray(rng.rand(7).astype(np.float32) + 0.5)
+
+    # loss = sum(out * w) with fixed random w: sum(out^2) would have an
+    # analytically-zero dx (BN backward projects out the mean and the
+    # xhat component of dy), making bf16 dx pure cancellation noise
+    w = jnp.asarray(rng.randn(4, 5, 6, 7).astype(np.float32))
+
+    def run(fused, dtype):
+        monkeypatch.setenv("MXTPU_BN_FUSED", "1" if fused else "0")
+        xd = x.astype(dtype)
+
+        def f(xd, gamma, beta):
+            out, nmm, nmv = opnn._k_batch_norm(
+                xd, gamma, beta, mm, mv, eps=1e-3, momentum=0.9,
+                fix_gamma=False, axis=-1, _train=True)
+            return jnp.sum(out.astype(jnp.float32) * w), (nmm, nmv)
+
+        (val, (nmm, nmv)), grads = jax.value_and_grad(
+            f, argnums=(0, 1, 2), has_aux=True)(xd, gamma, beta)
+        return val, nmm, nmv, grads
+
+    # bf16: both paths round differently (fused keeps everything fp32
+    # until the final dx cast; autodiff rounds per-op) — ~5% on sums
+    for dtype in (jnp.float32, jnp.bfloat16):
+        tol = 1e-5 if dtype == jnp.float32 else 8e-2
+        va, ma, va_, ga = run(False, dtype)
+        vb, mb, vb_, gb = run(True, dtype)
+        assert np.allclose(float(va), float(vb), rtol=tol), (va, vb)
+        assert np.allclose(np.asarray(ma), np.asarray(mb), atol=tol)
+        assert np.allclose(np.asarray(va_), np.asarray(vb_), atol=tol)
+        for a, b in zip(ga, gb):
+            assert np.allclose(np.asarray(a, np.float32),
+                               np.asarray(b, np.float32),
+                               atol=tol, rtol=tol), (a, b)

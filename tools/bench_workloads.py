@@ -1,0 +1,215 @@
+"""Secondary workload benchmarks on the current backend (TPU by default).
+
+The driver's headline bench (bench.py) is ResNet-50; this tool covers
+the other BASELINE-class workloads and the custom kernels, one JSON
+line per subcommand (ref: example/image-classification/
+benchmark_score.py + tools/bandwidth/measure.py roles):
+
+  python tools/bench_workloads.py bert        # BERT-base MLM train step
+  python tools/bench_workloads.py attention   # pallas flash vs XLA sdpa
+  python tools/bench_workloads.py rnn         # pallas LSTM vs lax.scan
+  python tools/bench_workloads.py all
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _setup_jax():
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(REPO, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    return jax
+
+
+def _peak_flops(dev):
+    sys.path.insert(0, REPO)
+    from bench import _peak_flops as pf
+
+    return pf(dev.device_kind) if dev.platform == "tpu" else None
+
+
+def bench_bert(bs=32, seq_len=128, steps=20):
+    """BERT-base MLM+NSP training step (BASELINE config #3)."""
+    jax = _setup_jax()
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import random as _random
+    from mxnet_tpu.models import bert as bert_mod
+    from mxnet_tpu.parallel import data_parallel
+
+    sys.path.insert(0, os.path.join(REPO, "examples", "bert"))
+    sys.path.insert(0, os.path.join(REPO, "examples"))
+    from pretrain_bert import BERTForPretrain, synthetic_batch
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    vocab = 30522
+    model = bert_mod.bert_base(vocab_size=vocab)
+    net = BERTForPretrain(model, vocab)
+    net.initialize(mx.init.Xavier())
+
+    class _Identity:
+        def __call__(self, out, _):
+            return out
+
+    trainer = data_parallel.DataParallelTrainer(
+        net, _Identity(), "adamw", {"learning_rate": 1e-4, "wd": 0.01},
+        compute_dtype="bfloat16")
+    x = synthetic_batch(rng, bs, seq_len, vocab)
+    y = np.zeros((bs,), np.float32)  # unused by the loss head
+
+    trainer.step(x, y).wait_to_read()
+    trainer.step_many(x, y, n_steps=steps).asnumpy()  # compile scan
+    dt = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        losses = trainer.step_many(x, y, n_steps=steps)
+        losses.asnumpy()
+        w = time.perf_counter() - t0
+        dt = w if dt is None or w < dt else dt
+    tokens_per_sec = steps * bs * seq_len / dt
+
+    flops = None
+    try:
+        from mxnet_tpu.parallel import mesh as mesh_mod
+
+        lowered = trainer._step_fn.lower(
+            trainer._params, trainer._states,
+            tuple(jnp.asarray(v) for v in x), jnp.asarray(y),
+            _random.next_key(), jnp.asarray(1e-4, jnp.float32),
+            jnp.asarray(3.0, jnp.float32))
+        cost = lowered.cost_analysis()
+        c = cost[0] if isinstance(cost, (list, tuple)) else cost
+        flops = float(c.get("flops", 0.0)) or None
+    except Exception:
+        pass
+    dev = jax.devices()[0]
+    # cost_analysis FLOPs cover the GLOBAL batch over the dp mesh, so
+    # peak must aggregate every chip the step ran on (as bench.py does)
+    chip_peak = _peak_flops(dev)
+    n_chips = len(trainer.mesh.devices.flat)
+    peak = chip_peak * n_chips if chip_peak else None
+    mfu = (flops * steps / dt / peak) if (flops and peak) else None
+    print(json.dumps({
+        "metric": "bert_base_mlm_throughput", "value": round(tokens_per_sec),
+        "unit": "tokens/sec", "mfu": round(mfu, 4) if mfu else None,
+        "batch_size": bs, "seq_len": seq_len,
+        "device_kind": dev.device_kind, "platform": dev.platform,
+        "final_loss": round(float(losses.asnumpy()[-1]), 4)}))
+
+
+def bench_attention(bs=8, heads=16, seq=2048, hd=64, iters=20):
+    """Pallas flash attention vs the XLA reference sdpa (fwd+bwd)."""
+    jax = _setup_jax()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mxnet_tpu.ops.attention import sdpa_reference
+    from mxnet_tpu.ops.pallas import flash_attention as fa
+
+    rng = np.random.RandomState(0)
+    shape = (bs, heads, seq, hd)
+    q, k, v = (jnp.asarray(rng.randn(*shape).astype(np.float32),
+                           jnp.bfloat16) for _ in range(3))
+
+    def time_fn(f):
+        g = jax.jit(jax.grad(lambda q, k, v:
+                             jnp.sum(f(q, k, v).astype(jnp.float32)),
+                             argnums=(0, 1, 2)))
+        g(q, k, v)[0].block_until_ready()  # compile
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = g(q, k, v)
+            out[0].block_until_ready()
+            w = (time.perf_counter() - t0) / iters
+            best = w if best is None or w < best else best
+        return best
+
+    t_flash = time_fn(lambda q, k, v: fa.flash_attention(q, k, v,
+                                                         causal=True))
+    t_ref = time_fn(lambda q, k, v: sdpa_reference(q, k, v, causal=True))
+    dev = jax.devices()[0]
+    print(json.dumps({
+        "metric": "flash_attention_fwdbwd_ms",
+        "value": round(t_flash * 1e3, 3), "unit": "ms",
+        "xla_reference_ms": round(t_ref * 1e3, 3),
+        "speedup_vs_xla": round(t_ref / t_flash, 3),
+        "shape": list(shape), "causal": True,
+        "device_kind": dev.device_kind, "platform": dev.platform}))
+
+
+def bench_rnn(bs=64, seq=256, input_size=512, hidden=512, iters=10):
+    """Fused Pallas LSTM vs the lax.scan path (fwd only, inference)."""
+    jax = _setup_jax()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mxnet_tpu.ops import rnn as rnn_ops
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(seq, bs, input_size).astype(np.float32))
+    params = jnp.asarray(rng.randn(
+        rnn_ops.rnn_param_size(1, input_size, hidden, "lstm"))
+        .astype(np.float32) * 0.05)
+    h0 = jnp.zeros((1, bs, hidden), jnp.float32)
+    c0 = jnp.zeros((1, bs, hidden), jnp.float32)
+
+    def time_mode(use_pallas):
+        os.environ["MXTPU_RNN_IMPL"] = "pallas" if use_pallas else "scan"
+        fn = jax.jit(lambda x, p, h, c: rnn_ops._k_rnn(
+            x, p, h, c, state_size=hidden, num_layers=1,
+            mode="lstm", state_outputs=True)[0])
+        fn(x, params, h0, c0).block_until_ready()
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(x, params, h0, c0)
+            out.block_until_ready()
+            w = (time.perf_counter() - t0) / iters
+            best = w if best is None or w < best else best
+        return best
+
+    try:
+        t_pallas = time_mode(True)
+    finally:
+        t_scan = time_mode(False)
+        os.environ.pop("MXTPU_RNN_IMPL", None)
+    dev = jax.devices()[0]
+    print(json.dumps({
+        "metric": "lstm_fwd_ms", "value": round(t_pallas * 1e3, 3),
+        "unit": "ms", "lax_scan_ms": round(t_scan * 1e3, 3),
+        "speedup_vs_scan": round(t_scan / t_pallas, 3),
+        "shape": [seq, bs, input_size], "hidden": hidden,
+        "device_kind": dev.device_kind, "platform": dev.platform}))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("which", choices=["bert", "attention", "rnn", "all"])
+    p.add_argument("--batch-size", type=int, default=None,
+                   help="override the per-benchmark default batch size")
+    args = p.parse_args()
+    bs_kw = {"bs": args.batch_size} if args.batch_size else {}
+    if args.which in ("bert", "all"):
+        bench_bert(**bs_kw)
+    if args.which in ("attention", "all"):
+        bench_attention(**bs_kw)
+    if args.which in ("rnn", "all"):
+        bench_rnn(**bs_kw)
+
+
+if __name__ == "__main__":
+    main()
