@@ -49,6 +49,13 @@ _LAZY = {
     "library": ".library",
     "registry": ".registry",
     "kvstore_server": ".kvstore_server",
+    "model": ".model",
+    "name": ".name",
+    "executor": ".executor",
+    "libinfo": ".libinfo",
+    "log": ".log",
+    "util": ".util",
+    "rtc": ".rtc",
 }
 
 

@@ -1,0 +1,10 @@
+"""Executor namespace (ref: python/mxnet/executor.py).
+
+The reference keeps `Executor` in its own module; here the class lives
+with the symbolic graph (`symbol/symbol.py`) since bind-time planning
+is XLA's job, but `mx.executor.Executor` remains importable for ported
+scripts.
+"""
+from .symbol.symbol import Executor  # noqa: F401
+
+__all__ = ["Executor"]

@@ -1,0 +1,35 @@
+"""Library/version info (ref: python/mxnet/libinfo.py).
+
+`find_lib_path()` locates the native runtime libraries this package
+builds (`lib/libmxtpu_*.so`) the way the reference locates
+`libmxnet.so`.
+"""
+from __future__ import annotations
+
+import os
+
+from .base import __version__  # noqa: F401
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def find_lib_path():
+    """Return paths of the built native libraries, engine first.
+
+    Raises RuntimeError when none are built yet (the reference raises
+    when libmxnet.so is absent).
+    """
+    libdir = os.path.join(_REPO, "lib")
+    order = ["libmxtpu_engine.so", "libmxtpu_storage.so",
+             "libmxtpu_io.so", "libmxtpu_capi.so"]
+    paths = [os.path.join(libdir, n) for n in order
+             if os.path.exists(os.path.join(libdir, n))]
+    if not paths:
+        raise RuntimeError(
+            f"native libraries not found under {libdir}; run `make`")
+    return paths
+
+
+def find_include_path():
+    """Return the C ABI header directory (ref: find_include_path)."""
+    return os.path.join(_REPO, "src")

@@ -1,0 +1,30 @@
+"""Runtime compilation namespace (ref: python/mxnet/rtc.py).
+
+The reference's `CudaModule` JIT-compiles CUDA source at runtime for
+custom pointwise kernels. On TPU that role is covered natively:
+
+- pointwise chains are fused by XLA automatically (the reason the
+  reference grew RTC no longer exists), and
+- genuinely custom kernels are written as Pallas kernels
+  (`mxnet_tpu/ops/pallas/`) or registered as custom ops
+  (`mx.operator.CustomOp`).
+
+The classes below exist so ported scripts fail with a pointed message
+instead of an AttributeError. See docs/MIGRATION.md.
+"""
+from .base import MXNetError
+
+_MSG = ("mx.rtc is CUDA runtime compilation and has no TPU equivalent: "
+        "XLA fuses elementwise chains automatically; write custom "
+        "kernels with Pallas (mxnet_tpu/ops/pallas) or "
+        "mx.operator.CustomOp instead. See docs/MIGRATION.md.")
+
+
+class CudaModule:
+    def __init__(self, *args, **kwargs):
+        raise MXNetError(_MSG)
+
+
+class CudaKernel:
+    def __init__(self, *args, **kwargs):
+        raise MXNetError(_MSG)

@@ -78,13 +78,10 @@ class _Node:
         self.inputs = inputs  # list of (Symbol-node, out_index)
 
 
-_name_counter = {}
-
-
 def _auto_name(op):
-    i = _name_counter.get(op, 0)
-    _name_counter[op] = i + 1
-    return f"{op.lower()}{i}"
+    from ..name import NameManager
+
+    return NameManager.current().get(None, op.lower())
 
 
 class Symbol:

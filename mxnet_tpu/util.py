@@ -1,0 +1,55 @@
+"""Misc utilities (ref: python/mxnet/util.py).
+
+The numpy-semantics toggles (`is_np_array`/`is_np_shape`) exist for
+script compatibility and report the classic MXNet semantics this
+framework implements (scalar tensors and zero-size arrays are
+supported natively by jax, so the toggle is a constant).
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+
+def makedirs(d):
+    """mkdir -p (ref: mx.util.makedirs)."""
+    os.makedirs(os.path.expanduser(d), exist_ok=True)
+
+
+def is_np_shape():
+    return False
+
+
+def is_np_array():
+    return False
+
+
+def use_np_shape(func):
+    """No-op decorator: numpy-style shapes are always available."""
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        return func(*args, **kwargs)
+    return wrapper
+
+
+use_np = use_np_shape
+use_np_array = use_np_shape
+
+
+def get_gpu_count():
+    from .context import num_gpus
+
+    return num_gpus()
+
+
+def get_gpu_memory(dev_id=0):
+    """Per-device (free, total) memory in bytes, via PjRt stats."""
+    import jax
+
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    if dev_id >= len(devs):
+        raise ValueError(f"no accelerator device {dev_id}")
+    stats = devs[dev_id].memory_stats() or {}
+    total = stats.get("bytes_limit", 0)
+    free = total - stats.get("bytes_in_use", 0)
+    return free, total

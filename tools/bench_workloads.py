@@ -184,8 +184,8 @@ def bench_rnn(bs=64, seq=256, input_size=512, hidden=512, iters=10):
 
     try:
         t_pallas = time_mode(True)
-    finally:
         t_scan = time_mode(False)
+    finally:
         os.environ.pop("MXTPU_RNN_IMPL", None)
     dev = jax.devices()[0]
     print(json.dumps({
