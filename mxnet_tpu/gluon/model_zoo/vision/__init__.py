@@ -4,11 +4,13 @@ from .resnet import (get_resnet, resnet18_v1, resnet34_v1, resnet50_v1,  # noqa:
                      resnet50_v2, resnet101_v2, resnet152_v2, ResNetV1,
                      ResNetV2)
 from .others import (alexnet, lenet, AlexNet, LeNet, VGG, get_vgg, vgg11,  # noqa: F401
-                     vgg13, vgg16, vgg19, vgg16_bn, vgg19_bn, MobileNet,
-                     MobileNetV2, mobilenet1_0, mobilenet0_5, mobilenet0_25,
-                     mobilenet_v2_1_0, SqueezeNet, squeezenet1_0,
-                     squeezenet1_1, DenseNet, densenet121, densenet169,
-                     densenet201)
+                     vgg13, vgg16, vgg19, vgg11_bn, vgg13_bn, vgg16_bn,
+                     vgg19_bn, MobileNet, MobileNetV2, mobilenet1_0,
+                     mobilenet0_75, mobilenet0_5, mobilenet0_25,
+                     mobilenet_v2_1_0, mobilenet_v2_0_75, mobilenet_v2_0_5,
+                     mobilenet_v2_0_25, SqueezeNet, squeezenet1_0,
+                     squeezenet1_1, DenseNet, densenet121, densenet161,
+                     densenet169, densenet201)
 from .inception import Inception3, inception_v3  # noqa: F401
 
 _models = {k: v for k, v in globals().items() if callable(v)

@@ -288,6 +288,14 @@ def vgg19(**kw):
     return get_vgg(19, **kw)
 
 
+def vgg11_bn(**kw):
+    return get_vgg(11, batch_norm=True, **kw)
+
+
+def vgg13_bn(**kw):
+    return get_vgg(13, batch_norm=True, **kw)
+
+
 def vgg16_bn(**kw):
     return get_vgg(16, batch_norm=True, **kw)
 
@@ -306,6 +314,11 @@ def mobilenet0_5(**kw):
     return MobileNet(0.5, **kw)
 
 
+def mobilenet0_75(**kw):
+    kw.pop("pretrained", None)
+    return MobileNet(0.75, **kw)
+
+
 def mobilenet0_25(**kw):
     kw.pop("pretrained", None)
     return MobileNet(0.25, **kw)
@@ -314,6 +327,21 @@ def mobilenet0_25(**kw):
 def mobilenet_v2_1_0(**kw):
     kw.pop("pretrained", None)
     return MobileNetV2(1.0, **kw)
+
+
+def mobilenet_v2_0_75(**kw):
+    kw.pop("pretrained", None)
+    return MobileNetV2(0.75, **kw)
+
+
+def mobilenet_v2_0_5(**kw):
+    kw.pop("pretrained", None)
+    return MobileNetV2(0.5, **kw)
+
+
+def mobilenet_v2_0_25(**kw):
+    kw.pop("pretrained", None)
+    return MobileNetV2(0.25, **kw)
 
 
 def squeezenet1_0(**kw):
@@ -329,6 +357,11 @@ def squeezenet1_1(**kw):
 def densenet121(**kw):
     kw.pop("pretrained", None)
     return DenseNet(*densenet_spec[121], **kw)
+
+
+def densenet161(**kw):
+    kw.pop("pretrained", None)
+    return DenseNet(*densenet_spec[161], **kw)
 
 
 def densenet169(**kw):

@@ -5,7 +5,8 @@ from .basic_layers import (Sequential, HybridSequential, Dense, Dropout,  # noqa
                            InstanceNorm, GroupNorm, Lambda,
                            HybridLambda)
 from .conv_layers import (Conv1D, Conv2D, Conv3D, Conv1DTranspose,  # noqa: F401
-                          Conv2DTranspose, MaxPool1D, MaxPool2D, MaxPool3D,
+                          Conv2DTranspose, Conv3DTranspose,
+                          MaxPool1D, MaxPool2D, MaxPool3D,
                           AvgPool1D, AvgPool2D, AvgPool3D, GlobalMaxPool1D,
                           GlobalMaxPool2D, GlobalMaxPool3D, GlobalAvgPool1D,
                           GlobalAvgPool2D, GlobalAvgPool3D, ReflectionPad2D)

@@ -125,6 +125,16 @@ class Conv1DTranspose(_Conv):
                          adj=_pair(output_padding, 1), **kwargs)
 
 
+class Conv3DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), output_padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW", **kwargs):
+        super().__init__(channels, _pair(kernel_size, 3), _pair(strides, 3),
+                         _pair(padding, 3), _pair(dilation, 3), groups,
+                         layout, op_name="Deconvolution",
+                         adj=_pair(output_padding, 3), **kwargs)
+
+
 class _Pooling(HybridBlock):
     def __init__(self, pool_size, strides, padding, ceil_mode, global_pool,
                  pool_type, count_include_pad=None, layout=None, **kwargs):

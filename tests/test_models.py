@@ -94,6 +94,32 @@ def test_mobilenet_squeezenet_smoke():
         assert out.shape == (1, 4)
 
 
+def test_model_zoo_width_variants():
+    """Every reference factory name resolves; cheapest variant runs."""
+    for name in ("densenet161", "mobilenet0_75", "mobilenet_v2_0_75",
+                 "mobilenet_v2_0_5", "vgg11_bn", "vgg13_bn"):
+        assert callable(getattr(vision, name))
+        vision.get_model(name, classes=4)  # constructs without error
+    net = vision.get_model("mobilenet_v2_0_25", classes=4)
+    net.initialize(mx.init.Xavier())
+    out = net(nd.random.uniform(shape=(1, 3, 64, 64)))
+    assert out.shape == (1, 4)
+
+
+def test_conv3d_transpose_layer():
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import nn
+
+    t = nn.Conv3DTranspose(4, kernel_size=2, strides=2)
+    t.initialize(mx.init.Xavier())
+    x = nd.random.uniform(shape=(2, 3, 4, 5, 6))
+    with autograd.record():
+        y = t(x)
+    y.backward()
+    assert y.shape == (2, 4, 8, 10, 12)
+    assert t.weight.grad().shape == t.weight.shape
+
+
 def test_bert_tiny_forward_and_grad():
     from mxnet_tpu.models import bert_tiny
 
