@@ -191,6 +191,73 @@ def _k_lamb(w, g, mean, var, lr, t, *, beta1, beta2, epsilon, rescale,
     return w - lr * ratio * update, m, v
 
 
+def _prep_wd_first(g, w, *, rescale, clip, wd):
+    # python-tier reference optimizers fold wd in BEFORE clipping
+    # (mx.optimizer.Adamax/Nadam, FTMLKernel) — unlike the C++ SGD
+    # kernels, which clip the bare gradient (_prep above)
+    g = g * rescale + wd * w
+    if clip is not None:
+        g = jnp.clip(g, -clip, clip)
+    return g
+
+
+def _k_adamax(w, g, mean, u, lr, t, *, beta1, beta2, epsilon, rescale,
+              clip, wd):
+    # ref: python/mxnet/optimizer/optimizer.py Adamax
+    gp = _prep_wd_first(g, w, rescale=rescale, clip=clip, wd=wd)
+    m = beta1 * mean + (1 - beta1) * gp
+    new_u = jnp.maximum(beta2 * u, jnp.abs(gp))
+    return w - (lr / (1 - beta1 ** t)) * m / (new_u + epsilon), m, new_u
+
+
+def _k_nadam(w, g, mean, var, lr, t, msched, msched_next, momentum_t,
+             momentum_t_1, *, beta1, beta2, epsilon, rescale, clip, wd):
+    # ref: python/mxnet/optimizer/optimizer.py Nadam (Dozat 2016);
+    # the step-dependent momentum schedule rides as traced scalars so
+    # every step hits the same executable
+    gp = _prep_wd_first(g, w, rescale=rescale, clip=clip, wd=wd)
+    g_prime = gp / (1 - msched)
+    m = beta1 * mean + (1 - beta1) * gp
+    m_prime = m / (1 - msched_next)
+    v = beta2 * var + (1 - beta2) * jnp.square(gp)
+    v_prime = v / (1 - beta2 ** t)
+    m_bar = (1 - momentum_t) * g_prime + momentum_t_1 * m_prime
+    return w - lr * m_bar / (jnp.sqrt(v_prime) + epsilon), m, v
+
+
+def _k_sgld(w, g, noise, lr, *, rescale, clip, wd):
+    # Langevin dynamics: half-step gradient + sqrt(lr) gaussian noise
+    # (ref: SGLDUpdate, optimizer_op.cc)
+    gp = _prep(g, w, rescale=rescale, clip=clip, wd=wd)
+    return w - lr / 2 * gp + jnp.sqrt(lr) * noise
+
+
+def _k_dcasgd(w, g, mom, prev_w, lr, *, momentum, lamda, rescale, clip, wd):
+    # delay-compensated async SGD (ref: mx.optimizer.DCASGD): the g²
+    # compensation term uses the bare clipped gradient; wd enters the
+    # update separately
+    gp = g * rescale
+    if clip is not None:
+        gp = jnp.clip(gp, -clip, clip)
+    new_mom = momentum * mom - lr * (
+        gp + wd * w + lamda * jnp.square(gp) * (w - prev_w))
+    new_w = w + new_mom
+    return new_w, new_mom, new_w
+
+
+def _k_ftml(w, g, d, v, z, lr, t, *, beta1, beta2, epsilon, rescale,
+            clip, wd):
+    # ref: FTMLUpdate, optimizer_op.cc (Zheng & Kwok 2017); same
+    # wd-before-clip order as the ftml_update op in ops/optimizer_ops.py
+    gp = _prep_wd_first(g, w, rescale=rescale, clip=clip, wd=wd)
+    new_v = beta2 * v + (1 - beta2) * jnp.square(gp)
+    new_d = (1 - beta1 ** t) / lr * (
+        jnp.sqrt(new_v / (1 - beta2 ** t)) + epsilon)
+    sigma = new_d - beta1 * d
+    new_z = beta1 * z + (1 - beta1) * gp - sigma * w
+    return -new_z / new_d, new_d, new_v, new_z
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -571,6 +638,142 @@ class LAMB(Optimizer):
                              upper_bound=self.upper_bound,
                              **self._common(index))
         mean._data, var._data = m._data, v._data
+        weight._data = new_w._data
+
+
+@register("adamax")
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        z = lambda: _nd.zeros(weight.shape, dtype=weight.dtype,
+                              ctx=weight.context)
+        return (z(), z())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._scalar(self._get_lr(index), weight)
+        t_arr = self._scalar(float(t), weight)
+        mean, u = state
+        new_w, m, nu = invoke(_k_adamax, weight, grad, mean, u, lr, t_arr,
+                              beta1=self.beta1, beta2=self.beta2,
+                              epsilon=self.epsilon, **self._common(index))
+        mean._data, u._data = m._data, nu._data
+        weight._data = new_w._data
+
+
+@register("nadam")
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        z = lambda: _nd.zeros(weight.shape, dtype=weight.dtype,
+                              ctx=weight.context)
+        return (z(), z())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._scalar(self._get_lr(index), weight)
+        # momentum schedule (host-side python floats, like the reference's
+        # shared self.m_schedule — traced in as scalars)
+        momentum_t = self.beta1 * (1 - 0.5 * 0.96 ** (t *
+                                                      self.schedule_decay))
+        momentum_t_1 = self.beta1 * (
+            1 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule *= momentum_t
+        msched_next = self.m_schedule * momentum_t_1
+        mean, var = state
+        new_w, m, v = invoke(
+            _k_nadam, weight, grad, mean, var, lr,
+            self._scalar(float(t), weight),
+            self._scalar(self.m_schedule, weight),
+            self._scalar(msched_next, weight),
+            self._scalar(momentum_t, weight),
+            self._scalar(momentum_t_1, weight),
+            beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
+            **self._common(index))
+        mean._data, var._data = m._data, v._data
+        weight._data = new_w._data
+
+
+@register("sgld")
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (ref: mx.optimizer.SGLD)."""
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._scalar(self._get_lr(index), weight)
+        from .random import normal as _normal
+
+        noise = _normal(0.0, 1.0, shape=weight.shape,
+                        dtype=weight.dtype, ctx=weight.context)
+        new_w = invoke(_k_sgld, weight, grad, noise, lr,
+                       **self._common(index))
+        weight._data = new_w._data
+
+
+@register("dcasgd")
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (ref: mx.optimizer.DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum, self.lamda = momentum, lamda
+
+    def create_state(self, index, weight):
+        z = lambda: _nd.zeros(weight.shape, dtype=weight.dtype,
+                              ctx=weight.context)
+        return (z(), weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._scalar(self._get_lr(index), weight)
+        mom, prev_w = state
+        new_w, nm, npw = invoke(_k_dcasgd, weight, grad, mom, prev_w, lr,
+                                momentum=self.momentum, lamda=self.lamda,
+                                **self._common(index))
+        mom._data, prev_w._data = nm._data, npw._data
+        weight._data = new_w._data
+
+
+@register("ftml")
+class Ftml(Optimizer):
+    """Follow the Moving Leader (ref: mx.optimizer.FTML)."""
+
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        z = lambda: _nd.zeros(weight.shape, dtype=weight.dtype,
+                              ctx=weight.context)
+        return (z(), z(), z())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._scalar(self._get_lr(index), weight)
+        d, v, z = state
+        new_w, nd_, nv, nz = invoke(_k_ftml, weight, grad, d, v, z, lr,
+                                    self._scalar(float(t), weight),
+                                    beta1=self.beta1, beta2=self.beta2,
+                                    epsilon=self.epsilon,
+                                    **self._common(index))
+        d._data, v._data, z._data = nd_._data, nv._data, nz._data
         weight._data = new_w._data
 
 
