@@ -1,7 +1,16 @@
 """Image module (ref: python/mxnet/image/)."""
 from .image import (imdecode, imread, imresize, resize_short, fixed_crop,  # noqa: F401
-                    center_crop, random_crop, color_normalize, Augmenter,
+                    center_crop, random_crop, random_size_crop,
+                    color_normalize, Augmenter,
                     ResizeAug, CenterCropAug, RandomCropAug,
-                    HorizontalFlipAug, CastAug, ColorNormalizeAug,
+                    RandomSizedCropAug, HorizontalFlipAug, CastAug,
+                    BrightnessJitterAug, ContrastJitterAug,
+                    SaturationJitterAug, HueJitterAug, ColorJitterAug,
+                    LightingAug, RandomGrayAug, ColorNormalizeAug,
                     ForceResizeAug, SequentialAug, RandomOrderAug,
-                    CreateAugmenter, ImageIter)
+                    CreateAugmenter, ImageIter,
+                    IMAGENET_MEAN, IMAGENET_STD,
+                    IMAGENET_PCA_EIGVAL, IMAGENET_PCA_EIGVEC)
+from .detection import (DetAugmenter, DetBorrowAug,  # noqa: F401
+                        DetHorizontalFlipAug, DetRandomCropAug,
+                        CreateDetAugmenter, ImageDetIter)

@@ -81,6 +81,27 @@ def random_crop(src, size, interp=1):
         (x0, y0, new_w, new_h)
 
 
+def random_size_crop(src, size, area, ratio, interp=1, **kwargs):
+    """Random area/aspect crop resized to `size` (ref:
+    mx.image.random_size_crop)."""
+    h, w = src.shape[0], src.shape[1]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = np.random.uniform(*area) * src_area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        new_ratio = np.exp(np.random.uniform(*log_ratio))
+        new_w = int(round(np.sqrt(target_area * new_ratio)))
+        new_h = int(round(np.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = np.random.randint(0, w - new_w + 1)
+            y0 = np.random.randint(0, h - new_h + 1)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
 def color_normalize(src, mean, std=None):
     src = src.astype("float32") if src.dtype == np.uint8 else src
     out = src - mean
@@ -140,31 +161,208 @@ class CastAug(Augmenter):
         return src.astype("float32")
 
 
+class SequentialAug(Augmenter):
+    """Apply augmenters in order (ref: SequentialAug)."""
+
+    def __init__(self, ts):
+        self._ts = list(ts)
+
+    def __call__(self, src):
+        for t in self._ts:
+            src = t(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    """Apply augmenters in a random order (ref: RandomOrderAug)."""
+
+    def __init__(self, ts):
+        self._ts = list(ts)
+
+    def __call__(self, src):
+        import random as _pyrandom
+
+        order = list(self._ts)
+        _pyrandom.shuffle(order)
+        for t in order:
+            src = t(src)
+        return src
+
+
+class RandomSizedCropAug(Augmenter):
+    """Ref: mx.image.RandomSizedCropAug (ImageNet training crop)."""
+
+    def __init__(self, size, area, ratio, interp=1):
+        super().__init__(size=size, area=area, ratio=ratio)
+        self.size, self.area, self.ratio, self.interp = (size, area, ratio,
+                                                         interp)
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+_GRAY_COEF = np.array([[0.299], [0.587], [0.114]], np.float32)
+
+# ImageNet statistics (ref: CreateAugmenter defaults)
+IMAGENET_MEAN = np.array([123.68, 116.28, 103.53], np.float32)
+IMAGENET_STD = np.array([58.395, 57.12, 57.375], np.float32)
+IMAGENET_PCA_EIGVAL = np.array([55.46, 4.794, 1.148], np.float32)
+IMAGENET_PCA_EIGVEC = np.array([[-0.5675, 0.7192, 0.4009],
+                                [-0.5808, -0.0045, -0.8140],
+                                [-0.5836, -0.6948, 0.4203]], np.float32)
+
+
+class BrightnessJitterAug(Augmenter):
+    """Ref: mx.image.BrightnessJitterAug."""
+
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + np.random.uniform(-self.brightness, self.brightness)
+        return src * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    """Ref: mx.image.ContrastJitterAug."""
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + np.random.uniform(-self.contrast, self.contrast)
+        gray = src.asnumpy() @ _GRAY_COEF
+        # reference offset reduces to (1-alpha) * mean luminance, which
+        # preserves a uniform image's level under pure contrast change
+        offset = (1.0 - alpha) * float(gray.mean())
+        return src * alpha + offset
+
+
+class SaturationJitterAug(Augmenter):
+    """Ref: mx.image.SaturationJitterAug."""
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + np.random.uniform(-self.saturation, self.saturation)
+        gray = (src.asnumpy() @ _GRAY_COEF) * (1.0 - alpha)
+        return src * alpha + _nd.array(gray)
+
+
+class HueJitterAug(Augmenter):
+    """Hue rotation in YIQ space (ref: mx.image.HueJitterAug)."""
+
+    _tyiq = np.array([[0.299, 0.587, 0.114],
+                      [0.596, -0.274, -0.321],
+                      [0.211, -0.523, 0.311]], np.float32)
+    _ityiq = np.array([[1.0, 0.956, 0.621],
+                       [1.0, -0.272, -0.647],
+                       [1.0, -1.107, 1.705]], np.float32)
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+
+    def __call__(self, src):
+        alpha = np.random.uniform(-self.hue, self.hue)
+        u, w = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0],
+                       [0.0, u, -w],
+                       [0.0, w, u]], np.float32)
+        t = (self._ityiq @ bt @ self._tyiq).T
+        return _nd.array(src.asnumpy() @ t)
+
+
+class ColorJitterAug(RandomOrderAug):
+    """Ref: mx.image.ColorJitterAug — random-order B/C/S jitter."""
+
+    def __init__(self, brightness, contrast, saturation):
+        augs = []
+        if brightness > 0:
+            augs.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            augs.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            augs.append(SaturationJitterAug(saturation))
+        super().__init__(augs)
+
+
+class LightingAug(Augmenter):
+    """PCA-based RGB lighting noise (ref: mx.image.LightingAug)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,))
+        rgb = (self.eigvec * alpha) @ self.eigval
+        return src + _nd.array(rgb.astype(np.float32))
+
+
+class RandomGrayAug(Augmenter):
+    """Randomly convert to 3-channel gray (ref: mx.image.RandomGrayAug)."""
+
+    _mat = np.array([[0.21, 0.21, 0.21],
+                     [0.72, 0.72, 0.72],
+                     [0.07, 0.07, 0.07]], np.float32)
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if np.random.random() < self.p:
+            return _nd.array(src.asnumpy() @ self._mat)
+        return src
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
-                    rand_mirror=False, mean=None, std=None, **kwargs):
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2, **kwargs):
     """Ref: mx.image.CreateAugmenter."""
     auglist = []
     if resize > 0:
         auglist.append(ResizeAug(resize))
     crop_size = (data_shape[2], data_shape[1])
-    if rand_crop:
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
         auglist.append(RandomCropAug(crop_size))
     else:
         auglist.append(CenterCropAug(crop_size))
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
     auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        auglist.append(LightingAug(pca_noise, IMAGENET_PCA_EIGVAL,
+                                   IMAGENET_PCA_EIGVEC))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
     if mean is not None or std is not None:
-        mean = np.asarray(mean if mean is not None else [0, 0, 0],
+        # mean=True/std=True select the ImageNet constants (ref behavior)
+        mean = np.asarray(IMAGENET_MEAN if mean is True
+                          else (mean if mean is not None else [0, 0, 0]),
                           np.float32)
-        std = np.asarray(std if std is not None else [1, 1, 1], np.float32)
-
-        class NormAug(Augmenter):
-            def __call__(self, src):
-                return color_normalize(src, _nd.array(mean),
-                                       _nd.array(std))
-
-        auglist.append(NormAug())
+        std = np.asarray(IMAGENET_STD if std is True
+                         else (std if std is not None else [1, 1, 1]),
+                         np.float32)
+        auglist.append(ColorNormalizeAug(_nd.array(mean), _nd.array(std)))
     return auglist
 
 
@@ -259,29 +457,3 @@ class ForceResizeAug(Augmenter):
                         interp=self._interp)
 
 
-class SequentialAug(Augmenter):
-    """Apply augmenters in order (ref: SequentialAug)."""
-
-    def __init__(self, ts):
-        self._ts = list(ts)
-
-    def __call__(self, src):
-        for t in self._ts:
-            src = t(src)
-        return src
-
-
-class RandomOrderAug(Augmenter):
-    """Apply augmenters in a random order (ref: RandomOrderAug)."""
-
-    def __init__(self, ts):
-        self._ts = list(ts)
-
-    def __call__(self, src):
-        import random as _pyrandom
-
-        order = list(self._ts)
-        _pyrandom.shuffle(order)
-        for t in order:
-            src = t(src)
-        return src

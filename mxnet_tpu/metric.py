@@ -121,6 +121,38 @@ class F1(EvalMetric):
         return self.name, f1
 
 
+@register("mcc")
+class MCC(EvalMetric):
+    """Matthews correlation coefficient, binary (ref: mx.metric.MCC)."""
+
+    def __init__(self, name="mcc", **kwargs):
+        super().__init__(name, **kwargs)
+        self.reset()
+
+    def reset(self):
+        super().reset()
+        self._tp = self._fp = self._fn = self._tn = 0.0
+
+    def update(self, labels, preds):
+        for label, pred in zip(_to_list(labels), _to_list(preds)):
+            label = _as_np(label).ravel().astype(np.int64)
+            pred = _as_np(pred)
+            if pred.ndim > 1:
+                pred = pred.argmax(axis=-1)
+            pred = pred.ravel().astype(np.int64)
+            self._tp += ((pred == 1) & (label == 1)).sum()
+            self._fp += ((pred == 1) & (label == 0)).sum()
+            self._fn += ((pred == 0) & (label == 1)).sum()
+            self._tn += ((pred == 0) & (label == 0)).sum()
+            self.num_inst += 1
+
+    def get(self):
+        tp, fp, fn, tn = self._tp, self._fp, self._fn, self._tn
+        denom = np.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+        mcc = (tp * tn - fp * fn) / denom if denom > 0 else 0.0
+        return self.name, float(mcc)
+
+
 @register("mae")
 class MAE(EvalMetric):
     def __init__(self, name="mae", **kwargs):

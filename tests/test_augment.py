@@ -154,3 +154,74 @@ def test_vision_surface_fills():
     assert seq(im).dtype == np.float32
     assert img.ForceResizeAug((4, 6))(im).shape == (6, 4, 3)
     assert img.RandomOrderAug([img.CastAug()])(im).dtype == np.float32
+
+
+def test_image_augmenter_classes():
+    """mx.image jitter/lighting/gray/sized-crop augmenters (ref:
+    python/mxnet/image/image.py augmenter classes)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import image as img, nd
+
+    np.random.seed(0)
+    src = nd.array(np.random.uniform(0, 255, (32, 48, 3))
+                   .astype(np.float32))
+
+    out = img.BrightnessJitterAug(0.4)(src)
+    assert out.shape == src.shape
+    ratio = out.asnumpy() / np.maximum(src.asnumpy(), 1e-6)
+    assert np.allclose(ratio, ratio.flat[0], atol=1e-4)  # pure scale
+
+    out = img.ContrastJitterAug(0.4)(src)
+    assert out.shape == src.shape and np.isfinite(out.asnumpy()).all()
+
+    # saturation/hue jitter leave pure-gray images (R=G=B) gray
+    gray = nd.array(np.tile(np.random.uniform(
+        0, 255, (8, 8, 1)).astype(np.float32), (1, 1, 3)))
+    # (the reference's YIQ/gray matrices are approximate — rows do not
+    # sum exactly to 1 — so gray is preserved to ~1%, not exactly)
+    for aug in (img.SaturationJitterAug(0.9), img.HueJitterAug(0.4)):
+        o = aug(gray).asnumpy()
+        assert np.allclose(o[..., 0], o[..., 1], rtol=0.01, atol=0.5)
+        assert np.allclose(o[..., 1], o[..., 2], rtol=0.01, atol=0.5)
+
+    out = img.RandomGrayAug(1.0)(src).asnumpy()
+    assert np.allclose(out[..., 0], out[..., 1], atol=1e-3)
+
+    out = img.LightingAug(0.1, np.array([55.46, 4.794, 1.148]),
+                          np.eye(3))(src)
+    assert out.shape == src.shape
+
+    out = img.RandomSizedCropAug((24, 16), (0.5, 1.0),
+                                 (0.75, 1.333))(src)
+    assert out.shape == (16, 24, 3)
+
+    jl = img.ColorJitterAug(0.3, 0.3, 0.3)
+    assert jl(src).shape == src.shape
+
+    augs = img.CreateAugmenter((3, 24, 24), rand_crop=True,
+                               rand_resize=True, rand_mirror=True,
+                               brightness=0.2, contrast=0.2,
+                               saturation=0.2, hue=0.1, pca_noise=0.05,
+                               rand_gray=0.2, mean=True, std=True)
+    x = src
+    for a in augs:
+        x = a(x)
+    assert x.shape == (24, 24, 3)
+
+
+def test_mcc_metric():
+    import mxnet_tpu as mx
+
+    m = mx.metric.create("mcc")
+    labels = mx.nd.array([1, 1, 0, 0, 1, 0])
+    # logits: predict [1, 0, 0, 1, 1, 0]
+    preds = mx.nd.array([[0.2, 0.8], [0.7, 0.3], [0.9, 0.1],
+                         [0.4, 0.6], [0.1, 0.9], [0.8, 0.2]])
+    m.update([labels], [preds])
+    tp, fp, fn, tn = 2, 1, 1, 2
+    want = (tp * tn - fp * fn) / np.sqrt(
+        (tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+    name, val = m.get()
+    assert name == "mcc" and abs(val - want) < 1e-6
+    m.reset()
+    assert m.get()[1] == 0.0

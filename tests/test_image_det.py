@@ -1,0 +1,197 @@
+"""ImageDetIter + detection augmenters (ref:
+python/mxnet/image/detection.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image as img
+
+
+def _write_images(tmp_path, n=6, size=(40, 30)):
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    paths = []
+    for i in range(n):
+        arr = rng.randint(0, 255, (size[1], size[0], 3), dtype=np.uint8)
+        p = str(tmp_path / f"im{i}.jpg")
+        Image.fromarray(arr).save(p)
+        paths.append(p)
+    return paths
+
+
+def _labels(n):
+    rng = np.random.RandomState(1)
+    labs = []
+    for i in range(n):
+        k = 1 + (i % 3)
+        objs = []
+        for _ in range(k):
+            x0, y0 = rng.uniform(0, 0.5, 2)
+            objs.append([float(rng.randint(0, 4)), x0, y0,
+                         x0 + rng.uniform(0.2, 0.45),
+                         y0 + rng.uniform(0.2, 0.45)])
+        labs.append(np.array(objs, np.float32))
+    return labs
+
+
+def _write_lst(tmp_path, paths, labs):
+    lst = str(tmp_path / "det.lst")
+    with open(lst, "w") as f:
+        for i, (p, lab) in enumerate(zip(paths, labs)):
+            fields = [str(i), "2", "5"]
+            for obj in lab:
+                fields += [f"{v:.6f}" for v in obj]
+            fields.append(os.path.basename(p))
+            f.write("\t".join(fields) + "\n")
+    return lst
+
+
+def test_image_det_iter_lst(tmp_path):
+    paths = _write_images(tmp_path)
+    labs = _labels(len(paths))
+    lst = _write_lst(tmp_path, paths, labs)
+    it = img.ImageDetIter(batch_size=4, data_shape=(3, 32, 32),
+                          path_imglist=lst, path_root=str(tmp_path),
+                          aug_list=[])
+    batch = it.next()
+    assert batch.data[0].shape == (4, 3, 32, 32)
+    assert batch.label[0].shape == (4, 3, 5)  # max 3 objects
+    lab0 = batch.label[0].asnumpy()[0]
+    np.testing.assert_allclose(lab0[:1], labs[0], atol=1e-5)
+    assert (lab0[1:] == -1.0).all()  # padded rows
+    # second batch pads past the end, then StopIteration
+    b2 = it.next()
+    assert b2.pad == 2
+    with pytest.raises(StopIteration):
+        it.next()
+    it.reset()
+    assert it.next().pad == 0
+
+
+def test_image_det_iter_rec(tmp_path):
+    from mxnet_tpu import recordio
+
+    paths = _write_images(tmp_path, n=4)
+    labs = _labels(4)
+    rec_path = str(tmp_path / "det.rec")
+    rec = recordio.MXRecordIO(rec_path, "w")
+    from PIL import Image
+
+    for i, (p, lab) in enumerate(zip(paths, labs)):
+        flat = np.concatenate([[2, 5], lab.ravel()]).astype(np.float32)
+        header = recordio.IRHeader(0, flat, i, 0)
+        rec.write(recordio.pack_img(header, np.asarray(Image.open(p))))
+    rec.close()
+    it = img.ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                          path_imgrec=rec_path, aug_list=[])
+    b = it.next()
+    assert b.data[0].shape == (2, 3, 32, 32)
+    assert b.label[0].shape[2] == 5
+
+
+def test_det_horizontal_flip():
+    src = mx.nd.array(np.random.uniform(0, 255, (16, 16, 3))
+                      .astype(np.float32))
+    lab = np.array([[1.0, 0.1, 0.2, 0.4, 0.6]], np.float32)
+    out, flipped = img.DetHorizontalFlipAug(1.0)(src, lab)
+    np.testing.assert_allclose(flipped[0],
+                               [1.0, 0.6, 0.2, 0.9, 0.6], atol=1e-6)
+    np.testing.assert_allclose(out.asnumpy(),
+                               src.asnumpy()[:, ::-1], atol=1e-6)
+    # flip twice = identity on boxes
+    _, twice = img.DetHorizontalFlipAug(1.0)(src, flipped)
+    np.testing.assert_allclose(twice, lab, atol=1e-6)
+
+
+def test_det_random_crop_boxes_stay_valid():
+    np.random.seed(0)
+    src = mx.nd.array(np.random.uniform(0, 255, (64, 64, 3))
+                      .astype(np.float32))
+    lab = np.array([[0.0, 0.3, 0.3, 0.7, 0.7],
+                    [2.0, 0.05, 0.05, 0.15, 0.15]], np.float32)
+    aug = img.DetRandomCropAug(min_object_covered=0.3,
+                               area_range=(0.3, 1.0))
+    for _ in range(10):
+        out, nl = aug(src, lab)
+        valid = nl[nl[:, 0] >= 0]
+        assert (valid[:, 1:] >= -1e-6).all()
+        assert (valid[:, 1:] <= 1 + 1e-6).all()
+        assert (valid[:, 3] >= valid[:, 1]).all()
+        assert (valid[:, 4] >= valid[:, 2]).all()
+
+
+def test_det_create_augmenter_runs():
+    src = mx.nd.array(np.random.uniform(0, 255, (48, 48, 3))
+                      .astype(np.float32))
+    lab = np.array([[1.0, 0.2, 0.2, 0.8, 0.8]], np.float32)
+    augs = img.CreateDetAugmenter((3, 32, 32), rand_crop=0.5,
+                                  rand_mirror=True, brightness=0.2,
+                                  contrast=0.2, saturation=0.2, hue=0.1,
+                                  pca_noise=0.02, rand_gray=0.1,
+                                  mean=True, std=True)
+    x, l = src, lab
+    for a in augs:
+        x, l = a(x, l)
+    assert x.shape[2] == 3 and l.shape[1] == 5
+
+
+def test_image_det_iter_roll_over(tmp_path):
+    paths = _write_images(tmp_path)
+    labs = _labels(len(paths))
+    lst = _write_lst(tmp_path, paths, labs)
+    it = img.ImageDetIter(batch_size=4, data_shape=(3, 16, 16),
+                          path_imglist=lst, path_root=str(tmp_path),
+                          aug_list=[], last_batch_handle="roll_over")
+    assert it.next().pad == 0
+    with pytest.raises(StopIteration):
+        it.next()  # 2 leftovers carried, not padded
+    it.reset()
+    assert it.next().pad == 0  # leftovers lead the new epoch
+    with pytest.raises(mx.MXNetError, match="last_batch_handle"):
+        img.ImageDetIter(batch_size=2, data_shape=(3, 16, 16),
+                         path_imglist=lst, path_root=str(tmp_path),
+                         last_batch_handle="bogus")
+
+
+def test_contrast_jitter_preserves_uniform_level():
+    """Reference invariant: pure contrast change leaves a uniform image
+    at its own level (offset = (1-alpha) * mean luminance)."""
+    uni = mx.nd.array(np.full((8, 8, 3), 100.0, np.float32))
+    for _ in range(5):
+        out = img.ContrastJitterAug(0.9)(uni).asnumpy()
+        np.testing.assert_allclose(out, 100.0, atol=0.2)
+
+
+def test_create_augmenter_imagenet_norm():
+    """mean=True/std=True select the ImageNet constants."""
+    augs = img.CreateAugmenter((3, 8, 8), mean=True, std=True)
+    x = mx.nd.array(np.broadcast_to(
+        img.IMAGENET_MEAN, (8, 8, 3)).astype(np.float32).copy())
+    for a in augs:
+        x = a(x)
+    np.testing.assert_allclose(x.asnumpy(), 0.0, atol=1e-4)
+
+
+def test_det_label_parse_errors(tmp_path):
+    paths = _write_images(tmp_path, n=1)
+    with open(str(tmp_path / "bad.lst"), "w") as f:
+        f.write("0\t2\t3\t1.0\t0.1\t0.1\t" +
+                os.path.basename(paths[0]) + "\n")  # obj_width 3 < 5
+    with pytest.raises(mx.MXNetError, match="object_width"):
+        img.ImageDetIter(batch_size=1, data_shape=(3, 16, 16),
+                         path_imglist=str(tmp_path / "bad.lst"),
+                         path_root=str(tmp_path))
+
+
+def test_draw_next(tmp_path):
+    paths = _write_images(tmp_path, n=2)
+    labs = _labels(2)
+    lst = _write_lst(tmp_path, paths, labs)
+    it = img.ImageDetIter(batch_size=1, data_shape=(3, 32, 32),
+                          path_imglist=lst, path_root=str(tmp_path),
+                          aug_list=[])
+    drawn = list(it.draw_next())
+    assert len(drawn) == 2 and drawn[0].shape == (32, 32, 3)
