@@ -13,4 +13,5 @@ from .image import (imdecode, imread, imresize, resize_short, fixed_crop,  # noq
                     IMAGENET_PCA_EIGVAL, IMAGENET_PCA_EIGVEC)
 from .detection import (DetAugmenter, DetBorrowAug,  # noqa: F401
                         DetHorizontalFlipAug, DetRandomCropAug,
+                        DetRandomPadAug, DetRandomSelectAug,
                         CreateDetAugmenter, ImageDetIter)

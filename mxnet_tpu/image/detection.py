@@ -40,6 +40,59 @@ class DetBorrowAug(DetAugmenter):
         return self.augmenter(src), label
 
 
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly pick one augmenter (or skip) — how the reference turns
+    rand_crop/rand_pad fractions into probabilities
+    (ref: mx.image.DetRandomSelectAug)."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if not self.aug_list or np.random.random() < self.skip_prob:
+            return src, label
+        return self.aug_list[np.random.randint(len(self.aug_list))](
+            src, label)
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Random expansion padding; boxes shrink into the new canvas
+    (ref: mx.image.DetRandomPadAug)."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=50,
+                 pad_val=(127, 127, 127)):
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = (max(area_range[0], 1.0), max(area_range[1], 1.0))
+        self.max_attempts = max_attempts
+        self.pad_val = np.asarray(pad_val, np.float32)
+
+    def __call__(self, src, label):
+        h, w = src.shape[0], src.shape[1]
+        for _ in range(self.max_attempts):
+            area = np.random.uniform(*self.area_range)
+            ar = np.random.uniform(*self.aspect_ratio_range)
+            nw, nh = int(w * np.sqrt(area * ar)), int(h * np.sqrt(area / ar))
+            if nw >= w and nh >= h:
+                break
+        else:
+            return src, label
+        x0 = np.random.randint(0, nw - w + 1)
+        y0 = np.random.randint(0, nh - h + 1)
+        arr = src.asnumpy()
+        canvas = np.empty((nh, nw, arr.shape[2]), arr.dtype)
+        canvas[:] = self.pad_val.astype(arr.dtype)
+        canvas[y0:y0 + h, x0:x0 + w] = arr
+        out = label.copy()
+        valid = out[:, 0] >= 0
+        out[valid, 1] = (out[valid, 1] * w + x0) / nw
+        out[valid, 3] = (out[valid, 3] * w + x0) / nw
+        out[valid, 2] = (out[valid, 2] * h + y0) / nh
+        out[valid, 4] = (out[valid, 4] * h + y0) / nh
+        return _nd.array(canvas), out
+
+
 class DetHorizontalFlipAug(DetAugmenter):
     """Mirror image and x-coordinates (ref: mx.image.DetHorizontalFlipAug)."""
 
@@ -130,13 +183,23 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
                        min_object_covered=0.1,
                        aspect_ratio_range=(0.75, 1.33),
                        area_range=(0.05, 3.0), max_attempts=50, **kwargs):
-    """Ref: mx.image.CreateDetAugmenter."""
+    """Ref: mx.image.CreateDetAugmenter. rand_crop / rand_pad are
+    PROBABILITIES (fraction of images augmented), realized through
+    DetRandomSelectAug exactly like the reference."""
     auglist = []
     if rand_crop > 0:
-        auglist.append(DetRandomCropAug(
+        crop = DetRandomCropAug(
             min_object_covered, aspect_ratio_range,
             (min(area_range[0], 1.0), min(area_range[1], 1.0)),
-            max_attempts))
+            max_attempts)
+        auglist.append(DetRandomSelectAug([crop],
+                                          skip_prob=1.0 - rand_crop))
+    if rand_pad > 0:
+        pad = DetRandomPadAug(aspect_ratio_range,
+                              (max(area_range[0], 1.0),
+                               max(area_range[1], 1.0)), max_attempts)
+        auglist.append(DetRandomSelectAug([pad],
+                                          skip_prob=1.0 - rand_pad))
     if rand_mirror:
         auglist.append(DetHorizontalFlipAug(0.5))
     # geometric augs done: force to the final shape (boxes are
@@ -160,17 +223,21 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
     if rand_gray > 0:
         auglist.append(DetBorrowAug(RandomGrayAug(rand_gray)))
     if mean is not None or std is not None:
-        from .image import IMAGENET_MEAN, IMAGENET_STD, ColorNormalizeAug
+        from .image import ColorNormalizeAug, _resolve_mean_std
 
-        mean = np.asarray(IMAGENET_MEAN if mean is True
-                          else (mean if mean is not None else [0, 0, 0]),
-                          np.float32)
-        std = np.asarray(IMAGENET_STD if std is True
-                         else (std if std is not None else [1, 1, 1]),
-                         np.float32)
+        mean, std = _resolve_mean_std(mean, std)
         auglist.append(DetBorrowAug(ColorNormalizeAug(_nd.array(mean),
                                                       _nd.array(std))))
     return auglist
+
+
+class _LazyRecKey:
+    """Marker for an on-demand indexed-recordio payload."""
+
+    __slots__ = ("key",)
+
+    def __init__(self, key):
+        self.key = key
 
 
 def _parse_det_label(raw):
@@ -204,21 +271,34 @@ class ImageDetIter:
         self.data_shape = tuple(data_shape)
         self.label_pad_value = float(label_pad_value)
         self._shuffle = shuffle
-        self._items = []  # list of (label 2-D array, image source)
+        # each item: (label 2-D array, source) where source is a str
+        # path, raw encoded bytes, or a lazy-read key into self._rec
+        self._items = []
+        self._rec = None
         if path_imgrec:
             from .. import recordio as _recordio
 
             idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
-            rec = _recordio.MXIndexedRecordIO(idx_path, path_imgrec, "r") \
-                if os.path.exists(idx_path) \
-                else _recordio.MXRecordIO(path_imgrec, "r")
-            while True:
-                s = rec.read()
-                if s is None:
-                    break
-                header, img = _recordio.unpack(s)
-                self._items.append((_parse_det_label(header.label), img))
-            rec.close()
+            if os.path.exists(idx_path):
+                # indexed: scan labels once (headers only), keep the
+                # reader open and fetch payloads on demand — a COCO-size
+                # .rec must not be held in RAM (ref: streaming iter)
+                self._rec = _recordio.MXIndexedRecordIO(idx_path,
+                                                        path_imgrec, "r")
+                for key in self._rec.keys:
+                    header, _ = _recordio.unpack(self._rec.read_idx(key))
+                    self._items.append((_parse_det_label(header.label),
+                                        _LazyRecKey(key)))
+            else:
+                rec = _recordio.MXRecordIO(path_imgrec, "r")
+                while True:
+                    s = rec.read()
+                    if s is None:
+                        break
+                    header, img = _recordio.unpack(s)
+                    self._items.append((_parse_det_label(header.label),
+                                        img))
+                rec.close()
         elif path_imglist:
             with open(path_imglist) as f:
                 for line in f:
@@ -237,8 +317,12 @@ class ImageDetIter:
             if lab.shape[1] != obj_w:
                 raise MXNetError("inconsistent object widths across images")
         max_obj = max(lab.shape[0] for lab, _ in self._items)
-        self.max_objects = (max(label_pad_width, max_obj)
-                            if label_pad_width else max_obj)
+        if label_pad_width and label_pad_width < max_obj:
+            raise MXNetError(
+                f"label_pad_width={label_pad_width} is smaller than the "
+                f"dataset's max object count {max_obj}; raise it or drop "
+                "the argument")
+        self.max_objects = label_pad_width or max_obj
         self.obj_width = obj_w
         self._aug = (aug_list if aug_list is not None
                      else CreateDetAugmenter((data_shape[0], data_shape[1],
@@ -271,9 +355,12 @@ class ImageDetIter:
             self._rollover = []
 
     def _load_image(self, src):
-        if isinstance(src, (bytes, bytearray, np.ndarray)):
-            if isinstance(src, np.ndarray):  # decoded array from recordio
-                return _nd.array(src.astype(np.uint8))
+        if isinstance(src, _LazyRecKey):
+            from .. import recordio as _recordio
+
+            _, payload = _recordio.unpack(self._rec.read_idx(src.key))
+            src = payload
+        if isinstance(src, (bytes, bytearray)):
             from .image import imdecode
 
             return imdecode(src)
@@ -314,9 +401,13 @@ class ImageDetIter:
             lab = lab.copy()
             for aug in self._aug:
                 img, lab = aug(img, lab)
-            if img.shape[0] != h or img.shape[1] != w:
-                img = imresize(img, w, h)  # aug chain without a resize
             arr = img.asnumpy().astype(np.float32)
+            if arr.shape[0] != h or arr.shape[1] != w:
+                # aug chain without a resize step: fix up float-safely
+                # (imresize would cast normalized data through uint8)
+                from .image import _resize_float
+
+                arr = _resize_float(arr, w, h)
             data[i] = arr.transpose(2, 0, 1)
             labels[i, :lab.shape[0]] = lab
         return DataBatch(data=[_nd.array(data)],

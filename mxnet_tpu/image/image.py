@@ -355,15 +355,32 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     if rand_gray > 0:
         auglist.append(RandomGrayAug(rand_gray))
     if mean is not None or std is not None:
-        # mean=True/std=True select the ImageNet constants (ref behavior)
-        mean = np.asarray(IMAGENET_MEAN if mean is True
-                          else (mean if mean is not None else [0, 0, 0]),
-                          np.float32)
-        std = np.asarray(IMAGENET_STD if std is True
-                         else (std if std is not None else [1, 1, 1]),
-                         np.float32)
+        mean, std = _resolve_mean_std(mean, std)
         auglist.append(ColorNormalizeAug(_nd.array(mean), _nd.array(std)))
     return auglist
+
+
+def _resolve_mean_std(mean, std):
+    """mean=True/std=True select the ImageNet constants (ref behavior)."""
+    mean = np.asarray(IMAGENET_MEAN if mean is True
+                      else (mean if mean is not None else [0, 0, 0]),
+                      np.float32)
+    std = np.asarray(IMAGENET_STD if std is True
+                     else (std if std is not None else [1, 1, 1]),
+                     np.float32)
+    return mean, std
+
+
+def _resize_float(arr, w, h):
+    """Bilinear resize that preserves float values (PIL mode-F per
+    channel) — imresize casts to uint8, which corrupts normalized
+    data."""
+    from PIL import Image
+
+    chans = [np.asarray(Image.fromarray(arr[..., c].astype(np.float32),
+                                        mode="F").resize((w, h)))
+             for c in range(arr.shape[2])]
+    return np.stack(chans, axis=2)
 
 
 class ImageIter:

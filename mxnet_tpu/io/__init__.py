@@ -3,3 +3,12 @@ from .io import (DataBatch, DataDesc, DataIter, NDArrayIter, MNISTIter,  # noqa:
                  CSVIter, LibSVMIter, ImageRecordIter, PrefetchingIter,
                  ResizeIter)
 from . import recordio  # noqa: F401
+
+
+def ImageDetRecordIter(**kwargs):
+    """Detection record iterator (ref: src/io/iter_image_det_recordio.cc,
+    registered as io.ImageDetRecordIter). Alias onto
+    `mx.image.ImageDetIter`; label layout and kwargs are shared."""
+    from ..image.detection import ImageDetIter
+
+    return ImageDetIter(**kwargs)

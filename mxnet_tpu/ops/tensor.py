@@ -87,6 +87,7 @@ _UNARY = {
     "erf": jax.scipy.special.erf, "erfinv": jax.scipy.special.erfinv,
     "gammaln": jax.scipy.special.gammaln,
     "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "digamma": jax.scipy.special.digamma,
     "logical_not": lambda x: (x == 0).astype(x.dtype),
     "isnan": lambda x: jnp.isnan(x).astype(jnp.float32),
     "isinf": lambda x: jnp.isinf(x).astype(jnp.float32),

@@ -175,6 +175,90 @@ def test_create_augmenter_imagenet_norm():
     np.testing.assert_allclose(x.asnumpy(), 0.0, atol=1e-4)
 
 
+def test_image_det_iter_indexed_rec_lazy(tmp_path):
+    from PIL import Image
+
+    from mxnet_tpu import recordio
+
+    paths = _write_images(tmp_path, n=4)
+    labs = _labels(4)
+    rec_path = str(tmp_path / "deti.rec")
+    idx_path = str(tmp_path / "deti.idx")
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i, (p, lab) in enumerate(zip(paths, labs)):
+        flat = np.concatenate([[2, 5], lab.ravel()]).astype(np.float32)
+        rec.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, flat, i, 0), np.asarray(Image.open(p))))
+    rec.close()
+    it = img.ImageDetIter(batch_size=2, data_shape=(3, 24, 24),
+                          path_imgrec=rec_path, aug_list=[])
+    # payloads are fetched lazily through the open indexed reader
+    from mxnet_tpu.image.detection import _LazyRecKey
+
+    assert all(isinstance(src, _LazyRecKey) for _, src in it._items)
+    b = it.next()
+    assert b.data[0].shape == (2, 3, 24, 24)
+    np.testing.assert_allclose(b.label[0].asnumpy()[0][:1], labs[0],
+                               atol=1e-5)
+
+
+def test_det_random_pad_boxes_shrink():
+    np.random.seed(3)
+    src = mx.nd.array(np.random.uniform(0, 255, (32, 32, 3))
+                      .astype(np.float32))
+    lab = np.array([[1.0, 0.2, 0.2, 0.8, 0.8]], np.float32)
+    aug = img.DetRandomPadAug(area_range=(1.5, 2.5))
+    out, nl = aug(src, lab)
+    assert out.shape[0] >= 32 and out.shape[1] >= 32
+    w0 = (lab[0, 3] - lab[0, 1]) * 32
+    w1 = (nl[0, 3] - nl[0, 1]) * out.shape[1]
+    np.testing.assert_allclose(w1, w0, atol=1e-3)  # absolute size kept
+
+
+def test_det_random_select_probability():
+    np.random.seed(0)
+    src = mx.nd.array(np.zeros((16, 16, 3), np.float32))
+    lab = np.array([[1.0, 0.2, 0.2, 0.8, 0.8]], np.float32)
+
+    class MarkAug(img.DetAugmenter):
+        def __call__(self, s, l):
+            return s + 1, l
+
+    hits = 0
+    sel = img.DetRandomSelectAug([MarkAug()], skip_prob=0.7)
+    for _ in range(300):
+        out, _ = sel(src, lab)
+        hits += int(float(out.asnumpy().max()) > 0)
+    assert 50 <= hits <= 130  # ~30% of 300
+
+
+def test_label_pad_width_too_small_raises(tmp_path):
+    paths = _write_images(tmp_path)
+    labs = _labels(len(paths))
+    lst = _write_lst(tmp_path, paths, labs)
+    with pytest.raises(mx.MXNetError, match="label_pad_width"):
+        img.ImageDetIter(batch_size=2, data_shape=(3, 16, 16),
+                         path_imglist=lst, path_root=str(tmp_path),
+                         label_pad_width=1)  # dataset max is 3
+
+
+def test_custom_aug_chain_without_resize_is_float_safe(tmp_path):
+    """Normalized (negative) float data must survive the shape fixup."""
+    paths = _write_images(tmp_path, n=2)
+    labs = _labels(2)
+    lst = _write_lst(tmp_path, paths, labs)
+
+    class NegAug(img.DetAugmenter):
+        def __call__(self, s, l):
+            return s.astype("float32") * 0 - 1.5, l
+
+    it = img.ImageDetIter(batch_size=2, data_shape=(3, 16, 16),
+                          path_imglist=lst, path_root=str(tmp_path),
+                          aug_list=[NegAug()])
+    d = it.next().data[0].asnumpy()
+    np.testing.assert_allclose(d, -1.5, atol=1e-5)  # not uint8-wrapped
+
+
 def test_det_label_parse_errors(tmp_path):
     paths = _write_images(tmp_path, n=1)
     with open(str(tmp_path / "bad.lst"), "w") as f:

@@ -390,3 +390,16 @@ def test_crop_out_of_bounds_raises():
         nd.Crop(x, offset=(4, 0), h_w=(4, 8))
     with pytest.raises(Exception, match="out of bounds"):
         nd.Crop(x, offset=(-1, 0), h_w=(2, 2))
+
+
+def test_special_gamma_family():
+    """gamma/gammaln/digamma against scipy (ref: unary special ops)."""
+    from scipy import special as sp
+
+    x = np.array([0.5, 1.0, 2.5, 4.0], np.float32)
+    np.testing.assert_allclose(nd.gamma(nd.array(x)).asnumpy(),
+                               sp.gamma(x), rtol=1e-5)
+    np.testing.assert_allclose(nd.gammaln(nd.array(x)).asnumpy(),
+                               sp.gammaln(x), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(nd.digamma(nd.array(x)).asnumpy(),
+                               sp.digamma(x), atol=1e-5)
