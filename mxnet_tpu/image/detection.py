@@ -264,7 +264,7 @@ class ImageDetIter:
                  path_imglist=None, path_root="", shuffle=False,
                  aug_list=None, label_pad_width=None,
                  label_pad_value=-1.0, data_name="data",
-                 label_name="label", last_batch_handle="pad", **kwargs):
+                 label_name="label", last_batch_handle="pad"):
         from ..io.io import DataDesc
 
         self.batch_size = batch_size

@@ -378,7 +378,8 @@ def _resize_float(arr, w, h):
     from PIL import Image
 
     chans = [np.asarray(Image.fromarray(arr[..., c].astype(np.float32),
-                                        mode="F").resize((w, h)))
+                                        mode="F")
+                        .resize((w, h), Image.Resampling.BILINEAR))
              for c in range(arr.shape[2])]
     return np.stack(chans, axis=2)
 

@@ -239,13 +239,12 @@ def unpack(s):
     """Unpack to (IRHeader, payload) (ref: mx.recordio.unpack)."""
     flag, label, id_, id2 = struct.unpack(_IR_FORMAT, s[:_IR_SIZE])
     payload = s[_IR_SIZE:]
-    if flag > 1:
+    if flag > 0:
+        # packed float label vector (size-1 included — ref strips for
+        # flag > 0, not flag > 1)
         label = np.frombuffer(payload[:4 * flag], dtype=np.float32)
         payload = payload[4 * flag:]
-        header = IRHeader(flag, label, id_, id2)
-    else:
-        header = IRHeader(flag, label, id_, id2)
-    return header, payload
+    return IRHeader(flag, label, id_, id2), payload
 
 
 def pack_img(header, img, quality=95, img_fmt=".jpg"):

@@ -253,8 +253,13 @@ struct ImagePipeline {
     const char* p = rec.data();
     IRHeader h;
     std::memcpy(&h, p, sizeof(h));
-    size_t skip = sizeof(h) + (h.flag > 1 ? 4u * h.flag : 0u);
-    *label = h.label;
+    // flag > 0 means the label is a packed float vector of that many
+    // elements preceding the image bytes (ref: mx.recordio.unpack strips
+    // for flag > 0 — size-1 vectors included)
+    size_t skip = sizeof(h) + (h.flag > 0 ? 4u * h.flag : 0u);
+    *label = h.flag > 0
+        ? *reinterpret_cast<const float*>(p + sizeof(h))  // first element
+        : h.label;
     const uint8_t* img = reinterpret_cast<const uint8_t*>(p + skip);
     size_t img_len = rec.size() - skip;
 

@@ -43,7 +43,7 @@ def make_list(prefix, root, recursive=False, train_ratio=1.0):
     print(f"wrote {len(items)} entries to {prefix}.lst")
 
 
-def pack(prefix, root, quality=95, resize=0, color=1):
+def pack(prefix, root, quality=95, resize=0, color=1, pack_label=False):
     import numpy as np
     from PIL import Image
 
@@ -54,7 +54,14 @@ def pack(prefix, root, quality=95, resize=0, color=1):
             parts = line.strip().split("\t")
             if len(parts) < 3:
                 continue
-            idx, label, rel = int(parts[0]), float(parts[1]), parts[2]
+            idx, rel = int(parts[0]), parts[-1]
+            if pack_label:
+                # full float label vector (detection et al.; ref:
+                # im2rec.py --pack-label)
+                label = np.array([float(v) for v in parts[1:-1]],
+                                 np.float32)
+            else:
+                label = float(parts[1])
             img = Image.open(os.path.join(root, rel))
             img = img.convert("RGB" if color else "L")
             if resize:
@@ -79,13 +86,17 @@ def main():
     ap.add_argument("--quality", type=int, default=95)
     ap.add_argument("--resize", type=int, default=0)
     ap.add_argument("--color", type=int, default=1)
+    ap.add_argument("--pack-label", action="store_true",
+                    help="pack every .lst field between idx and path as "
+                         "a float label vector (detection labels)")
     args = ap.parse_args()
     if args.list:
         make_list(args.prefix, args.root, args.recursive)
     else:
         if not os.path.exists(args.prefix + ".lst"):
             make_list(args.prefix, args.root, recursive=True)
-        pack(args.prefix, args.root, args.quality, args.resize, args.color)
+        pack(args.prefix, args.root, args.quality, args.resize, args.color,
+             pack_label=args.pack_label)
 
 
 if __name__ == "__main__":
