@@ -439,6 +439,105 @@ MXTPU_API int MXTPUWaitAll() {
 
 // Save/load NDArrays in the reference-compatible .params container
 // (MXNDArraySave/Load equivalents; keys optional for save).
+// Load a .params artifact (ref: MXNDArrayLoad). Each returned handle
+// carries its own reference — free with MXTPUNDArrayFree (same caller-
+// owned contract as the reference). The handle/name POINTER ARRAYS live
+// in thread-local storage valid until the next Load on this thread;
+// names is empty for list-form artifacts.
+static thread_local std::vector<NDArrayHandle> tl_load_handles;
+static thread_local std::vector<std::string> tl_load_names;
+static thread_local std::vector<const char*> tl_load_name_ptrs;
+
+MXTPU_API int MXTPUNDArrayLoad(const char* fname, int* out_size,
+                               NDArrayHandle** out_handles,
+                               int* out_name_size,
+                               const char*** out_names) {
+  if (!g_initialized) {
+    tl_last_error = "MXTPUCAPIInit not called";
+    return -1;
+  }
+  Gil gil;
+  do {
+    PyObject* r = PyObject_CallMethod(g_nd_module, "load", "s", fname);
+    if (!r) break;
+    tl_load_handles.clear();
+    tl_load_names.clear();
+    tl_load_name_ptrs.clear();
+    if (PyDict_Check(r)) {
+      PyObject *key, *val;
+      Py_ssize_t pos = 0;
+      while (PyDict_Next(r, &pos, &key, &val)) {
+        const char* k = PyUnicode_AsUTF8(key);
+        if (!k) {
+          // drop the references taken so far — they would otherwise
+          // leak when the next Load clears the vector without DECREF
+          for (auto h : tl_load_handles)
+            Py_DECREF(static_cast<PyObject*>(h));
+          tl_load_handles.clear();
+          tl_load_names.clear();
+          Py_DECREF(r);
+          goto fail;
+        }
+        tl_load_names.emplace_back(k);
+        Py_INCREF(val);
+        tl_load_handles.push_back(val);
+      }
+    } else {
+      PyObject* seq = PySequence_Fast(r, "nd.load returned non-sequence");
+      if (!seq) { Py_DECREF(r); break; }
+      Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+      for (Py_ssize_t i = 0; i < n; ++i) {
+        PyObject* o = PySequence_Fast_GET_ITEM(seq, i);
+        Py_INCREF(o);
+        tl_load_handles.push_back(o);
+      }
+      Py_DECREF(seq);
+    }
+    Py_DECREF(r);
+    for (auto& s : tl_load_names) tl_load_name_ptrs.push_back(s.c_str());
+    *out_size = static_cast<int>(tl_load_handles.size());
+    *out_handles = tl_load_handles.data();
+    *out_name_size = static_cast<int>(tl_load_name_ptrs.size());
+    *out_names = tl_load_name_ptrs.data();
+    return 0;
+  } while (false);
+fail:
+  set_error_from_python();
+  return -1;
+}
+
+// Op self-documentation through the C boundary (ref: MXSymbolGetAtomicSymbolInfo
+// role): returns the rendered docstring for a registered op. The pointer is
+// owned by a thread-local string valid until the next call on the thread.
+static thread_local std::string tl_op_doc;
+
+MXTPU_API int MXTPUOpGetDoc(const char* op_name, const char** out_doc) {
+  if (!g_initialized) {
+    tl_last_error = "MXTPUCAPIInit not called";
+    return -1;
+  }
+  Gil gil;
+  do {
+    PyObject* entry = PyObject_CallMethod(g_registry, "get", "s", op_name);
+    if (!entry) break;
+    PyObject* doc = PyObject_CallMethod(entry, "build_doc", nullptr);
+    Py_DECREF(entry);
+    if (!doc) break;
+    if (doc == Py_None) {  // undocumented op: legitimately empty
+      tl_op_doc.clear();
+    } else {
+      const char* c = PyUnicode_AsUTF8(doc);
+      if (!c) { Py_DECREF(doc); break; }
+      tl_op_doc = c;
+    }
+    Py_DECREF(doc);
+    *out_doc = tl_op_doc.c_str();
+    return 0;
+  } while (false);
+  set_error_from_python();
+  return -1;
+}
+
 MXTPU_API int MXTPUNDArraySave(const char* fname, NDArrayHandle* handles,
                                const char** keys, int num) {
   Gil gil;

@@ -32,6 +32,10 @@ extern int MXTPUImperativeInvoke(const char* op_name, NDArrayHandle* in,
 extern int MXTPUWaitAll(void);
 extern int MXTPUNDArraySave(const char* fname, NDArrayHandle* handles,
                             const char** keys, int num);
+extern int MXTPUNDArrayLoad(const char* fname, int* out_size,
+                            NDArrayHandle** out_handles,
+                            int* out_name_size, const char*** out_names);
+extern int MXTPUOpGetDoc(const char* op_name, const char** out_doc);
 
 #define CHECK(cond, msg)                                            \
   do {                                                              \
@@ -144,6 +148,36 @@ int main(int argc, char** argv) {
   NDArrayHandle pair[] = {a, b};
   CHECK(MXTPUNDArraySave(save_path, pair, save_keys, 2) == 0,
         "ndarray save");
+
+  /* load the artifact back through the C boundary (ref: MXNDArrayLoad) */
+  int ld_n = 0, ld_names_n = 0;
+  NDArrayHandle* ld = NULL;
+  const char** ld_names = NULL;
+  CHECK(MXTPUNDArrayLoad(save_path, &ld_n, &ld, &ld_names_n, &ld_names)
+            == 0, "ndarray load");
+  CHECK(ld_n == 2 && ld_names_n == 2, "load count");
+  int saw_a = 0;
+  for (int i = 0; i < ld_n; ++i) {
+    if (strcmp(ld_names[i], "weight_a") == 0) {
+      float back[6];
+      CHECK(MXTPUNDArraySyncCopyToCPU(ld[i], back, sizeof(back)) == 0,
+            "copy loaded");
+      for (int j = 0; j < 6; ++j)
+        CHECK(back[j] == data[j], "loaded values");
+      saw_a = 1;
+    }
+  }
+  CHECK(saw_a, "weight_a present after load");
+  for (int i = 0; i < ld_n; ++i)  /* caller-owned handles */
+    MXTPUNDArrayFree(ld[i]);
+  CHECK(MXTPUNDArrayLoad("/nonexistent/x.params", &ld_n, &ld,
+                         &ld_names_n, &ld_names) != 0, "bad load rejected");
+
+  /* op self-documentation crosses the ABI (dmlc parameter.h role) */
+  const char* doc = NULL;
+  CHECK(MXTPUOpGetDoc("Convolution", &doc) == 0 && doc &&
+        strstr(doc, "kernel") != NULL, "Convolution doc has params");
+  CHECK(MXTPUOpGetDoc("NoSuchOp__", &doc) != 0, "bad op doc rejected");
 
   /* any-thread contract: a second OS thread must be able to call in
    * (the embedded interpreter's GIL is released between calls) */
