@@ -27,9 +27,14 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e9
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
-                      causal, scale, seq_k):
-    # refs carry a leading block dim of 1: (1, block_q, d) / (1, seq_k, d)
+def _flash_fwd_kernel(*refs, block_k, causal, scale, seq_k, has_mask):
+    # refs carry a leading block dim of 1: (1, block_q, d) / (1, seq_k, d);
+    # with has_mask an additive key-padding row (1, seq_k) rides along
+    if has_mask:
+        q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref = refs
+        km_ref = None
     block_q = q_ref.shape[1]
     d = q_ref.shape[2]
     qi = pl.program_id(1)  # q-block index
@@ -46,6 +51,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
         k = k_ref[0, pl.ds(kb * block_k, block_k), :]
         v = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if km_ref is not None:
+            s = s + km_ref[0, pl.ds(kb * block_k, block_k)][None, :]
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -73,7 +80,15 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
     lse_ref[0] = (m + jnp.log(l))[:, 0]
 
 
-def _flash_forward(q, k, v, *, causal, scale, block_q=128, block_k=128):
+def _km_spec(h, sk):
+    """BlockSpec mapping the flattened (b*h) grid dim onto the original
+    (b, sk) mask — no h-fold HBM copy of the mask is ever made."""
+    return pl.BlockSpec((1, sk), lambda i, j: (i // h, 0),
+                        memory_space=pltpu.VMEM)
+
+
+def _flash_forward(q, k, v, *, causal, scale, kmask=None,
+                   block_q=128, block_k=128):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bh = b * h
@@ -81,36 +96,48 @@ def _flash_forward(q, k, v, *, causal, scale, block_q=128, block_k=128):
     k3 = k.reshape(bh, sk, d)
     v3 = v.reshape(bh, sk, d)
 
+    in_specs = [
+        pl.BlockSpec((1, block_q, d),
+                     lambda i, j: (i, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    args = [q3, k3, v3]
+    if kmask is not None:
+        in_specs.append(_km_spec(h, sk))
+        args.append(kmask.astype(jnp.float32))
+
     grid = (bh, sq // block_q)
     out, lse = pl.pallas_call(
         functools.partial(_flash_fwd_kernel, block_k=block_k,
-                          causal=causal, scale=scale, seq_k=sk),
+                          causal=causal, scale=scale, seq_k=sk,
+                          has_mask=kmask is not None),
         out_shape=(
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
             jax.ShapeDtypeStruct((bh, sq), jnp.float32),
         ),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d),
-                         lambda i, j: (i, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_q), lambda i, j: (i, j),
                          memory_space=pltpu.VMEM),
         ),
-    )(q3, k3, v3)
+    )(*args)
     return out.reshape(b, h, sq, d), lse
 
 
-def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                     dq_ref, *, block_k, causal, scale, seq_k):
+def _flash_dq_kernel(*refs, block_k, causal, scale, seq_k, has_mask):
+    if has_mask:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, km_ref,
+         dq_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref = refs
+        km_ref = None
     block_q = q_ref.shape[1]
     d = q_ref.shape[2]
     qi = pl.program_id(1)
@@ -126,6 +153,8 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         s = scale * jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if km_ref is not None:
+            s = s + km_ref[0, pl.ds(kb * block_k, block_k)][None, :]
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -146,14 +175,23 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dq_ref[0] = (scale * dq).astype(dq_ref.dtype)
 
 
-def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dk_ref, dv_ref, *, block_q, causal, scale, seq_q):
+def _flash_dkv_kernel(*refs, block_q, causal, scale, seq_q, has_mask):
+    if has_mask:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, km_ref,
+         dk_ref, dv_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref) = refs
+        km_ref = None
     block_k = k_ref.shape[1]
     d = k_ref.shape[2]
     ki = pl.program_id(1)
 
     k = k_ref[0].astype(jnp.float32)
     v = v_ref[0].astype(jnp.float32)
+    # this k-block's additive mask column: constant across q-blocks
+    km_col = (km_ref[0, pl.ds(ki * block_k, block_k)][None, :]
+              if km_ref is not None else None)
     dk0 = jnp.zeros((block_k, d), jnp.float32)
     dv0 = jnp.zeros((block_k, d), jnp.float32)
     num_qb = seq_q // block_q
@@ -165,6 +203,8 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[0, pl.ds(qb * block_q, block_q)][:, None]
         delta = delta_ref[0, pl.ds(qb * block_q, block_q)][:, None]
         s = scale * jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if km_col is not None:
+            s = s + km_col
         if causal:
             q_pos = qb * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -188,7 +228,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, o, lse, do, *, causal, scale,
+def _flash_backward(q, k, v, o, lse, do, *, causal, scale, kmask=None,
                     block_q=128, block_k=128):
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -202,53 +242,70 @@ def _flash_backward(q, k, v, o, lse, do, *, causal, scale,
 
     full_q = lambda i, j: (i, 0, 0)  # noqa: E731
     full_r = lambda i, j: (i, 0)     # noqa: E731
+    has_mask = kmask is not None
+    km3 = kmask.astype(jnp.float32) if has_mask else None
+    km_spec = _km_spec(h, sk)
+
+    dq_specs = [
+        pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, sk, d), full_q, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, sk, d), full_q, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q), lambda i, j: (i, j),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q), lambda i, j: (i, j),
+                     memory_space=pltpu.VMEM),
+    ]
+    dq_args = [q3, k3, v3, do3, lse, delta]
+    if has_mask:
+        dq_specs.append(km_spec)
+        dq_args.append(km3)
 
     dq = pl.pallas_call(
         functools.partial(_flash_dq_kernel, block_k=block_k,
-                          causal=causal, scale=scale, seq_k=sk),
+                          causal=causal, scale=scale, seq_k=sk,
+                          has_mask=has_mask),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         grid=(bh, sq // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, sk, d), full_q, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, sk, d), full_q, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
                                memory_space=pltpu.VMEM),
-    )(q3, k3, v3, do3, lse, delta)
+    )(*dq_args)
+
+    dkv_specs = [
+        pl.BlockSpec((1, sq, d), full_q, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, sq, d), full_q, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, sq), full_r, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, sq), full_r, memory_space=pltpu.VMEM),
+    ]
+    dkv_args = [q3, k3, v3, do3, lse, delta]
+    if has_mask:
+        dkv_specs.append(km_spec)
+        dkv_args.append(km3)
 
     dk, dv = pl.pallas_call(
         functools.partial(_flash_dkv_kernel, block_q=block_q,
-                          causal=causal, scale=scale, seq_q=sq),
+                          causal=causal, scale=scale, seq_q=sq,
+                          has_mask=has_mask),
         out_shape=(
             jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
             jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
         ),
         grid=(bh, sk // block_k),
-        in_specs=[
-            pl.BlockSpec((1, sq, d), full_q, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, sq, d), full_q, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, sq), full_r, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, sq), full_r, memory_space=pltpu.VMEM),
-        ],
+        in_specs=dkv_specs,
         out_specs=(
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
         ),
-    )(q3, k3, v3, do3, lse, delta)
+    )(*dkv_args)
 
     return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
             dv.reshape(b, h, sk, d))
@@ -257,37 +314,68 @@ def _flash_backward(q, k, v, o, lse, do, *, causal, scale,
 def _tiles_ok(q, k, block_q=128, block_k=128):
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    return (sq % block_q == 0 and sk % block_k == 0 and d % 128 == 0
+    # head_dim 64 is the common transformer case (BERT/GPT heads) and
+    # tiles onto the MXU fine (lane dim padded to 128); requiring
+    # d % 128 == 0 silently pushed every 64-dim model onto the XLA
+    # fallback path
+    return (sq % block_q == 0 and sk % block_k == 0 and d % 64 == 0
             and sq >= block_q and sk >= block_k)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_sdpa(q, k, v, causal, scale):
-    out, _ = _flash_forward(q, k, v, causal=causal, scale=scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash_sdpa(q, k, v, km, causal, scale):
+    # km: additive (b, sk) key-padding mask or None (None is an empty
+    # pytree to custom_vjp, so one definition covers both paths)
+    out, _ = _flash_forward(q, k, v, causal=causal, scale=scale, kmask=km)
     return out
 
 
-def _flash_sdpa_fwd(q, k, v, causal, scale):
-    out, lse = _flash_forward(q, k, v, causal=causal, scale=scale)
-    return out, (q, k, v, out, lse)
+def _flash_sdpa_fwd(q, k, v, km, causal, scale):
+    out, lse = _flash_forward(q, k, v, causal=causal, scale=scale,
+                              kmask=km)
+    return out, (q, k, v, km, out, lse)
 
 
 def _flash_sdpa_bwd(causal, scale, res, g):
-    q, k, v, o, lse = res
-    return _flash_backward(q, k, v, o, lse, g, causal=causal, scale=scale)
+    q, k, v, km, o, lse = res
+    dq, dk, dv = _flash_backward(q, k, v, o, lse, g, causal=causal,
+                                 scale=scale, kmask=km)
+    # mask is non-differentiable
+    dkm = None if km is None else jnp.zeros_like(km)
+    return dq, dk, dv, dkm
 
 
 _flash_sdpa.defvjp(_flash_sdpa_fwd, _flash_sdpa_bwd)
 
 
+def _as_key_padding_mask(mask, q, k):
+    """Normalize a (b, 1, 1, sk)-broadcastable mask to an additive
+    (b, sk) float row, or None when the mask is not that shape (full
+    (sq, sk) score masks stay on the XLA fallback)."""
+    if mask is None:
+        return None
+    b, sk = q.shape[0], k.shape[2]
+    if mask.ndim != 4 or mask.shape != (b, 1, 1, sk):
+        return None
+    row = mask.reshape(b, sk)
+    if row.dtype == jnp.bool_:
+        return jnp.where(row, 0.0, _NEG_INF).astype(jnp.float32)
+    return row.astype(jnp.float32)
+
+
 def flash_attention(q, k, v, mask=None, scale=None, causal=False):
     """Fused attention; q,k,v: (batch, heads, seq, head_dim).
 
-    Additive/bool masks and unaligned shapes fall back to the XLA
+    Key-padding masks — additive or bool, shape (b, 1, 1, seq_k), the
+    form BERT-style encoders build — ride inside the kernel; full
+    per-score masks and unaligned shapes fall back to the XLA
     reference (the caller treats this function as best-effort)."""
     from ..attention import sdpa_reference
 
-    if mask is not None or not _tiles_ok(q, k):
+    if not _tiles_ok(q, k):
         return sdpa_reference(q, k, v, mask, scale=scale, causal=causal)
     s = float(scale) if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    return _flash_sdpa(q, k, v, bool(causal), s)
+    km = _as_key_padding_mask(mask, q, k)
+    if mask is not None and km is None:  # full score mask: XLA fallback
+        return sdpa_reference(q, k, v, mask, scale=scale, causal=causal)
+    return _flash_sdpa(q, k, v, km, bool(causal), s)

@@ -66,7 +66,8 @@ def test_flash_backward_kernel_matches_reference(causal):
     try:
         pl.pallas_call = functools.partial(orig, interpret=True)
         out, vjp = jax.vjp(
-            lambda q_, k_, v_: fa._flash_sdpa(q_, k_, v_, causal, scale),
+            lambda q_, k_, v_: fa._flash_sdpa(q_, k_, v_, None, causal,
+                                              scale),
             q, k, v)
         dq, dk, dv = vjp(g)
     finally:
@@ -192,3 +193,147 @@ def test_ulysses_rejects_indivisible_heads():
     q, k, v = _qkv(b=1, h=3, s=64, d=16)  # 3 heads, 8 devices
     with pytest.raises(mx.MXNetError, match="heads"):
         ulysses_attention(q, k, v)
+
+
+def test_flash_kernel_head_dim_64():
+    """head_dim=64 (BERT/GPT heads) must use the Pallas path, fwd+bwd
+    (previously fell back to XLA because of a d%128 gate)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from mxnet_tpu.ops.attention import sdpa_reference
+    from mxnet_tpu.ops.pallas import flash_attention as fa
+
+    q, k, v = _qkv(s=256, d=64)
+    assert fa._tiles_ok(q, k)  # no longer gated out
+
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    orig = pl.pallas_call
+    try:
+        pl.pallas_call = functools.partial(orig, interpret=True)
+        out, lse = fa._flash_forward(q, k, v, causal=True, scale=scale)
+        # backward through the pallas kernels
+        g = jnp.ones_like(out)
+        dq, dk, dv = fa._flash_backward(q, k, v, out, lse, g,
+                                        causal=True, scale=scale)
+    finally:
+        pl.pallas_call = orig
+
+    ref = sdpa_reference(q, k, v, causal=True)
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-3)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(sdpa_reference(q, k, v, causal=True))
+
+    rdq, rdk, rdv = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for got, want, name in ((dq, rdq, "dq"), (dk, rdk, "dk"),
+                            (dv, rdv, "dv")):
+        err = np.abs(np.asarray(got) - np.asarray(want)).max()
+        assert err < 5e-3, (name, err)
+
+
+def test_flash_kernel_key_padding_mask():
+    """The (b,1,1,sk) additive key-padding mask (BERT's form) rides the
+    Pallas kernels fwd+bwd; full-score masks still fall back."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from mxnet_tpu.ops.attention import sdpa_reference
+    from mxnet_tpu.ops.pallas import flash_attention as fa
+
+    b, h, s, d = 2, 2, 256, 64
+    q, k, v = _qkv(b=b, h=h, s=s, d=d)
+    # pad out the tail third of keys per batch row
+    valid = np.array([s, s - 96], np.int32)
+    add = np.zeros((b, 1, 1, s), np.float32)
+    for i in range(b):
+        add[i, 0, 0, valid[i]:] = -1e9
+    add = jnp.asarray(add)
+
+    km = fa._as_key_padding_mask(add, q, k)
+    assert km is not None and km.shape == (b, s)
+    # bool masks normalize too
+    bmask = jnp.asarray(add == 0)
+    np.testing.assert_allclose(
+        np.asarray(fa._as_key_padding_mask(bmask, q, k) < -1e8),
+        np.asarray(add < -1e8).reshape(b, s))
+    # a full (sq, sk) score mask is NOT a key-padding mask
+    assert fa._as_key_padding_mask(
+        jnp.zeros((b, 1, s, s), jnp.float32), q, k) is None
+
+    scale = 1.0 / np.sqrt(d)
+    orig = pl.pallas_call
+    try:
+        pl.pallas_call = functools.partial(orig, interpret=True)
+        out, lse = fa._flash_forward(q, k, v, causal=False, scale=scale,
+                                     kmask=km)
+        g = jnp.ones_like(out)
+        dq, dk, dv = fa._flash_backward(q, k, v, out, lse, g,
+                                        causal=False, scale=scale,
+                                        kmask=km)
+    finally:
+        pl.pallas_call = orig
+
+    ref = sdpa_reference(q, k, v, add)
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-3), \
+        np.abs(np.asarray(out) - np.asarray(ref)).max()
+
+    def ref_loss(q, k, v):
+        return jnp.sum(sdpa_reference(q, k, v, add))
+
+    rdq, rdk, rdv = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for got, want, name in ((dq, rdq, "dq"), (dk, rdk, "dk"),
+                            (dv, rdv, "dv")):
+        err = np.abs(np.asarray(got) - np.asarray(want)).max()
+        assert err < 5e-3, (name, err)
+
+
+def test_flash_kernel_causal_plus_padding_mask():
+    """Causal early-exit loop bounds must compose with the key-padding
+    mask (a decoder over padded batches) — fwd and bwd."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from mxnet_tpu.ops.attention import sdpa_reference
+    from mxnet_tpu.ops.pallas import flash_attention as fa
+
+    b, h, s, d = 2, 2, 256, 64
+    q, k, v = _qkv(b=b, h=h, s=s, d=d, seed=5)
+    add = np.zeros((b, 1, 1, s), np.float32)
+    add[0, 0, 0, 200:] = -1e9
+    add = jnp.asarray(add)
+    km = fa._as_key_padding_mask(add, q, k)
+    scale = 1.0 / np.sqrt(d)
+
+    orig = pl.pallas_call
+    try:
+        pl.pallas_call = functools.partial(orig, interpret=True)
+        out, lse = fa._flash_forward(q, k, v, causal=True, scale=scale,
+                                     kmask=km)
+        g = jnp.ones_like(out)
+        dq, dk, dv = fa._flash_backward(q, k, v, out, lse, g,
+                                        causal=True, scale=scale,
+                                        kmask=km)
+    finally:
+        pl.pallas_call = orig
+
+    ref = sdpa_reference(q, k, v, add, causal=True)
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-3)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(sdpa_reference(q, k, v, add, causal=True))
+
+    rdq, rdk, rdv = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for got, want, name in ((dq, rdq, "dq"), (dk, rdk, "dk"),
+                            (dv, rdv, "dv")):
+        err = np.abs(np.asarray(got) - np.asarray(want)).max()
+        assert err < 5e-3, (name, err)
