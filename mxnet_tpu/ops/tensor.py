@@ -515,13 +515,20 @@ register("take", _k_take, arg_names=("a", "indices"),
 
 
 def _k_pick(data, index, *, axis=-1, keepdims=False, mode="clip"):
-    idx = jnp.expand_dims(index.astype(jnp.int32), axis if axis >= 0 else data.ndim + axis)
+    idx = index.astype(jnp.int32)
+    dim = data.shape[axis]
+    if mode == "wrap":
+        idx = idx % dim
+    else:  # "clip" (reference default)
+        idx = jnp.clip(idx, 0, dim - 1)
+    idx = jnp.expand_dims(idx, axis if axis >= 0 else data.ndim + axis)
     out = jnp.take_along_axis(data, idx, axis=axis)
     if not keepdims:
         out = jnp.squeeze(out, axis=axis)
     return out
 
-register("pick", _k_pick, arg_names=("data", "index"))
+register("pick", _k_pick, arg_names=("data", "index"),
+         aliases=("choose_element_0d",))  # legacy name (ref: mshadow op)
 
 
 def _k_gather_nd(data, indices):

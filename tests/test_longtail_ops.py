@@ -403,3 +403,20 @@ def test_special_gamma_family():
                                sp.gammaln(x), rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(nd.digamma(nd.array(x)).asnumpy(),
                                sp.digamma(x), atol=1e-5)
+
+
+def test_choose_element_0d_alias():
+    """Legacy alias of pick (ref: choose_element_0d, mshadow-era)."""
+    x = nd.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])
+    idx = nd.array([2.0, 0.0])
+    np.testing.assert_allclose(
+        nd.choose_element_0d(x, idx).asnumpy(), [3.0, 4.0])
+
+
+def test_pick_mode_clip_and_wrap():
+    x = nd.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])
+    oob = nd.array([5.0, -7.0])
+    np.testing.assert_allclose(  # clip (default): [2->2, -7->0]
+        nd.pick(x, oob).asnumpy(), [3.0, 4.0])
+    np.testing.assert_allclose(  # wrap: 5%3=2, -7%3=2
+        nd.pick(x, oob, mode="wrap").asnumpy(), [3.0, 6.0])
