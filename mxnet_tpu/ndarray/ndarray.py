@@ -496,10 +496,11 @@ class NDArray:
     def take(self, indices, axis=0, mode="clip"):
         return invoke(_take, self, indices, axis=axis, mode=mode)
 
-    def pick(self, index, axis=-1, keepdims=False):
+    def pick(self, index, axis=-1, keepdims=False, mode="clip"):
         from . import ops as _ops
 
-        return _ops.pick(self, index, axis=axis, keepdims=keepdims)
+        return _ops.pick(self, index, axis=axis, keepdims=keepdims,
+                         mode=mode)
 
     def one_hot(self, depth, on_value=1.0, off_value=0.0):
         from . import ops as _ops

@@ -515,6 +515,11 @@ register("take", _k_take, arg_names=("a", "indices"),
 
 
 def _k_pick(data, index, *, axis=-1, keepdims=False, mode="clip"):
+    if mode not in ("clip", "wrap"):
+        from ..base import MXNetError
+
+        raise MXNetError(f"pick: mode must be 'clip' or 'wrap', "
+                         f"got {mode!r}")
     idx = index.astype(jnp.int32)
     dim = data.shape[axis]
     if mode == "wrap":

@@ -420,3 +420,11 @@ def test_pick_mode_clip_and_wrap():
         nd.pick(x, oob).asnumpy(), [3.0, 4.0])
     np.testing.assert_allclose(  # wrap: 5%3=2, -7%3=2
         nd.pick(x, oob, mode="wrap").asnumpy(), [3.0, 6.0])
+
+
+def test_pick_method_and_bad_mode():
+    x = nd.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])
+    np.testing.assert_allclose(  # method API forwards mode
+        x.pick(nd.array([5.0, -7.0]), mode="wrap").asnumpy(), [3.0, 6.0])
+    with pytest.raises(mx.MXNetError, match="clip"):
+        nd.pick(x, nd.array([0.0, 1.0]), mode="warp")
