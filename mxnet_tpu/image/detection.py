@@ -264,12 +264,16 @@ class ImageDetIter:
                  path_imglist=None, path_root="", shuffle=False,
                  aug_list=None, label_pad_width=None,
                  label_pad_value=-1.0, data_name="data",
-                 label_name="label", last_batch_handle="pad"):
+                 label_name="label", last_batch_handle="pad",
+                 num_parts=1, part_index=0):
         from ..io.io import DataDesc
 
         self.batch_size = batch_size
         self.data_shape = tuple(data_shape)
         self.label_pad_value = float(label_pad_value)
+        from ..io.io import _check_partition
+
+        _check_partition(num_parts, part_index)  # before any dataset scan
         self._shuffle = shuffle
         # each item: (label 2-D array, source) where source is a str
         # path, raw encoded bytes, or a lazy-read key into self._rec
@@ -309,6 +313,8 @@ class ImageDetIter:
                         (label, os.path.join(path_root, parts[-1])))
         else:
             raise MXNetError("need path_imgrec or path_imglist")
+        if num_parts > 1:  # dist-worker shard (ref: num_parts/part_index)
+            self._items = self._items[part_index::num_parts]
         if not self._items:
             raise MXNetError("empty detection dataset")
 

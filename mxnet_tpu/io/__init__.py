@@ -21,7 +21,7 @@ def ImageDetRecordIter(**kwargs):
     core_keys = ("batch_size", "data_shape", "path_imgrec", "path_imglist",
                  "path_root", "shuffle", "aug_list", "label_pad_width",
                  "label_pad_value", "data_name", "label_name",
-                 "last_batch_handle")
+                 "last_batch_handle", "num_parts", "part_index")
     core = {k: kwargs.pop(k) for k in core_keys if k in kwargs}
     if kwargs and "aug_list" in core:
         raise MXNetError(

@@ -164,17 +164,32 @@ def _init_data(data, allow_empty, default_name):
     return out
 
 
+def _check_partition(num_parts, part_index):
+    """Validate the dist-worker sharding pair (ref: every C++ iter's
+    num_parts/part_index params)."""
+    if num_parts < 1 or not (0 <= part_index < num_parts):
+        raise MXNetError(
+            f"need 0 <= part_index < num_parts, got part_index="
+            f"{part_index} num_parts={num_parts}")
+
+
 class MNISTIter(DataIter):
     """Reads the classic idx-ubyte MNIST files (ref: src/io/iter_mnist.cc)."""
 
     def __init__(self, image, label, batch_size=128, shuffle=True,
                  flat=False, seed=0, silent=False, data_name="data",
-                 label_name="softmax_label", **kwargs):
+                 label_name="softmax_label", num_parts=1, part_index=0,
+                 **kwargs):
         super().__init__(batch_size)
+        _check_partition(num_parts, part_index)
         self._images = _read_idx_images(image)
         self._labels = _read_idx_labels(label)
         if self._images.shape[0] != self._labels.shape[0]:
             raise MXNetError("MNIST image/label count mismatch")
+        if num_parts > 1:
+            # dist-worker shard (ref: iter_mnist.cc num_parts/part_index)
+            self._images = self._images[part_index::num_parts]
+            self._labels = self._labels[part_index::num_parts]
         if flat:
             self._images = self._images.reshape(self._images.shape[0], -1)
         else:
@@ -230,17 +245,21 @@ class CSVIter(DataIter):
     """Ref: src/io/iter_csv.cc."""
 
     def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
-                 batch_size=1, round_batch=True, **kwargs):
+                 batch_size=1, round_batch=True, num_parts=1, part_index=0,
+                 **kwargs):
         super().__init__(batch_size)
+        _check_partition(num_parts, part_index)
         data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32,
                           ndmin=2)
         data = data.reshape((-1,) + tuple(data_shape))
-        label = None
         if label_csv is not None:
             label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32,
                                ndmin=2).reshape((-1,) + tuple(label_shape))
         else:
             label = np.zeros((data.shape[0], 1), np.float32)
+        if num_parts > 1:  # dist-worker shard
+            data = data[part_index::num_parts]
+            label = label[part_index::num_parts]
         self._iter = NDArrayIter(data, label, batch_size=batch_size,
                                  last_batch_handle="pad" if round_batch
                                  else "discard")
@@ -381,8 +400,11 @@ class ImageRecordIter(DataIter):
                  random_resized_crop=False, min_random_area=1.0,
                  max_random_area=1.0, min_aspect_ratio=1.0,
                  max_aspect_ratio=1.0, brightness=0.0, contrast=0.0,
-                 saturation=0.0, random_h=0.0, inter_method=1, **kwargs):
+                 saturation=0.0, random_h=0.0, inter_method=1,
+                 num_parts=1, part_index=0, **kwargs):
         super().__init__(batch_size)
+        _check_partition(num_parts, part_index)
+        self._num_parts, self._part_index = num_parts, part_index
         from . import recordio as rio
 
         # augmentation tier (ref: image_aug_default.cc —
@@ -401,10 +423,13 @@ class ImageRecordIter(DataIter):
         idx_path = path_imgidx or os.path.splitext(path_imgrec)[0] + ".idx"
         if os.path.exists(idx_path):
             self._rec = rio.MXIndexedRecordIO(idx_path, path_imgrec, "r")
-            self._keys = list(self._rec.keys)
+            # dist-worker shard: every num_parts-th record (ref:
+            # iter_image_recordio_2.cc num_parts/part_index)
+            self._keys = list(self._rec.keys)[part_index::num_parts]
         else:
             self._rec = rio.MXRecordIO(path_imgrec, "r")
             self._keys = None
+            self._stream_count = 0
         self.shuffle = shuffle
         self.rand_crop = rand_crop
         self.rand_mirror = rand_mirror
@@ -477,6 +502,7 @@ class ImageRecordIter(DataIter):
                 self._rng.shuffle(self._order)
         else:
             self._rec.reset()
+            self._stream_count = 0
         for _ in range(self._prefetch_depth):
             self._enqueue()
 
@@ -493,9 +519,16 @@ class ImageRecordIter(DataIter):
                 return None
             rec = self._rec.read_idx(self._order[self._pos])
         else:
-            rec = self._rec.read()
-            if rec is None:
-                return None
+            # streaming (no .idx): modulo-skip to this worker's shard
+            while True:
+                rec = self._rec.read()
+                if rec is None:
+                    return None
+                mine = (self._stream_count % self._num_parts
+                        == self._part_index)
+                self._stream_count += 1
+                if mine:
+                    break
         self._pos += 1
         return rec
 

@@ -340,3 +340,16 @@ def test_draw_next(tmp_path):
                           aug_list=[])
     drawn = list(it.draw_next())
     assert len(drawn) == 2 and drawn[0].shape == (32, 32, 3)
+
+
+def test_image_det_iter_num_parts(tmp_path):
+    paths = _write_images(tmp_path)
+    labs = _labels(len(paths))
+    lst = _write_lst(tmp_path, paths, labs)
+    tot = 0
+    for part in range(2):
+        it = img.ImageDetIter(batch_size=3, data_shape=(3, 16, 16),
+                              path_imglist=lst, path_root=str(tmp_path),
+                              aug_list=[], num_parts=2, part_index=part)
+        tot += sum(b.data[0].shape[0] - b.pad for b in it)
+    assert tot == 6  # exact partition of the 6 images
