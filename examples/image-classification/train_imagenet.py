@@ -61,9 +61,19 @@ def data_source(args):
         while True:
             yield x, y
     else:
+        # dist workers read disjoint shards (the kv.num_workers/kv.rank
+        # pattern; the launcher exports the DMLC_* env these default to)
+        # same env chain as parallel/dist.py: MXTPU_* preferred, DMLC_*
+        # (launcher protocol) as the fallback
+        num_parts = args.num_parts or int(os.environ.get(
+            "MXTPU_NUM_WORKER", os.environ.get("DMLC_NUM_WORKER", 1)))
+        part_index = args.part_index if args.part_index >= 0 else int(
+            os.environ.get("MXTPU_WORKER_ID",
+                           os.environ.get("DMLC_WORKER_ID", 0)))
         it = mx.io.ImageRecordIter(
             path_imgrec=args.data_train, data_shape=(c, h, w),
             batch_size=args.batch_size, shuffle=True,
+            num_parts=num_parts, part_index=part_index,
             rand_mirror=True,
             # the standard ImageNet recipe: area/aspect-sampled crops
             # + color jitter (ref: image_aug_default.cc defaults used by
@@ -106,6 +116,10 @@ def main():
     p.add_argument("--lr", type=float, default=0.1)
     p.add_argument("--lr-step-epochs", default="30,60,80")
     p.add_argument("--data-nthreads", type=int, default=8)
+    p.add_argument("--num-parts", type=int, default=0,
+                   help="dist data shards (0 = DMLC_NUM_WORKER env)")
+    p.add_argument("--part-index", type=int, default=-1,
+                   help="this worker's shard (-1 = DMLC_WORKER_ID env)")
     p.add_argument("--disp-batches", type=int, default=20)
     p.add_argument("--bulk-steps", type=int, default=1,
                    help="run K steps per dispatch as one XLA "
