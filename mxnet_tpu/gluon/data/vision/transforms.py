@@ -93,6 +93,52 @@ class CenterCrop(Block):
         return x[y0:y0 + h, x0:x0 + w]
 
 
+class RandomCrop(Block):
+    """Random-position crop, optionally zero-padding first
+    (ref: transforms.RandomCrop)."""
+
+    def __init__(self, size, pad=None):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size,
+                                                                   size)
+        self._pad = pad
+
+    def forward(self, x):
+        if self._pad:
+            p = self._pad
+            x = _nd.array(np.pad(x.asnumpy(),
+                                 ((p, p), (p, p), (0, 0))))
+        w, h = self._size
+        ih, iw = x.shape[0], x.shape[1]
+        if ih < h or iw < w:
+            from ....base import MXNetError
+
+            raise MXNetError(
+                f"RandomCrop: image ({ih}x{iw}) smaller than crop "
+                f"({h}x{w}); use pad= or resize first")
+        y0 = np.random.randint(0, ih - h + 1)
+        x0 = np.random.randint(0, iw - w + 1)
+        return x[y0:y0 + h, x0:x0 + w]
+
+
+class RandomGray(Block):
+    """Randomly convert to 3-channel gray (ref: transforms.RandomGray)."""
+
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        if np.random.rand() < self._p:
+            from ....image.image import RandomGrayAug
+
+            # keep the input dtype: the gray matmul promotes to float32,
+            # and a stochastic dtype change breaks dtype-sensitive
+            # consumers downstream
+            return RandomGrayAug(1.0)(x).astype(x.dtype)
+        return x
+
+
 class RandomResizedCrop(Block):
     def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
                  interpolation=1):

@@ -225,3 +225,30 @@ def test_mcc_metric():
     assert name == "mcc" and abs(val - want) < 1e-6
     m.reset()
     assert m.get()[1] == 0.0
+
+
+def test_transforms_random_crop_and_gray():
+    """gluon transforms RandomCrop (with padding) + RandomGray (ref:
+    gluon/data/vision/transforms.py)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randint(0, 255, (20, 24, 3)).astype(np.float32))
+    assert T.RandomCrop(12)(x).shape == (12, 12, 3)
+    padded = T.RandomCrop((24, 20), pad=4)(x)
+    assert padded.shape == (20, 24, 3)
+    g = T.RandomGray(1.0)(x)
+    assert np.allclose(g.asnumpy()[..., 0], g.asnumpy()[..., 1], atol=1e-3)
+    assert g.dtype == x.dtype  # no stochastic dtype change
+    assert T.RandomGray(0.0)(x) is x  # skip path returns input untouched
+    out = T.Compose([T.RandomCrop(16), T.RandomGray(0.5),
+                     T.ToTensor()])(x)
+    assert out.shape == (3, 16, 16)
+    import pytest as _pytest
+
+    with _pytest.raises(mx.MXNetError, match="smaller than crop"):
+        T.RandomCrop(64)(x)
+    u8 = nd.array(np.zeros((8, 8, 3)), dtype="uint8")
+    assert T.RandomGray(1.0)(u8).dtype == u8.dtype
