@@ -286,8 +286,10 @@ class LibSVMIter(DataIter):
     format; models densify or use sparse.dot (see ndarray/sparse.py)."""
 
     def __init__(self, data_libsvm, data_shape, label_libsvm=None,
-                 label_shape=None, batch_size=1, round_batch=True, **kwargs):
+                 label_shape=None, batch_size=1, round_batch=True,
+                 num_parts=1, part_index=0, **kwargs):
         super().__init__(batch_size)
+        _check_partition(num_parts, part_index)
         self._num_features = int(
             data_shape[0] if isinstance(data_shape, (tuple, list))
             else data_shape)
@@ -318,6 +320,25 @@ class LibSVMIter(DataIter):
         else:
             self._labels = self._labels.reshape(-1)
         self._n = len(self._labels)
+        if self._n != len(self._indptr) - 1:
+            raise MXNetError(
+                f"libsvm label/data row mismatch: {self._n} labels vs "
+                f"{len(self._indptr) - 1} data rows")
+        if num_parts > 1:  # dist-worker shard: CSR row subset
+            keep = np.arange(self._n)[part_index::num_parts]
+            starts, ends = self._indptr[keep], self._indptr[keep + 1]
+            lens = ends - starts
+            # vectorized per-row index expansion (no python-level loop)
+            take = (np.repeat(starts - np.concatenate(
+                [[0], np.cumsum(lens[:-1])]), lens)
+                    + np.arange(lens.sum())) if len(keep) \
+                else np.empty((0,), np.int64)
+            self._vals = self._vals[take]
+            self._cols = self._cols[take]
+            self._indptr = np.concatenate(
+                [[0], np.cumsum(lens)]).astype(np.int64)
+            self._labels = self._labels[keep]
+            self._n = len(keep)
         self._round_batch = round_batch
         self._cursor = 0
 

@@ -511,7 +511,7 @@ class DataParallelTrainer:
                 out[f"state::{i}::{j}"] = leaf
         return out
 
-    def save_states(self, prefix):
+    def save_states(self, prefix, async_save=False):
         """Sharded SPMD checkpoint (ref: trainer.save_states + Module
         do_checkpoint, SURVEY §5 checkpoint mechanisms).
 
@@ -520,26 +520,48 @@ class DataParallelTrainer:
         optimizer state wasn't saved at all). Layout:
         ``{prefix}-meta.npz`` (step counter, lr, mesh shape) +
         ``{prefix}-shards-p{rank}.npz`` per process.
+
+        ``async_save=True`` snapshots device shards to host memory
+        synchronously (cheap; must happen before the next donated step
+        invalidates the buffers) and pushes the file write onto the
+        engine's host pool so training overlaps the disk IO (orbax-style
+        async checkpointing). Returns a future — call ``.result()``
+        before relying on the files (it also re-raises any write error).
         """
         if self._step_fn is None:
             raise MXNetError("save_states before the first step: nothing "
                              "to checkpoint yet")
         proc = jax.process_index()
+        # D2H snapshot happens NOW in both modes: the step donates param
+        # buffers, so device refs must not outlive the next step()
         shard_arrays = {}
         for key, arr in self._ckpt_tensors().items():
             for s in arr.addressable_shards:
                 if s.replica_id != 0:
                     continue  # one copy per distinct shard
                 sid = self._shard_id(s.index, arr.shape)
-                shard_arrays[f"{key}@@{sid}"] = np.asarray(s.data)
-        np.savez(f"{prefix}-shards-p{proc}.npz", **shard_arrays)
-        if proc == 0:
-            np.savez(f"{prefix}-meta.npz",
-                     t=np.int64(self._t), lr=np.float64(self._lr),
-                     mesh_shape=np.array(
-                         [self.mesh.shape[a] for a in self.mesh.axis_names],
-                         np.int64),
-                     mesh_axes=np.array(list(self.mesh.axis_names)))
+                # copy=True: on CPU backends __array__ can be zero-copy,
+                # and an aliased view would be clobbered by the next
+                # donated step while the async write is in flight
+                shard_arrays[f"{key}@@{sid}"] = np.array(s.data,
+                                                         copy=True)
+        meta = dict(t=np.int64(self._t), lr=np.float64(self._lr),
+                    mesh_shape=np.array(
+                        [self.mesh.shape[a] for a in self.mesh.axis_names],
+                        np.int64),
+                    mesh_axes=np.array(list(self.mesh.axis_names)))
+
+        def _write():
+            np.savez(f"{prefix}-shards-p{proc}.npz", **shard_arrays)
+            if proc == 0:
+                np.savez(f"{prefix}-meta.npz", **meta)
+
+        if async_save:
+            from .. import engine as _engine
+
+            return _engine.push_host(_write)
+        _write()
+        return None
 
     def load_states(self, prefix):
         """Restore a sharded checkpoint onto the SAME mesh topology.

@@ -320,3 +320,28 @@ def test_csv_iter_label_csv_roundtrip(tmp_path):
     it3 = CSVIter(data_csv=str(tmp_path / "d.csv"), data_shape=(2,),
                   batch_size=3, num_parts=2, part_index=1)
     assert (np.concatenate([b.label[0].asnumpy() for b in it3]) == 0).all()
+
+
+def test_libsvm_iter_num_parts(tmp_path):
+    lines = ["1 0:1.0 3:2.0", "0 1:3.0", "1 2:4.0 4:5.0", "0 0:6.0"]
+    p = str(tmp_path / "d.svm")
+    open(p, "w").write("\n".join(lines) + "\n")
+    from mxnet_tpu.io import LibSVMIter
+
+    it = LibSVMIter(data_libsvm=p, data_shape=(5,), batch_size=2,
+                    num_parts=2, part_index=1)
+    b = next(iter(it))
+    dense = b.data[0].todense().asnumpy()
+    np.testing.assert_allclose(dense[0], [0, 3, 0, 0, 0])  # row 1
+    np.testing.assert_allclose(dense[1], [6, 0, 0, 0, 0])  # row 3
+    np.testing.assert_allclose(b.label[0].asnumpy(), [0, 0])
+
+
+def test_libsvm_label_row_mismatch_raises(tmp_path):
+    open(str(tmp_path / "d.svm"), "w").write("1 0:1.0\n0 1:2.0\n")
+    open(str(tmp_path / "l.svm"), "w").write("1\n0\n1\n")  # 3 labels, 2 rows
+    from mxnet_tpu.io import LibSVMIter
+
+    with pytest.raises(mx.MXNetError, match="mismatch"):
+        LibSVMIter(data_libsvm=str(tmp_path / "d.svm"), data_shape=(4,),
+                   label_libsvm=str(tmp_path / "l.svm"), batch_size=1)
