@@ -5,9 +5,10 @@ the other BASELINE-class workloads and the custom kernels, one JSON
 line per subcommand (ref: example/image-classification/
 benchmark_score.py + tools/bandwidth/measure.py roles):
 
-  python tools/bench_workloads.py bert        # BERT-base MLM train step
-  python tools/bench_workloads.py attention   # pallas flash vs XLA sdpa
-  python tools/bench_workloads.py rnn         # pallas LSTM vs lax.scan
+  python tools/bench_workloads.py bert         # BERT-base MLM train step
+  python tools/bench_workloads.py transformer  # Transformer-big WMT14 step
+  python tools/bench_workloads.py attention    # pallas flash vs XLA sdpa
+  python tools/bench_workloads.py rnn          # pallas LSTM vs lax.scan
   python tools/bench_workloads.py all
 """
 import argparse
@@ -23,8 +24,13 @@ sys.path.insert(0, REPO)
 def _setup_jax():
     import jax
 
+    # per-platform cache dirs: the axon tunnel compiles remotely and its
+    # XLA:CPU AOT artifacts carry that host's machine features — loading
+    # them locally risks SIGILL/slow paths (same split as bench.py)
+    plat = jax.devices()[0].platform
+    cache = ".jax_cache_cpu" if plat == "cpu" else ".jax_cache"
     jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(REPO, ".jax_cache"))
+                      os.path.join(REPO, cache))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
     return jax
 
@@ -36,14 +42,57 @@ def _peak_flops(dev):
     return pf(dev.device_kind) if dev.platform == "tpu" else None
 
 
+def _bench_trainer(jax, trainer, x, y, steps, tokens_per_step, metric,
+                   lr, extra):
+    """Shared harness: warmup, best-of-3 bulk-scan timing, FLOPs via
+    cost analysis, chip-aggregated MFU, one JSON line."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu import random as _random
+
+    trainer.step(x, y).wait_to_read()
+    trainer.step_many(x, y, n_steps=steps).asnumpy()  # compile scan
+    dt = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        losses = trainer.step_many(x, y, n_steps=steps)
+        losses.asnumpy()
+        w = time.perf_counter() - t0
+        dt = w if dt is None or w < dt else dt
+
+    flops = None
+    try:
+        xj = tuple(jnp.asarray(v) for v in x) if isinstance(
+            x, (tuple, list)) else jnp.asarray(x)
+        lowered = trainer._step_fn.lower(
+            trainer._params, trainer._states, xj, jnp.asarray(y),
+            _random.next_key(), jnp.asarray(lr, jnp.float32),
+            jnp.asarray(3.0, jnp.float32))
+        cost = lowered.cost_analysis()
+        c = cost[0] if isinstance(cost, (list, tuple)) else cost
+        flops = float(c.get("flops", 0.0)) or None
+    except Exception:
+        pass
+    dev = jax.devices()[0]
+    # cost_analysis FLOPs cover the GLOBAL batch over the dp mesh, so
+    # peak must aggregate every chip the step ran on (as bench.py does)
+    chip_peak = _peak_flops(dev)
+    n_chips = len(trainer.mesh.devices.flat)
+    peak = chip_peak * n_chips if chip_peak else None
+    mfu = (flops * steps / dt / peak) if (flops and peak) else None
+    print(json.dumps(dict({
+        "metric": metric, "value": round(steps * tokens_per_step / dt),
+        "unit": "tokens/sec", "mfu": round(mfu, 4) if mfu else None,
+        "device_kind": dev.device_kind, "platform": dev.platform,
+        "final_loss": round(float(losses.asnumpy()[-1]), 4)}, **extra)))
+
+
 def bench_bert(bs=32, seq_len=128, steps=20):
     """BERT-base MLM+NSP training step (BASELINE config #3)."""
     jax = _setup_jax()
-    import jax.numpy as jnp
     import numpy as np
 
     import mxnet_tpu as mx
-    from mxnet_tpu import random as _random
     from mxnet_tpu.models import bert as bert_mod
     from mxnet_tpu.parallel import data_parallel
 
@@ -67,45 +116,40 @@ def bench_bert(bs=32, seq_len=128, steps=20):
         compute_dtype="bfloat16")
     x = synthetic_batch(rng, bs, seq_len, vocab)
     y = np.zeros((bs,), np.float32)  # unused by the loss head
+    _bench_trainer(jax, trainer, x, y, steps, bs * seq_len,
+                   "bert_base_mlm_throughput", 1e-4,
+                   {"batch_size": bs, "seq_len": seq_len})
 
-    trainer.step(x, y).wait_to_read()
-    trainer.step_many(x, y, n_steps=steps).asnumpy()  # compile scan
-    dt = None
-    for _ in range(3):
-        t0 = time.perf_counter()
-        losses = trainer.step_many(x, y, n_steps=steps)
-        losses.asnumpy()
-        w = time.perf_counter() - t0
-        dt = w if dt is None or w < dt else dt
-    tokens_per_sec = steps * bs * seq_len / dt
 
-    flops = None
-    try:
-        from mxnet_tpu.parallel import mesh as mesh_mod
+def bench_transformer(bs=32, seq_len=32, steps=20, model="big"):
+    """Transformer-{base,big} WMT14-style train step (BASELINE #4)."""
+    jax = _setup_jax()
+    import numpy as np
 
-        lowered = trainer._step_fn.lower(
-            trainer._params, trainer._states,
-            tuple(jnp.asarray(v) for v in x), jnp.asarray(y),
-            _random.next_key(), jnp.asarray(1e-4, jnp.float32),
-            jnp.asarray(3.0, jnp.float32))
-        cost = lowered.cost_analysis()
-        c = cost[0] if isinstance(cost, (list, tuple)) else cost
-        flops = float(c.get("flops", 0.0)) or None
-    except Exception:
-        pass
-    dev = jax.devices()[0]
-    # cost_analysis FLOPs cover the GLOBAL batch over the dp mesh, so
-    # peak must aggregate every chip the step ran on (as bench.py does)
-    chip_peak = _peak_flops(dev)
-    n_chips = len(trainer.mesh.devices.flat)
-    peak = chip_peak * n_chips if chip_peak else None
-    mfu = (flops * steps / dt / peak) if (flops and peak) else None
-    print(json.dumps({
-        "metric": "bert_base_mlm_throughput", "value": round(tokens_per_sec),
-        "unit": "tokens/sec", "mfu": round(mfu, 4) if mfu else None,
-        "batch_size": bs, "seq_len": seq_len,
-        "device_kind": dev.device_kind, "platform": dev.platform,
-        "final_loss": round(float(losses.asnumpy()[-1]), 4)}))
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import transformer as tfm
+    from mxnet_tpu.parallel import data_parallel
+
+    sys.path.insert(0, os.path.join(REPO, "examples", "nmt"))
+    sys.path.insert(0, os.path.join(REPO, "examples"))
+    from train_transformer import (LabelSmoothedCE, Seq2SeqTrainNet,
+                                   synthetic_pairs)
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    vocab = 32000
+    net = Seq2SeqTrainNet(getattr(tfm, f"transformer_{model}")(vocab,
+                                                               vocab))
+    net.initialize(mx.init.Xavier())
+    trainer = data_parallel.DataParallelTrainer(
+        net, LabelSmoothedCE(), "adam",
+        {"learning_rate": 3e-4, "beta2": 0.98},
+        compute_dtype="bfloat16")
+    src, tgt_in, tgt_out = synthetic_pairs(rng, bs, seq_len, vocab)
+    _bench_trainer(jax, trainer, (src, tgt_in), tgt_out, steps,
+                   bs * seq_len,
+                   f"transformer_{model}_train_throughput", 3e-4,
+                   {"batch_size": bs, "seq_len": seq_len})
 
 
 def bench_attention(bs=8, heads=16, seq=2048, hd=64, iters=20):
@@ -198,13 +242,18 @@ def bench_rnn(bs=64, seq=256, input_size=512, hidden=512, iters=10):
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("which", choices=["bert", "attention", "rnn", "all"])
+    p.add_argument("which", choices=["bert", "transformer", "attention",
+                                     "rnn", "all"])
     p.add_argument("--batch-size", type=int, default=None,
                    help="override the per-benchmark default batch size")
+    p.add_argument("--model", default="big", choices=["base", "big"],
+                   help="transformer variant (transformer subcommand)")
     args = p.parse_args()
     bs_kw = {"bs": args.batch_size} if args.batch_size else {}
     if args.which in ("bert", "all"):
         bench_bert(**bs_kw)
+    if args.which in ("transformer", "all"):
+        bench_transformer(model=args.model, **bs_kw)
     if args.which in ("attention", "all"):
         bench_attention(**bs_kw)
     if args.which in ("rnn", "all"):
