@@ -7,6 +7,7 @@ benchmark_score.py + tools/bandwidth/measure.py roles):
 
   python tools/bench_workloads.py bert         # BERT-base MLM train step
   python tools/bench_workloads.py transformer  # Transformer-big WMT14 step
+  python tools/bench_workloads.py deepar       # DeepAR forecasting step
   python tools/bench_workloads.py attention    # pallas flash vs XLA sdpa
   python tools/bench_workloads.py rnn          # pallas LSTM vs lax.scan
   python tools/bench_workloads.py all
@@ -43,9 +44,10 @@ def _peak_flops(dev):
 
 
 def _bench_trainer(jax, trainer, x, y, steps, tokens_per_step, metric,
-                   lr, extra):
+                   extra):
     """Shared harness: warmup, best-of-3 bulk-scan timing, FLOPs via
-    cost analysis, chip-aggregated MFU, one JSON line."""
+    cost analysis, chip-aggregated MFU, one JSON line. `extra` keys
+    override the defaults (e.g. a different "unit")."""
     import jax.numpy as jnp
 
     from mxnet_tpu import random as _random
@@ -64,9 +66,12 @@ def _bench_trainer(jax, trainer, x, y, steps, tokens_per_step, metric,
     try:
         xj = tuple(jnp.asarray(v) for v in x) if isinstance(
             x, (tuple, list)) else jnp.asarray(x)
+        # lower() traces abstractly — only shapes/dtypes matter, so the
+        # trainer's own lr scalar serves
         lowered = trainer._step_fn.lower(
             trainer._params, trainer._states, xj, jnp.asarray(y),
-            _random.next_key(), jnp.asarray(lr, jnp.float32),
+            _random.next_key(),
+            jnp.asarray(trainer._lr, jnp.float32),
             jnp.asarray(3.0, jnp.float32))
         cost = lowered.cost_analysis()
         c = cost[0] if isinstance(cost, (list, tuple)) else cost
@@ -85,6 +90,13 @@ def _bench_trainer(jax, trainer, x, y, steps, tokens_per_step, metric,
         "unit": "tokens/sec", "mfu": round(mfu, 4) if mfu else None,
         "device_kind": dev.device_kind, "platform": dev.platform,
         "final_loss": round(float(losses.asnumpy()[-1]), 4)}, **extra)))
+
+
+class _Identity:
+    """Loss adapter for nets whose forward already returns the loss."""
+
+    def __call__(self, out, _):
+        return out
 
 
 def bench_bert(bs=32, seq_len=128, steps=20):
@@ -107,17 +119,13 @@ def bench_bert(bs=32, seq_len=128, steps=20):
     net = BERTForPretrain(model, vocab)
     net.initialize(mx.init.Xavier())
 
-    class _Identity:
-        def __call__(self, out, _):
-            return out
-
     trainer = data_parallel.DataParallelTrainer(
         net, _Identity(), "adamw", {"learning_rate": 1e-4, "wd": 0.01},
         compute_dtype="bfloat16")
     x = synthetic_batch(rng, bs, seq_len, vocab)
     y = np.zeros((bs,), np.float32)  # unused by the loss head
     _bench_trainer(jax, trainer, x, y, steps, bs * seq_len,
-                   "bert_base_mlm_throughput", 1e-4,
+                   "bert_base_mlm_throughput",
                    {"batch_size": bs, "seq_len": seq_len})
 
 
@@ -148,8 +156,37 @@ def bench_transformer(bs=32, seq_len=32, steps=20, model="big"):
     src, tgt_in, tgt_out = synthetic_pairs(rng, bs, seq_len, vocab)
     _bench_trainer(jax, trainer, (src, tgt_in), tgt_out, steps,
                    bs * seq_len,
-                   f"transformer_{model}_train_throughput", 3e-4,
+                   f"transformer_{model}_train_throughput",
                    {"batch_size": bs, "seq_len": seq_len})
+
+
+def bench_deepar(bs=64, context_length=72, prediction_length=24,
+                 steps=20, num_cells=40, num_layers=2):
+    """DeepAR probabilistic-forecasting train step (BASELINE #5)."""
+    jax = _setup_jax()
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+    from mxnet_tpu.parallel import data_parallel
+
+    sys.path.insert(0, os.path.join(REPO, "examples", "forecasting"))
+    sys.path.insert(0, os.path.join(REPO, "examples"))
+    from train_deepar import synthetic_series
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    net = models.deepar(num_cells, num_layers)
+    net.initialize(mx.init.Xavier())
+    trainer = data_parallel.DataParallelTrainer(
+        net, _Identity(), "adam", {"learning_rate": 1e-3})
+    T = context_length + prediction_length
+    x = synthetic_series(rng, bs, T).astype(np.float32)
+    y = np.zeros((bs,), np.float32)  # unused by the NLL head
+    _bench_trainer(jax, trainer, x, y, steps, bs * T,
+                   "deepar_train_throughput",
+                   {"batch_size": bs, "series_length": T,
+                    "unit": "series points/sec"})
 
 
 def bench_attention(bs=8, heads=16, seq=2048, hd=64, iters=20):
@@ -242,8 +279,8 @@ def bench_rnn(bs=64, seq=256, input_size=512, hidden=512, iters=10):
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("which", choices=["bert", "transformer", "attention",
-                                     "rnn", "all"])
+    p.add_argument("which", choices=["bert", "transformer", "deepar",
+                                     "attention", "rnn", "all"])
     p.add_argument("--batch-size", type=int, default=None,
                    help="override the per-benchmark default batch size")
     p.add_argument("--model", default="big", choices=["base", "big"],
@@ -254,6 +291,8 @@ def main():
         bench_bert(**bs_kw)
     if args.which in ("transformer", "all"):
         bench_transformer(model=args.model, **bs_kw)
+    if args.which in ("deepar", "all"):
+        bench_deepar(**bs_kw)
     if args.which in ("attention", "all"):
         bench_attention(**bs_kw)
     if args.which in ("rnn", "all"):
