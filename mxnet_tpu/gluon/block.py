@@ -274,6 +274,7 @@ class CachedOp:
         for fn in self._fns.values():
             _imperative.evict(fn)
         self._fns.clear()
+        self._meta.clear()  # stale meta must not outlive its graph fn
 
     def __del__(self):
         try:
