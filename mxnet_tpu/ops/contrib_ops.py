@@ -191,6 +191,99 @@ def _k_box_nms(data, *, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
     return out.reshape(orig_shape)
 
 
+def _k_box_decode(data, anchors, *, std0=1.0, std1=1.0, std2=1.0,
+                  std3=1.0, clip=-1.0, format="corner"):
+    """Decode center-offset deltas against anchors back to corner boxes
+    (ref: src/operator/contrib/bounding_box.cc BoxDecode).
+
+    data (B, N, 4) deltas; anchors (1, N, 4) in `format`; output corner
+    (B, N, 4). clip > 0 bounds dw/dh exponents."""
+    a = _to_center(_corner(anchors, format))
+    ax, ay, aw, ah = (a[..., i] for i in range(4))
+    dx, dy, dw, dh = (data[..., i] for i in range(4))
+    cx = dx * std0 * aw + ax
+    cy = dy * std1 * ah + ay
+    dw = dw * std2
+    dh = dh * std3
+    if clip > 0:
+        dw = jnp.minimum(dw, clip)
+        dh = jnp.minimum(dh, clip)
+    w = jnp.exp(dw) * aw
+    h = jnp.exp(dh) * ah
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                     axis=-1)
+
+
+def _k_box_encode(samples, matches, anchors, refs, means, stds):
+    """Encode matched ground-truth boxes as normalized center-offset
+    regression targets (ref: bounding_box.cc BoxEncode).
+
+    samples (B, N) {+1 pos, else ignore}; matches (B, N) ref indices;
+    anchors (B, N, 4) corner; refs (B, M, 4) corner; means/stds (4,).
+    Returns (targets (B, N, 4), masks (B, N, 4))."""
+    m = matches.astype(jnp.int32)
+    matched = jnp.take_along_axis(refs, m[..., None].repeat(4, -1),
+                                  axis=1)
+    a = _to_center(anchors)
+    g = _to_center(matched)
+    ax, ay, aw, ah = (a[..., i] for i in range(4))
+    gx, gy, gw, gh = (g[..., i] for i in range(4))
+    t = jnp.stack([(gx - ax) / jnp.maximum(aw, 1e-12),
+                   (gy - ay) / jnp.maximum(ah, 1e-12),
+                   jnp.log(jnp.maximum(gw, 1e-12)
+                           / jnp.maximum(aw, 1e-12)),
+                   jnp.log(jnp.maximum(gh, 1e-12)
+                           / jnp.maximum(ah, 1e-12))], axis=-1)
+    t = (t - means.reshape(1, 1, 4)) / stds.reshape(1, 1, 4)
+    mask = (samples > 0.5)[..., None].astype(t.dtype)
+    return t * mask, jnp.broadcast_to(mask, t.shape)
+
+
+def _k_adaptive_avg_pool2d(data, *, output_size=1):
+    """NCHW adaptive average pooling (ref: contrib/adaptive_avg_pooling.cc):
+    each output cell averages its floor/ceil input region, torch-style."""
+    if isinstance(output_size, int):
+        oh = ow = int(output_size)
+    elif len(output_size) == 1:  # 1-elem shape means square (ref)
+        oh = ow = int(output_size[0])
+    else:
+        oh, ow = (int(v) for v in output_size)
+    n, c, h, w = data.shape
+    rows = []
+    for i in range(oh):
+        h0, h1 = (i * h) // oh, -((-(i + 1) * h) // oh)  # floor, ceil
+        cols = []
+        for j in range(ow):
+            w0, w1 = (j * w) // ow, -((-(j + 1) * w) // ow)
+            cols.append(jnp.mean(data[:, :, h0:h1, w0:w1], axis=(2, 3)))
+        rows.append(jnp.stack(cols, axis=-1))
+    return jnp.stack(rows, axis=-2)
+
+
+def _k_index_array(data, *, axes=None):
+    """Index coordinates of every element: shape data.shape + (len(axes),)
+    (ref: contrib/index_array.cc)."""
+    shape = data.shape
+    sel = tuple(range(len(shape))) if axes is None else \
+        tuple(int(a) % len(shape) for a in axes)  # negatives supported
+    comps = [jax.lax.broadcasted_iota(jnp.int32, shape, ax) for ax in sel]
+    return jnp.stack(comps, axis=-1)
+
+
+register("_contrib_box_decode", _k_box_decode,
+         arg_names=("data", "anchors"), aliases=("box_decode",),
+         nondiff=True, doc=_k_box_decode.__doc__)
+register("_contrib_box_encode", _k_box_encode,
+         arg_names=("samples", "matches", "anchors", "refs", "means",
+                    "stds"),
+         num_outputs=2, nondiff=True, doc=_k_box_encode.__doc__)
+register("_contrib_AdaptiveAvgPooling2D", _k_adaptive_avg_pool2d,
+         arg_names=("data",), aliases=("adaptive_avg_pool2d",),
+         doc=_k_adaptive_avg_pool2d.__doc__)
+register("_contrib_index_array", _k_index_array, arg_names=("data",),
+         aliases=("index_array",), nondiff=True,
+         doc=_k_index_array.__doc__)
+
 register("_contrib_box_iou", _k_box_iou, arg_names=("lhs", "rhs"),
          aliases=("box_iou",), nondiff=True, doc=_k_box_iou.__doc__)
 register("_contrib_box_nms", _k_box_nms, arg_names=("data",),

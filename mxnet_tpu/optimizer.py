@@ -706,6 +706,46 @@ class Nadam(Optimizer):
         weight._data = new_w._data
 
 
+def _k_group_adagrad(w, g, hist, lr, *, epsilon, rescale, clip):
+    # per-ROW accumulated squared gradient (ref:
+    # src/operator/contrib/optimizer_op.cc GroupAdagradUpdate) — the
+    # embedding-friendly AdaGrad variant; no wd term in the reference
+    gp = g * rescale
+    if clip is not None:
+        gp = jnp.clip(gp, -clip, clip)
+    axes = tuple(range(1, gp.ndim))
+    new_h = hist + jnp.mean(jnp.square(gp), axis=axes, keepdims=True) \
+        if gp.ndim > 1 else hist + jnp.square(gp)
+    return w - lr * gp / (jnp.sqrt(new_h) + epsilon), new_h
+
+
+@register("groupadagrad")
+class GroupAdaGrad(Optimizer):
+    """Row-wise AdaGrad (ref: mx.optimizer.contrib.GroupAdaGrad)."""
+
+    def __init__(self, learning_rate=0.01, epsilon=1e-5, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        if kwargs.get("wd"):
+            raise MXNetError(
+                "GroupAdaGrad does not support weight decay "
+                "(ref: optimizer/contrib.py assertion)")
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        shape = (weight.shape[0],) + (1,) * (len(weight.shape) - 1)
+        return _nd.zeros(shape, dtype=weight.dtype, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._scalar(self._get_lr(index), weight)
+        common = self._common(index)
+        common.pop("wd", None)  # reference GroupAdaGrad has no wd term
+        new_w, nh = invoke(_k_group_adagrad, weight, grad, state, lr,
+                           epsilon=self.epsilon, **common)
+        state._data = nh._data
+        weight._data = new_w._data
+
+
 @register("sgld")
 class SGLD(Optimizer):
     """Stochastic Gradient Langevin Dynamics (ref: mx.optimizer.SGLD)."""

@@ -377,3 +377,83 @@ def test_multibox_target_padding_gt_cannot_clobber():
     _, bm, ct = nd.contrib.MultiBoxTarget(anchors, label, cls_pred)
     assert ct.asnumpy()[0, 0] == 2.0  # class 1 -> target 2
     assert (bm.asnumpy() > 0).all()
+
+
+def test_box_decode_encode_roundtrip():
+    """encode(anchors, refs) then decode must reproduce the refs
+    (ref: contrib bounding_box.cc BoxEncode/BoxDecode)."""
+    rng = np.random.RandomState(0)
+    B, N = 2, 5
+    base = np.zeros((1, N, 4), np.float32)
+    base[..., 0] = rng.uniform(0, 0.5, (1, N))
+    base[..., 1] = rng.uniform(0, 0.5, (1, N))
+    base[..., 2] = base[..., 0] + rng.uniform(0.1, 0.4, (1, N))
+    base[..., 3] = base[..., 1] + rng.uniform(0.1, 0.4, (1, N))
+    anchors = np.tile(base, (B, 1, 1))  # decode broadcasts (1, N, 4)
+    refs = anchors + rng.uniform(-0.03, 0.03, anchors.shape).astype(
+        np.float32)
+    samples = np.ones((B, N), np.float32)
+    matches = np.tile(np.arange(N, dtype=np.float32), (B, 1))
+    means = np.zeros(4, np.float32)
+    stds = np.ones(4, np.float32)
+
+    t, m = nd.contrib.box_encode(nd.array(samples), nd.array(matches),
+                                 nd.array(anchors), nd.array(refs),
+                                 nd.array(means), nd.array(stds))
+    assert m.asnumpy().min() == 1.0  # all positive samples
+    dec = nd.contrib.box_decode(t, nd.array(anchors[:1]))
+    np.testing.assert_allclose(dec.asnumpy(), refs, atol=1e-4)
+    # negative samples are masked out
+    samples[0, 0] = 0.0
+    t2, m2 = nd.contrib.box_encode(nd.array(samples), nd.array(matches),
+                                   nd.array(anchors), nd.array(refs),
+                                   nd.array(means), nd.array(stds))
+    assert (t2.asnumpy()[0, 0] == 0).all() and m2.asnumpy()[0, 0, 0] == 0
+
+
+def test_adaptive_avg_pooling2d():
+    x = nd.array(np.arange(2 * 3 * 6 * 6, dtype=np.float32)
+                 .reshape(2, 3, 6, 6))
+    out = nd.contrib.AdaptiveAvgPooling2D(x, output_size=3)
+    assert out.shape == (2, 3, 3, 3)
+    # divisible case equals plain 2x2 average pooling
+    ref = x.asnumpy().reshape(2, 3, 3, 2, 3, 2).mean(axis=(3, 5))
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-6)
+    # non-divisible output: global check via output_size=1
+    g = nd.contrib.AdaptiveAvgPooling2D(x, output_size=1)
+    np.testing.assert_allclose(g.asnumpy()[..., 0, 0],
+                               x.asnumpy().mean(axis=(2, 3)), rtol=1e-6)
+    odd = nd.contrib.AdaptiveAvgPooling2D(
+        nd.array(np.ones((1, 1, 5, 7), np.float32)), output_size=(2, 3))
+    assert odd.shape == (1, 1, 2, 3)
+    np.testing.assert_allclose(odd.asnumpy(), 1.0)
+
+
+def test_index_array():
+    x = nd.zeros((2, 3))
+    out = nd.contrib.index_array(x)
+    assert out.shape == (2, 3, 2)
+    np.testing.assert_array_equal(out.asnumpy()[1, 2], [1, 2])
+    ax = nd.contrib.index_array(x, axes=(1,))
+    assert ax.shape == (2, 3, 1)
+    np.testing.assert_array_equal(ax.asnumpy()[..., 0],
+                                  [[0, 1, 2], [0, 1, 2]])
+
+
+def test_contrib_op_edge_kwargs():
+    import pytest
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import optimizer as opt
+
+    # 1-elem output_size tuple = square (ref Shape ndim==1 semantics)
+    x = nd.array(np.ones((1, 1, 6, 6), np.float32))
+    assert nd.contrib.AdaptiveAvgPooling2D(
+        x, output_size=(3,)).shape == (1, 1, 3, 3)
+    # negative axes in index_array
+    ia = nd.contrib.index_array(nd.zeros((2, 3)), axes=(-1,))
+    np.testing.assert_array_equal(ia.asnumpy()[..., 0],
+                                  [[0, 1, 2], [0, 1, 2]])
+    # GroupAdaGrad rejects weight decay like the reference
+    with pytest.raises(mx.MXNetError, match="weight decay"):
+        opt.create("groupadagrad", wd=1e-4)

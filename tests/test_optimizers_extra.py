@@ -171,3 +171,22 @@ def test_new_optimizers_drive_training():
             o.update(0, w, nd.array(g.astype(np.float32)), state)
         l1, _ = loss_grad(w.asnumpy())
         assert l1 < l0 * 0.5, f"{name}: {l0} -> {l1}"
+
+
+def test_group_adagrad_oracle():
+    """Row-wise AdaGrad (ref: mx.optimizer.contrib.GroupAdaGrad)."""
+    rng = np.random.RandomState(1)
+    w0 = rng.randn(4, 3).astype(np.float32)
+    grads = [rng.randn(4, 3).astype(np.float32) for _ in range(3)]
+    lr, eps = 0.01, 1e-5
+    o = opt.create("groupadagrad", learning_rate=lr, epsilon=eps)
+    w = nd.array(w0.copy())
+    state = o.create_state(0, w)
+    assert state.shape == (4, 1)  # one accumulator per row
+    for g in grads:
+        o.update(0, w, nd.array(g), state)
+    wr, h = w0.copy(), np.zeros((4, 1), np.float32)
+    for g in grads:
+        h = h + np.mean(g * g, axis=1, keepdims=True)
+        wr = wr - lr * g / (np.sqrt(h) + eps)
+    np.testing.assert_allclose(w.asnumpy(), wr, rtol=1e-5)
