@@ -1,6 +1,7 @@
 """mx.nd — the imperative NDArray API (ref: python/mxnet/ndarray/)."""
 from .ndarray import (NDArray, array, zeros, ones, full, arange, empty,  # noqa: F401
-                      zeros_like, ones_like, eye, linspace, concatenate,
+                      zeros_like, ones_like, eye, linspace, histogram,
+                      concatenate,
                       waitall, save, load, from_jax, moveaxis)
 from .ops import *  # noqa: F401,F403  (generated op namespace)
 from . import ops as _gen_ops

@@ -28,8 +28,9 @@ from ..base import MXNetError
 from ..context import Context, cpu, current_context
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "arange", "empty",
-           "zeros_like", "ones_like", "eye", "linspace", "concatenate",
-           "waitall", "save", "load", "from_jax", "moveaxis"]
+           "zeros_like", "ones_like", "eye", "linspace", "histogram",
+           "concatenate", "waitall", "save", "load", "from_jax",
+           "moveaxis"]
 
 
 def waitall():
@@ -668,6 +669,19 @@ def linspace(start, stop, num, endpoint=True, ctx=None, dtype=None):
     return NDArray(jnp.linspace(start, stop, num, endpoint=endpoint,
                                 dtype=_to_jax_dtype(dtype)),
                    ctx=ctx or current_context())
+
+
+def histogram(a, bins=10, range=None):
+    """(hist, bin_edges) like numpy (ref: mx.nd.histogram). `bins` may
+    be an int (with optional `range`) or an NDArray/array of edges."""
+    data = a._data if isinstance(a, NDArray) else jnp.asarray(a)
+    if isinstance(bins, NDArray):
+        bins = bins._data
+    # range=None is handled lazily on-device by jnp.histogram (min/max
+    # edges) — no host sync needed here
+    h, edges = jnp.histogram(data, bins=bins, range=range)
+    ctx = a.context if isinstance(a, NDArray) else None
+    return NDArray(h, ctx=ctx), NDArray(edges, ctx=ctx)
 
 
 def concatenate(arrays, axis=0):

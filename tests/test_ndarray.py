@@ -191,3 +191,19 @@ def test_iter_len():
     rows = list(x)
     assert len(x) == 3 and len(rows) == 3
     assert np.allclose(rows[2].asnumpy(), [4, 5])
+
+
+def test_histogram():
+    """(hist, edges) numpy parity incl. explicit edges
+    (ref: mx.nd.histogram)."""
+    x = nd.array(np.array([0.1, 0.4, 0.4, 2.5, 3.9], np.float32))
+    h, e = nd.histogram(x, bins=4, range=(0.0, 4.0))
+    np.testing.assert_array_equal(h.asnumpy(), [3, 0, 1, 1])
+    np.testing.assert_allclose(e.asnumpy(), [0, 1, 2, 3, 4])
+    edges = nd.array(np.array([0.0, 0.5, 4.0], np.float32))
+    h2, e2 = nd.histogram(x, bins=edges)
+    np.testing.assert_array_equal(h2.asnumpy(), [3, 2])
+    np.testing.assert_allclose(e2.asnumpy(), edges.asnumpy())
+    # default range spans the data
+    h3, e3 = nd.histogram(x, bins=2)
+    assert h3.asnumpy().sum() == 5
