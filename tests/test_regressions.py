@@ -243,3 +243,42 @@ def test_generated_wrappers_importable_and_named():
         assert callable(w)
         if entry.wrapper is None:
             assert w.__name__ == name
+
+
+def test_seeded_training_is_bitwise_reproducible():
+    """Two identically-seeded hybridized training runs (with dropout)
+    produce identical loss trajectories — the MXNET_TEST_SEED
+    reproducibility convention (ref: test_utils.with_seed)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+
+    def run():
+        from mxnet_tpu.gluon.block import _BlockScope
+
+        _BlockScope._counters.clear()
+        mx.random.seed(42)
+        np.random.seed(42)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(16, activation="relu"),
+                gluon.nn.Dropout(0.5), gluon.nn.Dense(3))
+        net.initialize(mx.init.Xavier())
+        net.hybridize()
+        tr = gluon.Trainer(net.collect_params(), "adam",
+                           {"learning_rate": 0.01})
+        X = nd.array(np.random.RandomState(1).rand(32, 8)
+                     .astype(np.float32))
+        Y = nd.array((np.random.RandomState(2).rand(32) * 3)
+                     .astype(np.float32))
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        out = []
+        for _ in range(6):
+            with autograd.record():
+                loss = loss_fn(net(X), Y)
+            loss.backward()
+            tr.step(32)
+            out.append(float(loss.mean().asscalar()))
+        return out
+
+    assert run() == run()
