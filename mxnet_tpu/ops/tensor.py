@@ -603,7 +603,15 @@ def _k_topk(data, *, axis=-1, k=1, ret_typ="indices", is_ascend=False,
     vals, idxs = lax.top_k(-src_m if is_ascend else src_m, k)
     if is_ascend:
         vals = -vals
-    if axis != -1 and axis != data.ndim - 1:
+    moved = axis != -1 and axis != data.ndim - 1
+    if ret_typ == "mask":
+        # 1 where the element is among the top-k of its axis slice
+        # (ref: ordering_op topk ret_typ=mask); built in the moved
+        # layout (k on the last axis), then restored
+        onehot = jax.nn.one_hot(idxs, src_m.shape[-1], dtype=data.dtype)
+        mask_m = onehot.sum(axis=-2)  # merge the k picks
+        return jnp.moveaxis(mask_m, -1, axis) if moved else mask_m
+    if moved:
         vals = jnp.moveaxis(vals, -1, axis)
         idxs = jnp.moveaxis(idxs, -1, axis)
     idxs = idxs.astype(jnp.dtype(dtype))
