@@ -145,6 +145,23 @@ def clear_caches():
     _vjp_cache.clear()
 
 
+def compiled_executable_count():
+    """Total XLA executables held by the jitted-op caches (each jit
+    wrapper tracks one executable per input-shape signature).  A steady
+    count across repeated same-shape calls is the no-recompile
+    invariant the shape-bucketing tier relies on (SURVEY §5
+    long-context scaling; tests/test_regressions.py asserts it)."""
+    total = 0
+    for fn in list(_jit_cache.values()) + list(_vjp_cache.values()):
+        size = getattr(fn, "_cache_size", None)
+        if callable(size):
+            try:
+                total += size()
+            except Exception:
+                pass
+    return total
+
+
 def evict(fn):
     """Drop all cached executables for one fn (used when a CachedOp is
     released, so discarded hybridized models don't pin memory forever)."""

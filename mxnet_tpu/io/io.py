@@ -173,6 +173,19 @@ def _check_partition(num_parts, part_index):
             f"{part_index} num_parts={num_parts}")
 
 
+def _partition_range(n, num_parts, part_index):
+    """Contiguous [start, end) record range for this worker, matching the
+    reference's proportional split (ref: iter_mnist.cc GetPart — start =
+    n/num_parts*part_index, end = n/num_parts*(part_index+1)).  Computed
+    in exact integer arithmetic rather than the reference's double cast:
+    float rounding can drop the final row entirely (e.g. n=15, parts=11:
+    int(15/11*11) == 14), and no worker owning a record is worse than a
+    one-off boundary shift."""
+    start = n * part_index // num_parts
+    end = n * (part_index + 1) // num_parts
+    return start, end
+
+
 class MNISTIter(DataIter):
     """Reads the classic idx-ubyte MNIST files (ref: src/io/iter_mnist.cc)."""
 
@@ -187,9 +200,12 @@ class MNISTIter(DataIter):
         if self._images.shape[0] != self._labels.shape[0]:
             raise MXNetError("MNIST image/label count mismatch")
         if num_parts > 1:
-            # dist-worker shard (ref: iter_mnist.cc num_parts/part_index)
-            self._images = self._images[part_index::num_parts]
-            self._labels = self._labels[part_index::num_parts]
+            # dist-worker shard: contiguous range, matching the reference's
+            # proportional split (ref: iter_mnist.cc GetPart)
+            s, e = _partition_range(self._images.shape[0], num_parts,
+                                    part_index)
+            self._images = self._images[s:e]
+            self._labels = self._labels[s:e]
         if flat:
             self._images = self._images.reshape(self._images.shape[0], -1)
         else:
@@ -257,9 +273,12 @@ class CSVIter(DataIter):
                                ndmin=2).reshape((-1,) + tuple(label_shape))
         else:
             label = np.zeros((data.shape[0], 1), np.float32)
-        if num_parts > 1:  # dist-worker shard
-            data = data[part_index::num_parts]
-            label = label[part_index::num_parts]
+        if num_parts > 1:
+            # dist-worker shard: contiguous range like the reference C++
+            # iterator (ref: iter_csv.cc InputSplit partitioning)
+            s, e = _partition_range(data.shape[0], num_parts, part_index)
+            data = data[s:e]
+            label = label[s:e]
         self._iter = NDArrayIter(data, label, batch_size=batch_size,
                                  last_batch_handle="pad" if round_batch
                                  else "discard")
@@ -324,8 +343,11 @@ class LibSVMIter(DataIter):
             raise MXNetError(
                 f"libsvm label/data row mismatch: {self._n} labels vs "
                 f"{len(self._indptr) - 1} data rows")
-        if num_parts > 1:  # dist-worker shard: CSR row subset
-            keep = np.arange(self._n)[part_index::num_parts]
+        if num_parts > 1:
+            # dist-worker shard: contiguous CSR row range like the
+            # reference (ref: iter_libsvm.cc InputSplit partitioning)
+            _s, _e = _partition_range(self._n, num_parts, part_index)
+            keep = np.arange(self._n)[_s:_e]
             starts, ends = self._indptr[keep], self._indptr[keep + 1]
             lens = ends - starts
             # vectorized per-row index expansion (no python-level loop)
@@ -589,10 +611,31 @@ class ImageRecordIter(DataIter):
             data = handle.as_numpy(np.float32).reshape(len(recs), c, h, w)
             labels = np.empty((len(recs),), np.float32)
             for i, rec in enumerate(recs):
-                header, img = rio.unpack_img(rec,
-                                             iscolor=1 if c == 3 else 0)
-                labels[i] = header.label if np.isscalar(header.label) \
-                    else header.label[0]
+                # two-stage parse mirroring native DecodeOne: a label
+                # that survives header parsing is kept even when the
+                # image bytes are corrupt; only header corruption zeroes
+                # the label too
+                try:
+                    header, payload = rio.unpack(rec)
+                    if (header.flag > 0
+                            and np.size(header.label) != header.flag):
+                        # truncated label vector (frombuffer silently
+                        # reads fewer floats when the truncation is
+                        # 4-byte aligned): native DecodeOne's
+                        # skip>rec.size() check zeroes both — match it
+                        raise ValueError("truncated label vector")
+                    labels[i] = header.label if np.isscalar(header.label) \
+                        else header.label[0]
+                except Exception:
+                    labels[i] = 0.0
+                    data[i] = 0.0
+                    continue
+                try:
+                    img = rio.unpack_img(rec,
+                                         iscolor=1 if c == 3 else 0)[1]
+                except Exception:
+                    data[i] = 0.0
+                    continue
                 img = self._augment(img, rng)
                 if img.ndim == 2:
                     img = img[:, :, None]

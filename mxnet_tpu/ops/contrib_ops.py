@@ -869,3 +869,206 @@ register("_contrib_SyncBatchNorm", _k_sync_batch_norm,
              "sharded-batch reduction is already global; under shard_map "
              "pass axis_name= to pmean the stats. Ref "
              "contrib/sync_batch_norm.cc.")
+
+
+# ---------------------------------------------------------------------------
+# DeformableConvolution (ref: src/operator/contrib/deformable_convolution
+# .cc + nn/deformable_im2col.h — Dai et al., Deformable ConvNets).
+# The reference builds a deformable im2col buffer with a custom CUDA
+# kernel, then GEMMs.  Here the same decomposition targets the MXU:
+# vectorized bilinear gathers build the sampled (N,C,kh,kw,Ho,Wo)
+# tensor in one fused XLA computation, and the contraction with the
+# weight is a single einsum (one MXU matmul per group).  Autodiff
+# reproduces the reference's analytic data/offset gradients (the
+# bilinear weights are differentiable in the offsets).
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (int(v), int(v))
+
+
+def _k_deformable_convolution(data, offset, weight, bias=None, *,
+                              kernel, num_filter, stride=(1, 1),
+                              dilate=(1, 1), pad=(0, 0), num_group=1,
+                              num_deformable_group=1, no_bias=False,
+                              workspace=1024, layout="NCHW"):
+    """data (N,C,H,W); offset (N, 2*dg*kh*kw, Ho, Wo) with per-group
+    channel order (i*kw+j)*2 + {0:dy, 1:dx} (the deformable_im2col
+    layout); weight (O, C/num_group, kh, kw)."""
+    if layout != "NCHW":
+        raise NotImplementedError("DeformableConvolution: NCHW only")
+    kh, kw = _pair(kernel)
+    sh, sw = _pair(stride)
+    dh, dw = _pair(dilate)
+    ph_, pw_ = _pair(pad)
+    N, C, H, W = data.shape
+    Ho = (H + 2 * ph_ - (dh * (kh - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw_ - (dw * (kw - 1) + 1)) // sw + 1
+    dg = int(num_deformable_group)
+    G = int(num_group)
+    # loud shape checks (the reference prop's InferShape): silent
+    # clamped gathers would otherwise return plausible garbage
+    if C % dg or C % G or int(num_filter) % G:
+        raise ValueError(
+            f"DeformableConvolution: channels {C} must divide by "
+            f"num_deformable_group {dg} and num_group {G}; num_filter "
+            f"{num_filter} must divide by num_group")
+    if offset.shape != (N, 2 * dg * kh * kw, Ho, Wo):
+        raise ValueError(
+            f"DeformableConvolution: offset shape {offset.shape} != "
+            f"expected {(N, 2 * dg * kh * kw, Ho, Wo)}")
+    if weight.shape != (int(num_filter), C // G, kh, kw):
+        raise ValueError(
+            f"DeformableConvolution: weight shape {weight.shape} != "
+            f"expected {(int(num_filter), C // G, kh, kw)}")
+    Cg = C // dg
+
+    off = offset.reshape(N, dg, kh, kw, 2, Ho, Wo).astype(jnp.float32)
+    # sampling positions: h = ho*sh - pad + i*dil + dy (dmcn_im2col)
+    base_y = (jnp.arange(Ho) * sh - ph_).astype(jnp.float32)
+    base_x = (jnp.arange(Wo) * sw - pw_).astype(jnp.float32)
+    tap_y = (jnp.arange(kh) * dh).astype(jnp.float32)
+    tap_x = (jnp.arange(kw) * dw).astype(jnp.float32)
+    # (N, dg, kh, kw, Ho, Wo)
+    yy = (base_y[None, None, None, None, :, None]
+          + tap_y[None, None, :, None, None, None] + off[..., 0, :, :])
+    xx = (base_x[None, None, None, None, None, :]
+          + tap_x[None, None, None, :, None, None] + off[..., 1, :, :])
+
+    dat = data.reshape(N, dg, Cg, H, W)
+
+    def sample_one(img, y, x):
+        # img (Cg, H, W); y/x (kh, kw, Ho, Wo); zero-padding semantics:
+        # out-of-range corners contribute nothing (dmcn_im2col_bilinear)
+        y0 = jnp.floor(y)
+        x0 = jnp.floor(x)
+        wy1 = y - y0
+        wx1 = x - x0
+        out = jnp.zeros((img.shape[0],) + y.shape, img.dtype)
+        for cy, wyc in ((y0, 1.0 - wy1), (y0 + 1.0, wy1)):
+            for cx, wxc in ((x0, 1.0 - wx1), (x0 + 1.0, wx1)):
+                ok = ((cy >= 0) & (cy <= H - 1)
+                      & (cx >= 0) & (cx <= W - 1))
+                yi = jnp.clip(cy, 0, H - 1).astype(jnp.int32)
+                xi = jnp.clip(cx, 0, W - 1).astype(jnp.int32)
+                v = img[:, yi, xi]  # (Cg, kh, kw, Ho, Wo)
+                out = out + v * (wyc * wxc * ok)[None]
+        return out
+
+    # vmap over batch then deformable group
+    sampled = jax.vmap(jax.vmap(sample_one))(dat, yy, xx)
+    # (N, dg, Cg, kh, kw, Ho, Wo) -> (N, G, C/G, kh, kw, Ho, Wo)
+    sampled = sampled.reshape(N, G, C // G, kh, kw, Ho, Wo)
+    wg = weight.reshape(G, num_filter // G, C // G, kh, kw)
+    out = jnp.einsum("ngcijhw,gocij->ngohw", sampled,
+                     wg.astype(sampled.dtype))
+    out = out.reshape(N, num_filter, Ho, Wo)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+register("_contrib_DeformableConvolution", _k_deformable_convolution,
+         arg_names=("data", "offset", "weight", "bias"),
+         aliases=("DeformableConvolution",),
+         doc=_k_deformable_convolution.__doc__)
+
+
+# ---------------------------------------------------------------------------
+# PSROIPooling (ref: src/operator/contrib/psroi_pooling.cc — R-FCN's
+# position-sensitive ROI pooling).  The reference loops h,w per output
+# cell with dynamic bin bounds; XLA needs static shapes, so each bin
+# average is a masked full-plane reduction — one einsum over (H, W)
+# with per-cell interval masks, then a position-sensitive channel
+# gather.  O(H*W) per cell is the static-shape price; feature maps at
+# this stage are small (R-FCN: 7x7 bins over ~63x38).
+
+def _k_psroipooling(data, rois, *, spatial_scale, output_dim,
+                    pooled_size, group_size=0):
+    """data (N, C, H, W) with C == output_dim*group_size^2; rois (R, 5)
+    [batch_idx, x1, y1, x2, y2] image coords.  Returns
+    (R, output_dim, pooled_size, pooled_size)."""
+    P = int(pooled_size)
+    G = int(group_size) or P
+    D = int(output_dim)
+    N, C, H, W = data.shape
+    if C != D * G * G:
+        # loud check (the reference prop's InferShape): a clamped
+        # channel gather would otherwise return plausible garbage
+        raise ValueError(
+            f"PSROIPooling: data channels {C} != "
+            f"output_dim*group_size^2 = {D}*{G}^2 = {D * G * G}")
+    scale = float(spatial_scale)
+
+    phs = jnp.arange(P, dtype=jnp.float32)
+    gh = jnp.clip(jnp.floor(phs * G / P), 0, G - 1).astype(jnp.int32)
+    chan = ((jnp.arange(D, dtype=jnp.int32)[:, None, None] * G
+             + gh[None, :, None]) * G + gh[None, None, :])  # (D, P, P)
+
+    def one(roi):
+        bidx = roi[0].astype(jnp.int32)
+        start_w = jnp.round(roi[1]) * scale
+        start_h = jnp.round(roi[2]) * scale
+        end_w = (jnp.round(roi[3]) + 1.0) * scale
+        end_h = (jnp.round(roi[4]) + 1.0) * scale
+        rw = jnp.maximum(end_w - start_w, 0.1)
+        rh = jnp.maximum(end_h - start_h, 0.1)
+        bin_h, bin_w = rh / P, rw / P
+
+        def _snap(v):
+            # XLA may rewrite /P into *(1/P) under jit, perturbing a
+            # bin edge that lands exactly on an integer by 1 ulp — and
+            # floor/ceil then shift the bin a whole pixel vs eager.
+            # Snap near-integer edges first so both paths agree.
+            r = jnp.round(v)
+            tol = 1e-4 * jnp.maximum(1.0, jnp.abs(v))
+            return jnp.where(jnp.abs(v - r) < tol, r, v)
+
+        hstart = jnp.clip(jnp.floor(_snap(phs * bin_h + start_h)), 0, H)
+        hend = jnp.clip(
+            jnp.ceil(_snap((phs + 1) * bin_h + start_h)), 0, H)
+        wstart = jnp.clip(jnp.floor(_snap(phs * bin_w + start_w)), 0, W)
+        wend = jnp.clip(
+            jnp.ceil(_snap((phs + 1) * bin_w + start_w)), 0, W)
+        hmask = ((jnp.arange(H)[None, :] >= hstart[:, None])
+                 & (jnp.arange(H)[None, :] < hend[:, None])
+                 ).astype(data.dtype)  # (P, H)
+        wmask = ((jnp.arange(W)[None, :] >= wstart[:, None])
+                 & (jnp.arange(W)[None, :] < wend[:, None])
+                 ).astype(data.dtype)  # (P, W)
+        sums = jnp.einsum("chw,ph,qw->cpq", data[bidx], hmask, wmask)
+        picked = sums[chan,
+                      jnp.arange(P)[None, :, None],
+                      jnp.arange(P)[None, None, :]]  # (D, P, P)
+        area = ((hend - hstart)[:, None] * (wend - wstart)[None, :])
+        return jnp.where(area > 0, picked / jnp.maximum(area, 1.0), 0.0)
+
+    return jax.vmap(one)(rois.astype(jnp.float32))
+
+
+register("_contrib_PSROIPooling", _k_psroipooling,
+         arg_names=("data", "rois"), aliases=("PSROIPooling",),
+         doc=_k_psroipooling.__doc__)
+
+
+# ---------------------------------------------------------------------------
+# count_sketch (ref: src/operator/contrib/count_sketch.cc — compact
+# bilinear pooling's random projection).  The reference scatter-adds
+# with a CUDA kernel; XLA's scatter-add (.at[].add) is the native
+# equivalent and its VJP is exactly the reference's backward
+# (grad_data = s * grad_out[:, h]).
+
+def _k_count_sketch(data, h, s, *, out_dim, processing_batch_size=32):
+    """data (n, in_dim); h (1, in_dim) hash bucket per input feature in
+    [0, out_dim); s (1, in_dim) signs (+-1).  Returns (n, out_dim):
+    out[i, h[j]] += s[j] * data[i, j].  processing_batch_size is
+    accepted for parity (the reference tiles the batch; XLA fuses)."""
+    n, d = data.shape
+    hh = h.reshape(-1).astype(jnp.int32)
+    ss = s.reshape(-1).astype(data.dtype)
+    return jnp.zeros((n, int(out_dim)), data.dtype).at[:, hh].add(
+        data * ss[None, :])
+
+
+register("_contrib_count_sketch", _k_count_sketch,
+         arg_names=("data", "h", "s"), aliases=("count_sketch",),
+         doc=_k_count_sketch.__doc__)

@@ -318,8 +318,61 @@ def _tiles_ok(q, k, block_q=128, block_k=128):
     # tiles onto the MXU fine (lane dim padded to 128); requiring
     # d % 128 == 0 silently pushed every 64-dim model onto the XLA
     # fallback path
-    return (sq % block_q == 0 and sk % block_k == 0 and d % 64 == 0
+    if d % 128 != 0:
+        if d % 64 != 0 or not _headdim64_allowed():
+            return False
+    return (sq % block_q == 0 and sk % block_k == 0
             and sq >= block_q and sk >= block_k)
+
+
+_D64_PROBE_OK = None
+
+
+def _headdim64_allowed():
+    """Whether the d%64 (non-128-multiple) tiling may hit the kernel.
+
+    A Mosaic lowering failure for this tiling would surface at
+    jit-compile time — after trace time, so past the try/except in
+    ops/attention._k_sdpa — leaving no runtime fallback.  On real TPU we
+    therefore compile-probe a tiny d=64 instance ONCE per process
+    (eagerly, outside any enclosing trace) and cache the verdict; off
+    TPU (interpret mode) the kernel is interpreter-checked and always
+    allowed.  MXTPU_FLASH_HEADDIM64=1/0 forces the answer either way.
+    """
+    from ...base import getenv
+
+    forced = getenv("FLASH_HEADDIM64", None)
+    if forced is not None:
+        return forced not in ("0", "false", "False", "")
+    try:
+        on_tpu = jax.default_backend() == "tpu"
+    except RuntimeError:
+        on_tpu = False
+    if not on_tpu:
+        return True
+    global _D64_PROBE_OK
+    if _D64_PROBE_OK is None:
+        try:
+            # probe value-and-grad in both training dtypes so a Mosaic
+            # rejection of the BACKWARD d=64 tiling (or the bf16
+            # variant) is caught here, not at the user's jit compile
+            for dt in (jnp.float32, jnp.bfloat16):
+                q = jnp.zeros((1, 1, 128, 64), dt)
+                jax.jit(jax.grad(
+                    lambda a: _flash_sdpa(a, a, a, None, False, 0.125)
+                    .astype(jnp.float32).sum())).lower(q).compile()
+            _D64_PROBE_OK = True
+        except Exception as e:
+            if "mosaic" in f"{type(e).__name__} {e}".lower():
+                # the chip genuinely rejects this tiling: latch for the
+                # process lifetime
+                _D64_PROBE_OK = False
+            else:
+                # transient (tunnel RPC, compile-service hiccup): fall
+                # back THIS call but leave the verdict open so a later
+                # call re-probes after the backend recovers
+                return False
+    return _D64_PROBE_OK
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))

@@ -643,8 +643,11 @@ class LAMB(Optimizer):
 
 @register("adamax")
 class Adamax(Optimizer):
+    # epsilon defaults to 0 because the reference Adamax update is
+    # w -= lr * m_t / u_t with no epsilon term (and no epsilon ctor arg);
+    # a nonzero value is accepted as an opt-in numerical guard only.
     def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
-                 epsilon=1e-8, **kwargs):
+                 epsilon=0.0, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
 

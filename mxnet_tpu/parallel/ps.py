@@ -10,8 +10,13 @@ on the in-graph DCN collective path (parallel/dist.py), which is the
 right shape for TPU pods; this server is the DCN-async escape hatch and
 runs anywhere (the nightly tests drive it multi-process on CPU).
 
-Protocol: length-prefixed pickled tuples, trusted-cluster only (same
-trust model as ps-lite's raw ZMQ). Ops:
+Protocol: length-prefixed pickled tuples — TRUSTED-CLUSTER ONLY (same
+trust model as ps-lite's raw ZMQ, but sharper: unpickling attacker
+bytes is REMOTE CODE EXECUTION, not just data corruption — anyone who
+can reach the port owns the process).  Servers therefore bind loopback
+by default; a multi-host cluster must opt in by setting
+DMLC_PS_BIND_HOST (e.g. 0.0.0.0) and is responsible for network
+isolation of the PS ports.  Ops:
   ("init", key, array)      -> set-if-absent (idempotent)
   ("push", key, array[, wid, seq]) -> merge: optimizer(key, grad,
                                weight) if a server-side optimizer is
@@ -71,7 +76,12 @@ def _recv_frame(sock):
 class PSServer:
     """The KVServer role (ref: KVStoreDistServer::Run DataHandleEx)."""
 
-    def __init__(self, port, host="0.0.0.0"):
+    def __init__(self, port, host=None):
+        if host is None:
+            # loopback unless the cluster explicitly opts in: the pickle
+            # protocol is RCE to anyone who can reach the port (see
+            # module docstring)
+            host = os.environ.get("DMLC_PS_BIND_HOST", "127.0.0.1")
         self._store = {}           # key -> np.ndarray (weights)
         self._updater = None       # server-side optimizer updater
         self._applied = {}         # (wid, key) -> last applied push seq
@@ -383,12 +393,30 @@ def server_endpoints():
     return [(host, base + i) for i in range(n)]
 
 
+def _check_bind_optin(root_host):
+    """Multi-host cluster without an explicit bind opt-in: binding
+    loopback would strand remote workers in retry loops, and binding
+    wide open silently would expose the pickle transport (= RCE).
+    Fail fast with the knob to turn."""
+    if (root_host not in ("127.0.0.1", "localhost", "::1")
+            and not os.environ.get("DMLC_PS_BIND_HOST")):
+        raise MXNetError(
+            f"dist server for cluster root {root_host!r} needs "
+            "DMLC_PS_BIND_HOST set (e.g. 0.0.0.0). The PS pickle "
+            "transport is remote-code-execution to anything that can "
+            "reach the port, so non-loopback binding is opt-in; the "
+            "launcher must network-isolate the PS ports.")
+
+
 def ensure_local_server():
     """Start the in-process server on worker 0 when no dedicated server
-    role exists. Idempotent."""
+    role exists. Idempotent.  Binds loopback unless DMLC_PS_BIND_HOST
+    opts in — and fails fast (rather than stranding remote workers)
+    when the cluster root is non-loopback and no opt-in is set."""
     global _server_singleton
     if _server_singleton is None:
-        (_, port), = server_endpoints()
+        (host, port), = server_endpoints()
+        _check_bind_optin(host)
         _server_singleton = PSServer(port).start()
     return _server_singleton
 
@@ -409,5 +437,6 @@ def run_server():
         pass  # backend already initialized by the embedding process
     host, base = server_endpoints()[0]
     my_id = int(os.environ.get("DMLC_SERVER_ID", "0"))
-    srv = PSServer(base + my_id, host="0.0.0.0").start()
+    _check_bind_optin(host)
+    srv = PSServer(base + my_id).start()
     srv._thread.join()

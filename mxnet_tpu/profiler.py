@@ -102,14 +102,53 @@ def op_scope(name):
     return _OpScope(name)
 
 
-def dumps(reset=False):
-    """Return the chrome trace JSON string (ref: mx.profiler.dumps)."""
+def dumps(reset=False, format="json"):
+    """Return the trace (ref: mx.profiler.dumps).
+
+    format="json": chrome://tracing event JSON (the default).
+    format="table": per-op aggregate summary — name, count, total/min/
+    max/avg ms — requires set_config(aggregate_stats=True) like the
+    reference's MXAggregateProfileStatsPrint (ref:
+    src/profiler/aggregate_stats.cc)."""
+    if format == "table":
+        if not _config.get("aggregate_stats"):
+            raise RuntimeError(
+                "aggregate stats not enabled: call "
+                "profiler.set_config(aggregate_stats=True) before "
+                "profiling (ref: MXAggregateProfileStatsPrint)")
+        return _aggregate_table(reset)
     with _events_lock:
         data = {"traceEvents": list(_events),
                 "displayTimeUnit": "ms"}
         if reset:
             _events.clear()
     return json.dumps(data)
+
+
+def _aggregate_table(reset=False):
+    """Per-op totals across recorded events, formatted like the
+    reference's aggregate stats table (ref: aggregate_stats.cc
+    DumpTable: Name / Total Count / Time columns, sorted by total)."""
+    with _events_lock:
+        events = list(_events)
+        if reset:
+            _events.clear()
+    stats = {}
+    for ev in events:
+        s = stats.setdefault(ev["name"], [0, 0.0, float("inf"), 0.0])
+        dur_ms = ev["dur"] / 1000.0
+        s[0] += 1
+        s[1] += dur_ms
+        s[2] = min(s[2], dur_ms)
+        s[3] = max(s[3], dur_ms)
+    header = (f"{'Name':<40}{'Total Count':>12}{'Total (ms)':>14}"
+              f"{'Min (ms)':>12}{'Max (ms)':>12}{'Avg (ms)':>12}")
+    lines = ["Profile Statistics:", header, "-" * len(header)]
+    for name, (cnt, tot, mn, mx) in sorted(
+            stats.items(), key=lambda kv: -kv[1][1]):
+        lines.append(f"{name:<40}{cnt:>12}{tot:>14.4f}"
+                     f"{mn:>12.4f}{mx:>12.4f}{tot / cnt:>12.4f}")
+    return "\n".join(lines)
 
 
 def dump(finished=True, profile_process="worker"):

@@ -9,6 +9,7 @@
 //
 // Exposed as a flat C ABI (ref: the c_api boundary) consumed via ctypes.
 
+#include <csetjmp>
 #include <cstddef>
 #include <cstdio>
 
@@ -59,13 +60,33 @@ struct IRHeader {
 // ---------------------------------------------------------------------------
 // JPEG decode via libjpeg
 
+// libjpeg's default error_exit calls exit(); corrupt records must decode
+// as a failure return instead, so route fatal errors through longjmp (the
+// canonical libjpeg.txt recovery pattern).
+struct JpegErrorJmp {
+  jpeg_error_mgr pub;
+  jmp_buf jb;
+};
+
+extern "C" void MxtpuJpegErrorExit(j_common_ptr cinfo) {
+  JpegErrorJmp* e = reinterpret_cast<JpegErrorJmp*>(cinfo->err);
+  longjmp(e->jb, 1);
+}
+
+extern "C" void MxtpuJpegSilence(j_common_ptr, int) {}
+
 bool DecodeJpeg(const uint8_t* data, size_t len, std::vector<uint8_t>* out,
                 int* w, int* h, int* channels, bool gray) {
+  if (len < 2 || data[0] != 0xFF || data[1] != 0xD8) return false;
   jpeg_decompress_struct cinfo;
-  jpeg_error_mgr jerr;
-  cinfo.err = jpeg_std_error(&jerr);
-  // default error handler calls exit(); override fatal path with longjmp-free
-  // quiet failure by checking header first
+  JpegErrorJmp jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = MxtpuJpegErrorExit;
+  jerr.pub.emit_message = MxtpuJpegSilence;  // no warning spam on stderr
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
   jpeg_create_decompress(&cinfo);
   jpeg_mem_src(&cinfo, data, len);
   if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
@@ -252,14 +273,31 @@ struct ImagePipeline {
                  std::mt19937* rng) {
     const char* p = rec.data();
     IRHeader h;
+    // h.flag comes from the file: a truncated/corrupt record can carry a
+    // flag whose label vector extends past the payload, so bound-check
+    // before the label read and the skip arithmetic (size_t underflow).
+    if (rec.size() < sizeof(h)) {
+      *label = 0.f;
+      std::fill(out, out + static_cast<size_t>(cfg.c) * cfg.h * cfg.w, 0.f);
+      return;
+    }
     std::memcpy(&h, p, sizeof(h));
     // flag > 0 means the label is a packed float vector of that many
     // elements preceding the image bytes (ref: mx.recordio.unpack strips
     // for flag > 0 — size-1 vectors included)
-    size_t skip = sizeof(h) + (h.flag > 0 ? 4u * h.flag : 0u);
-    *label = h.flag > 0
-        ? *reinterpret_cast<const float*>(p + sizeof(h))  // first element
-        : h.label;
+    size_t skip = sizeof(h) + (h.flag > 0 ? 4ull * h.flag : 0ull);
+    if (skip > rec.size()) {
+      *label = 0.f;
+      std::fill(out, out + static_cast<size_t>(cfg.c) * cfg.h * cfg.w, 0.f);
+      return;
+    }
+    float lab;
+    if (h.flag > 0) {
+      std::memcpy(&lab, p + sizeof(h), 4);  // first element of the vector
+    } else {
+      lab = h.label;
+    }
+    *label = lab;
     const uint8_t* img = reinterpret_cast<const uint8_t*>(p + skip);
     size_t img_len = rec.size() - skip;
 

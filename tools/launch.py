@@ -84,7 +84,8 @@ def launch_ssh(hosts, n, cmd, port):
                 f"MXTPU_NUM_WORKER={n}", f"DMLC_NUM_WORKER={n}",
                 f"MXTPU_WORKER_ID={wid}", f"DMLC_WORKER_ID={wid}",
                 "DMLC_ROLE=worker",
-            ])
+            ] + ([f"DMLC_PS_BIND_HOST={os.environ['DMLC_PS_BIND_HOST']}"]
+                 if os.environ.get("DMLC_PS_BIND_HOST") else []))
             remote = f"cd {os.getcwd()} && env {envs} {' '.join(cmd)}"
             procs.append(subprocess.Popen(["ssh", "-o",
                                            "StrictHostKeyChecking=no",
