@@ -440,7 +440,12 @@ class HybridBlock(Block):
                       for k, p in self._reg_params.items()}
         except DeferredInitializationError:
             self._try_infer_and_init(x, *args)
-            params = {k: p.data() for k, p in self._reg_params.items()}
+            # same context-aware fetch as the first attempt: with
+            # multi-context init and the input on a non-first context,
+            # bare p.data() would mix parameter copies across devices
+            params = {k: p.data(ctx) if (ctx is not None and p._data and
+                                         ctx in p._data) else p.data()
+                      for k, p in self._reg_params.items()}
         return self.hybrid_forward(F, x, *args, **params)
 
     def _try_infer_and_init(self, x, *args):

@@ -6,8 +6,9 @@ internal math).
 """
 from __future__ import annotations
 
-from ....base import MXNetError
+from ....base import MXNetError, getenv
 from ...block import HybridBlock
+from ...parameter import DeferredInitializationError
 from ... import nn
 
 
@@ -42,10 +43,25 @@ class BasicBlockV1(HybridBlock):
 
 
 class BottleneckV1(HybridBlock):
+    """1x1 -> 3x3 -> 1x1 bottleneck.
+
+    With ``MXTPU_CONV_EPILOGUE=pallas`` and NHWC layout the forward
+    routes the 1x1 convs through the fused Pallas epilogue ops
+    (ops/conv_fused_ops.py: conv matmul + BN stats in one pass, the
+    previous BN's normalize+ReLU folded into the next matmul's input
+    read — the cuDNN fused-op pattern, ref batch_norm.cu /
+    CUDNN_FUSED_SCALE_BIAS_ACTIVATION_CONV_BNSTATS).  Parameters,
+    names, and checkpoints are IDENTICAL to the standard path — the
+    fused forward reads the same child blocks' parameters — so the
+    flag can be flipped per-run."""
+
     def __init__(self, channels, stride, downsample=False, in_channels=0,
                  layout="NCHW", **kwargs):
         super().__init__(**kwargs)
         ax = -1 if layout[-1] == "C" else 1
+        self._stride = stride
+        self._fuse = (layout == "NHWC"
+                      and getenv("CONV_EPILOGUE", "") == "pallas")
         self.body = nn.HybridSequential()
         self.body.add(nn.Conv2D(channels // 4, 1, stride, use_bias=False,
                                 layout=layout))
@@ -68,11 +84,73 @@ class BottleneckV1(HybridBlock):
             self.downsample = None
 
     def hybrid_forward(self, F, x):
+        if self._fuse and not getattr(F, "__name__", "").endswith("symbol"):
+            try:
+                return self._fused_forward(F, x)
+            except DeferredInitializationError:
+                # first call with deferred shapes: one standard pass
+                # initializes every child param, fused thereafter
+                pass
         residual = x
         x_out = self.body(x)
         if self.downsample is not None:
             residual = self.downsample(residual)
         return F.Activation(residual + x_out, act_type="relu")
+
+    @staticmethod
+    def _bn_kw(bn):
+        return dict(eps=bn._kwargs["eps"],
+                    momentum=bn._kwargs["momentum"],
+                    fix_gamma=bn._kwargs["fix_gamma"])
+
+    @staticmethod
+    def _pdata(p, ctx):
+        # context-aware fetch, mirroring _eager_forward: a net
+        # initialized on several devices must compute against (and
+        # commit running stats into) the INPUT's context copy
+        if ctx is not None and p._data and ctx in p._data:
+            return p.data(ctx)
+        return p.data()
+
+    def _bn_params(self, bn, ctx):
+        return (self._pdata(bn.gamma, ctx), self._pdata(bn.beta, ctx),
+                self._pdata(bn.running_mean, ctx),
+                self._pdata(bn.running_var, ctx))
+
+    def _fused_forward(self, F, x):
+        from ...block import is_tracing
+
+        ctx = None if is_tracing() else x.context
+        c1, b1, c2, b2, c3, b3 = (self.body[0], self.body[1],
+                                  self.body[3], self.body[4],
+                                  self.body[6], self.body[7])
+        # conv1 (1x1, stride): raw out + its BN folded to (scale, shift)
+        y1, s1, h1 = F.contrib.conv1x1_bn_act(
+            x, self._pdata(c1.weight, ctx), *self._bn_params(b1, ctx),
+            stride=self._stride, **self._bn_kw(b1))
+        # 3x3 stays on the XLA conv path; normalize+ReLU materializes
+        # once (XLA fuses it with the conv's input)
+        a1 = F.Activation(y1 * s1.astype(y1.dtype) + h1.astype(y1.dtype),
+                          act_type="relu")
+        y2 = c2(a1)
+        # bn2: stats + fold only — NO normalized copy of y2 is written;
+        # conv3 consumes the raw y2 with the normalize+ReLU fused into
+        # its input read, and computes bn3's stats in its epilogue
+        s2, h2 = F.contrib.bn_fold(y2, *self._bn_params(b2, ctx),
+                                   **self._bn_kw(b2))
+        y3, s3, h3 = F.contrib.conv1x1_bn_act(
+            y2, self._pdata(c3.weight, ctx), *self._bn_params(b3, ctx),
+            in_scale=s2, in_shift=h2, in_act=True, **self._bn_kw(b3))
+        if self.downsample is not None:
+            dc, db = self.downsample[0], self.downsample[1]
+            yd, sd, hd = F.contrib.conv1x1_bn_act(
+                x, self._pdata(dc.weight, ctx), *self._bn_params(db, ctx),
+                stride=self._stride, **self._bn_kw(db))
+            residual = yd * sd.astype(yd.dtype) + hd.astype(yd.dtype)
+        else:
+            residual = x
+        out = y3 * s3.astype(y3.dtype) + h3.astype(y3.dtype) + residual
+        return F.Activation(out, act_type="relu")
 
 
 class BasicBlockV2(HybridBlock):

@@ -631,8 +631,15 @@ class ImageRecordIter(DataIter):
                     data[i] = 0.0
                     continue
                 try:
-                    img = rio.unpack_img(rec,
-                                         iscolor=1 if c == 3 else 0)[1]
+                    # decode straight from the payload already split
+                    # off above (unpack_img would re-parse the header)
+                    import io as _io
+
+                    from PIL import Image
+
+                    img = Image.open(_io.BytesIO(payload))
+                    img = np.asarray(img.convert("RGB" if c == 3
+                                                 else "L"))
                 except Exception:
                     data[i] = 0.0
                     continue

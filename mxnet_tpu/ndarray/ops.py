@@ -24,6 +24,7 @@ from ..ops import vision as _vision_ops  # noqa: F401
 from ..ops import multi as _multi_ops  # noqa: F401
 from ..ops import contrib_ops as _contrib_ops  # noqa: F401
 from ..ops import random_ops as _random_ops  # noqa: F401
+from ..ops import conv_fused_ops as _conv_fused_ops  # noqa: F401
 from ..ops import optimizer_ops as _optimizer_ops  # noqa: F401
 from ..ops import descriptors as _descriptors  # noqa: F401 (param docs)
 from .ndarray import NDArray, array
@@ -101,7 +102,10 @@ def make_op_wrapper(entry):
             for in_idx, out_idx in entry.mutate_aux:
                 if in_idx < len(arrays) and isinstance(arrays[in_idx], NDArray):
                     arrays[in_idx]._data = res[out_idx]._data
-            res = res[0]
+            # aux outputs are committed in place above; the caller sees
+            # only the primary outputs (BatchNorm: 1; conv1x1_bn_act: 3)
+            n_primary = len(res) - len(entry.mutate_aux)
+            res = res[0] if n_primary == 1 else res[:n_primary]
         if out_arr is not None:
             first_res = res[0] if isinstance(res, tuple) else res
             out_arr._data = first_res._data

@@ -21,40 +21,58 @@ from ..base import MXNetError
 
 
 def moe_ffn(x, router_w, w1, b1, w2, b2, mesh=None, axis="ep",
-            capacity_factor=1.25):
-    """Top-1 (Switch) MoE feed-forward.
+            capacity_factor=1.25, top_k=1):
+    """Top-1 (Switch) or top-2 (GShard) MoE feed-forward.
 
     x (..., M) tokens (leading dims — batch, sequence — are flattened
     into one token axis and restored); router_w (M, E); w1 (E, M, H);
     b1 (E, H); w2 (E, H, M); b2 (E, M).  Returns (y shaped like x,
     aux_loss scalar).  Shard w1/b1/w2/b2 leading dim over `axis` for
     real EP.
+
+    top_k=2 follows GShard: the two gates are renormalized to sum to
+    one, and capacity positions are assigned first-choice-first (every
+    token's primary expert wins a slot before any secondary
+    assignment), tokens over capacity drop to the residual path.
     """
+    if top_k not in (1, 2):
+        raise MXNetError(f"top_k must be 1 or 2, got {top_k}")
     lead = x.shape[:-1]
     if x.ndim != 2:
         x = x.reshape(-1, x.shape[-1])
     S, M = x.shape
     E = router_w.shape[1]
-    C = max(1, int(capacity_factor * S / E))
+    C = max(1, int(capacity_factor * top_k * S / E))
 
     logits = x @ router_w                           # (S, E)
     probs = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(probs, axis=-1)             # (S,)
-    gate = jnp.max(probs, axis=-1)                  # (S,)
+    gate_k, expert_k = jax.lax.top_k(probs, top_k)  # (S, k)
+    if top_k == 2:
+        # GShard: renormalize the pair so the gates sum to 1
+        gate_k = gate_k / jnp.maximum(
+            gate_k.sum(axis=-1, keepdims=True), 1e-9)
 
-    # position of each token within its expert's capacity buffer
-    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)    # (S, E)
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1          # (S, E)
-    pos_in_expert = pos.max(axis=-1)                       # (S,)
-    keep = pos_in_expert < C
-    gate = gate * keep
+    # capacity accounting in priority order: all first choices, then
+    # all second choices (a secondary assignment never evicts a
+    # primary one) — flatten (k, S) so cumsum walks that order
+    onehot_k = jax.nn.one_hot(expert_k, E, dtype=jnp.int32)  # (S, k, E)
+    flat = onehot_k.transpose(1, 0, 2).reshape(top_k * S, E)
+    pos_flat = jnp.cumsum(flat, axis=0) * flat - 1           # (kS, E)
+    pos_k = pos_flat.max(axis=-1).reshape(top_k, S).T        # (S, k)
+    keep_k = pos_k < C
+    gate_k = gate_k * keep_k
 
-    # dispatch (S, E, C) one-hot; combine = dispatch * gate
-    dispatch = (jax.nn.one_hot(expert, E, dtype=x.dtype)[:, :, None] *
-                jax.nn.one_hot(jnp.clip(pos_in_expert, 0, C - 1), C,
-                               dtype=x.dtype)[:, None, :] *
-                keep[:, None, None].astype(x.dtype))
-    combine = dispatch * gate[:, None, None]
+    # dispatch (S, E, C): sum of each choice's one-hot placement
+    dispatch = jnp.zeros((S, E, C), x.dtype)
+    combine = jnp.zeros((S, E, C), x.dtype)
+    for j in range(top_k):
+        d_j = (jax.nn.one_hot(expert_k[:, j], E, dtype=x.dtype)[:, :, None]
+               * jax.nn.one_hot(jnp.clip(pos_k[:, j], 0, C - 1), C,
+                                dtype=x.dtype)[:, None, :]
+               * keep_k[:, j, None, None].astype(x.dtype))
+        dispatch = dispatch + d_j
+        combine = combine + d_j * gate_k[:, j, None, None]
+    onehot = onehot_k[:, 0]  # first choice, for the aux loss
 
     if mesh is not None and axis in mesh.axis_names:
         from jax.sharding import NamedSharding, PartitionSpec

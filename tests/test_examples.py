@@ -70,6 +70,10 @@ def test_train_ssd_toy():
     ("moe", "train_moe_lm.py",
      ["--steps", "4", "--batch-size", "4", "--seq-len", "8"],
      "accuracy"),
+    ("pipeline_lm", "train_pipeline_lm.py",
+     ["--steps", "4", "--batch-size", "8", "--seq-len", "16",
+      "--d-model", "32", "--d-ff", "64", "--vocab", "64"],
+     "final loss"),
 ])
 def test_sequence_examples(subdir, script, args, marker):
     out = _run_example(subdir, script, args)

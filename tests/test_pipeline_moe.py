@@ -154,3 +154,123 @@ def test_moe_accepts_sequence_input():
     y2, _ = moe_ffn(x3.reshape(32, 8), *blk.params())
     assert np.allclose(np.asarray(y3).reshape(32, 8), np.asarray(y2),
                        atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# r3: PP/EP product surface (VERDICT r2 #4)
+
+
+def test_pipeline_lm_matches_reference_all_axes():
+    """PipelineLMTrainer's first-step loss must equal the single-device
+    oracle on every axis combination: pure pp, pure tp, pure dp, and
+    the combined 3D mesh (non-uniform stages: embed on stage 0, head
+    on the last stage, real lax.cond branches)."""
+    import jax
+
+    from mxnet_tpu.parallel import mesh as mesh_mod
+    from mxnet_tpu.parallel import pipeline_lm as plm
+
+    V, D, L, F, H, S = 64, 32, 4, 64, 4, 16
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, V, (8, S))
+    tgts = np.roll(toks, -1, axis=1)
+    devs = jax.devices()
+    cases = [({"dp": 1, "tp": 1, "pp": 2}, 2, 2),
+             ({"dp": 1, "tp": 2, "pp": 1}, 2, 1),
+             ({"dp": 2, "tp": 1, "pp": 1}, 2, 1),
+             ({"dp": 1, "tp": 1, "pp": 4}, 4, 4),
+             ({"dp": 2, "tp": 2, "pp": 2}, 8, 2)]
+    for shape, n_dev, stages in cases:
+        params = plm.init_pipeline_lm(V, D, L, F, H, S,
+                                      n_stages=stages, seed=0)
+        ref = float(plm.reference_lm_loss(
+            params, np.asarray(toks), np.asarray(tgts), H))
+        mesh = mesh_mod.make_mesh(shape, devices=devs[:n_dev])
+        tr = plm.PipelineLMTrainer(params, mesh, n_heads=H, n_micro=2,
+                                   lr=1e-3)
+        got = tr.step(toks, tgts)
+        assert abs(ref - got) < 2e-4, (shape, ref, got)
+
+
+def test_pipeline_lm_trains_on_3d_mesh():
+    """A transformer LM trains under dp x tp x pp on the 8-device mesh
+    (the VERDICT r2 #4 done-criterion)."""
+    from mxnet_tpu.parallel import mesh as mesh_mod
+    from mxnet_tpu.parallel import pipeline_lm as plm
+
+    V, D, L, F, H, S = 64, 32, 4, 64, 4, 16
+    params = plm.init_pipeline_lm(V, D, L, F, H, S, n_stages=2, seed=0)
+    mesh = mesh_mod.make_mesh({"dp": 2, "tp": 2, "pp": 2})
+    tr = plm.PipelineLMTrainer(params, mesh, n_heads=H, n_micro=2,
+                               lr=3e-3)
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, V, (8, S))
+    tgts = np.roll(toks, -1, axis=1)
+    losses = [tr.step(toks, tgts) for _ in range(13)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.7, losses
+    # stacking/mesh mismatch is a loud error, not silently-skipped layers
+    import mxnet_tpu as mx
+    bad = plm.init_pipeline_lm(V, D, L, F, H, S, n_stages=4, seed=0)
+    with pytest.raises(mx.MXNetError, match="n_stages"):
+        plm.PipelineLMTrainer(bad, mesh, n_heads=H)
+
+
+def test_moe_top2_oracle_and_ep():
+    """Top-2 GShard routing: renormalized pair gates, first-choice
+    capacity priority; with generous capacity it must equal the dense
+    two-expert mixture, sharded or not."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.parallel import mesh as mesh_mod, moe
+
+    blk = moe.MoEBlock(4, 16, 32, seed=1)
+    x = jnp.asarray(np.random.RandomState(0).rand(64, 16)
+                    .astype(np.float32))
+    router_w, w1, b1, w2, b2 = blk.params()
+    got, _ = moe.moe_ffn(x, *blk.params(), top_k=2,
+                         capacity_factor=100.0)
+    probs = jax.nn.softmax(x @ router_w, -1)
+    g, e = jax.lax.top_k(probs, 2)
+    g = g / g.sum(-1, keepdims=True)
+    want = []
+    for i in range(x.shape[0]):
+        acc = 0
+        for j in range(2):
+            ei = int(e[i, j])
+            h = jax.nn.relu(x[i] @ w1[ei] + b1[ei])
+            acc = acc + g[i, j] * (h @ w2[ei] + b2[ei])
+        want.append(acc)
+    want = jnp.stack(want)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    mesh = mesh_mod.make_mesh({"ep": 4}, devices=jax.devices()[:4])
+    got_ep, _ = moe.moe_ffn(x, *blk.params(), mesh=mesh, top_k=2,
+                            capacity_factor=100.0)
+    np.testing.assert_allclose(np.asarray(got_ep), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_moe_top2_capacity_priority():
+    """Over-capacity: every token's FIRST choice wins a slot before any
+    second choice (GShard priority), so with capacity exactly S/E the
+    primary routes survive and most secondaries drop."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.parallel import moe
+
+    S, M, E = 16, 8, 4
+    blk = moe.MoEBlock(E, M, 16, seed=3)
+    x = jnp.asarray(np.random.RandomState(2).rand(S, M)
+                    .astype(np.float32))
+    # top_k=2 with capacity_factor=0.5 -> C = S/E: room for the
+    # primaries only (if perfectly balanced)
+    y, aux = moe.moe_ffn(x, *blk.params(), top_k=2,
+                         capacity_factor=0.5)
+    assert np.isfinite(np.asarray(y)).all()
+    # must differ from the full-capacity result (secondaries dropped)
+    y_full, _ = moe.moe_ffn(x, *blk.params(), top_k=2,
+                            capacity_factor=100.0)
+    assert not np.allclose(np.asarray(y), np.asarray(y_full))

@@ -370,3 +370,18 @@ def test_bench_roofline_bound_computed():
 
     assert bench._roofline_bound(1e12, 1e9, Cpu()) is None
     assert bench._roofline_bound(None, 1e9, Dev()) is None
+
+
+def test_deferred_init_multictx_uses_input_context():
+    """The deferred-init retry in _eager_forward must refetch params on
+    the INPUT's context: with multi-context init and the input on a
+    non-first context, a bare p.data() mixed device copies (r3 review
+    find while wiring the fused conv path)."""
+    from mxnet_tpu.gluon import nn
+
+    c = nn.Conv2D(8, 3, padding=1, layout="NHWC")
+    c.initialize(mx.init.Xavier(), ctx=[mx.xla(0), mx.xla(1)])
+    x = nd.random.uniform(shape=(1, 5, 5, 4), ctx=mx.xla(1))
+    out = c(x)  # first call: deferred-shape retry path
+    assert out.context.device_id == 1
+    assert out.shape == (1, 5, 5, 8)

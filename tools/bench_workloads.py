@@ -277,10 +277,47 @@ def bench_rnn(bs=64, seq=256, input_size=512, hidden=512, iters=10):
         "device_kind": dev.device_kind, "platform": dev.platform}))
 
 
+def bench_convfuse(bs=128, image=224, steps=20):
+    """ResNet-50 NHWC bf16 train step, standard XLA path vs the
+    MXTPU_CONV_EPILOGUE=pallas fused conv1x1+BN+ReLU path (VERDICT r2
+    #2: the epilogue fusion the roofline analysis calls for).  Emits
+    one JSON line per mode; the A/B delta is the fusion's measured
+    value on this chip."""
+    import os
+
+    jax = _setup_jax()
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import data_parallel
+
+    x = np.random.RandomState(0).rand(bs, image, image, 3) \
+        .astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 1000, bs).astype(np.float32)
+    for mode in ("xla", "pallas"):
+        os.environ["MXTPU_CONV_EPILOGUE"] = \
+            "" if mode == "xla" else "pallas"
+        from mxnet_tpu.gluon.model_zoo import vision
+
+        mx.random.seed(0)
+        net = vision.resnet50_v1(layout="NHWC")
+        net.initialize(mx.init.Xavier())
+        trainer = data_parallel.DataParallelTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.1, "momentum": 0.9},
+            compute_dtype="bfloat16")
+        _bench_trainer(jax, trainer, x, y, steps, bs,
+                       f"resnet50_convfuse_{mode}",
+                       {"unit": "images/sec", "batch_size": bs,
+                        "image_size": image, "conv_epilogue": mode})
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("which", choices=["bert", "transformer", "deepar",
-                                     "attention", "rnn", "all"])
+                                     "attention", "rnn", "convfuse",
+                                     "all"])
     p.add_argument("--batch-size", type=int, default=None,
                    help="override the per-benchmark default batch size")
     p.add_argument("--model", default="big", choices=["base", "big"],
@@ -297,6 +334,8 @@ def main():
         bench_attention(**bs_kw)
     if args.which in ("rnn", "all"):
         bench_rnn(**bs_kw)
+    if args.which in ("convfuse", "all"):
+        bench_convfuse(**bs_kw)
 
 
 if __name__ == "__main__":
