@@ -29,6 +29,8 @@ def main():
     p.add_argument("--dp", type=int, default=2)
     p.add_argument("--tp", type=int, default=2)
     p.add_argument("--pp", type=int, default=2)
+    p.add_argument("--sp", type=int, default=1,
+                   help="sequence-parallel (Ulysses) axis size")
     p.add_argument("--vocab", type=int, default=256)
     p.add_argument("--d-model", type=int, default=64)
     p.add_argument("--layers", type=int, default=4)
@@ -44,7 +46,7 @@ def main():
 
     import jax
 
-    n_dev = args.dp * args.tp * args.pp
+    n_dev = args.dp * args.tp * args.pp * args.sp
     if args.cpu and len(jax.devices()) < n_dev:
         raise SystemExit(
             f"need {n_dev} devices; run under XLA_FLAGS="
@@ -55,9 +57,11 @@ def main():
     from mxnet_tpu.parallel import mesh as mesh_mod
     from mxnet_tpu.parallel import pipeline_lm as plm
 
-    mesh = mesh_mod.make_mesh(
-        {"dp": args.dp, "tp": args.tp, "pp": args.pp},
-        devices=jax.devices()[:n_dev])
+    axes = {"dp": args.dp}
+    if args.sp > 1:
+        axes["sp"] = args.sp
+    axes.update({"tp": args.tp, "pp": args.pp})
+    mesh = mesh_mod.make_mesh(axes, devices=jax.devices()[:n_dev])
     params = plm.init_pipeline_lm(
         args.vocab, args.d_model, args.layers, args.d_ff, args.heads,
         args.seq_len, n_stages=args.pp, seed=0)

@@ -303,14 +303,20 @@ def _k_roi_align(data, rois, *, pooled_size, spatial_scale=1.0,
 
     sample_ratio<=0 (the reference's adaptive mode — taps scale with
     the roi size) is approximated with a fixed 2x2 tap grid: per-roi
-    tap counts are data-dependent shapes, which XLA cannot compile."""
-    if position_sensitive:
-        raise NotImplementedError(
-            "ROIAlign position_sensitive=True (PSROIAlign) is not "
-            "implemented; pool plain ROIAlign per class instead")
+    tap counts are data-dependent shapes, which XLA cannot compile.
+
+    position_sensitive=True (PSROIAlign, ref roi_align.cc v1.5 + the
+    R-FCN papers): input channels C = out_channels*ph*pw, and output
+    channel c at cell (iy, ix) pools input channel
+    (c*ph + iy)*pw + ix — computed here by pooling every channel with
+    the plain ROIAlign grid and then gathering the cell-diagonal."""
     ph, pw = (pooled_size if isinstance(pooled_size, (tuple, list))
               else (pooled_size, pooled_size))
     B, C, H, W = data.shape
+    if position_sensitive and C % (ph * pw):
+        raise ValueError(
+            f"ROIAlign position_sensitive: channels {C} must be a "
+            f"multiple of pooled_h*pooled_w = {ph * pw}")
     sr = int(sample_ratio) if int(sample_ratio) > 0 else 2
     offset = 0.5 if aligned else 0.0
 
@@ -338,6 +344,29 @@ def _k_roi_align(data, rois, *, pooled_size, spatial_scale=1.0,
         wy1 = jnp.clip(ys - y0, 0.0, 1.0)
         wx1 = jnp.clip(xs - x0, 0.0, 1.0)
         img = data[bidx]                                   # (C, H, W)
+        if position_sensitive:
+            # pool ONLY each cell's own channel (d*ph + iy)*pw + ix:
+            # corner gathers are indexed per (cell, tap) so no work is
+            # spent pooling channels the cell-diagonal would discard
+            D = C // (ph * pw)
+            imgr = img.reshape(D, ph, pw, H, W)
+            yb = y0i.reshape(ph, sr)
+            yt = y1i.reshape(ph, sr)
+            xb = x0i.reshape(pw, sr)
+            xt = x1i.reshape(pw, sr)
+            wy = wy1.reshape(ph, sr)
+            wx = wx1.reshape(pw, sr)
+            A = jnp.arange(ph)[:, None, None, None]   # cell row
+            B = jnp.arange(pw)[None, :, None, None]   # cell col
+            sy = jnp.arange(sr)[None, None, :, None]  # tap row
+            sx = jnp.arange(sr)[None, None, None, :]  # tap col
+            wyc = wy[A, sy]
+            wxc = wx[B, sx]
+            g = (imgr[:, A, B, yb[A, sy], xb[B, sx]] * (1 - wyc) * (1 - wxc)
+                 + imgr[:, A, B, yb[A, sy], xt[B, sx]] * (1 - wyc) * wxc
+                 + imgr[:, A, B, yt[A, sy], xb[B, sx]] * wyc * (1 - wxc)
+                 + imgr[:, A, B, yt[A, sy], xt[B, sx]] * wyc * wxc)
+            return g.mean(axis=(3, 4))                 # (D, ph, pw)
         # gather 4 corners: (C, ph*sr, pw*sr)
         g = (img[:, y0i[:, None], x0i[None, :]] *
              ((1 - wy1)[:, None] * (1 - wx1)[None, :]) +

@@ -1,11 +1,11 @@
-"""Pipeline-parallel transformer LM: the PP/TP/DP product surface.
+"""Pipeline-parallel transformer LM: the PP/TP/SP/DP product surface.
 
 Ref capability: ABSENT in the reference (SURVEY §2.3 'PP: ABSENT');
 capability upgrade.  VERDICT r2 #4 asked for non-uniform stages (embed
 -> blocks -> head) and a trainer-level entry so the pipeline tier is a
 product feature, not a library demo — this module is that entry.
 
-Design (tpu-native, one combined 3D mesh dp x tp x pp):
+Design (tpu-native, one combined mesh dp x [sp x] tp x pp):
 
 - **Non-uniform stages.** The rotating GPipe payload is the hidden
   state (mb, S, D) — uniform between transformer blocks — while the
@@ -23,6 +23,11 @@ Design (tpu-native, one combined 3D mesh dp x tp x pp):
 - **dp**: the microbatch dim of the token buffer is sharded over 'dp';
   shard_map's transpose inserts the gradient psum for the replicated
   parameters automatically.
+- **sp** (opt-in, when the mesh carries the axis): Ulysses sequence
+  parallelism — tokens sharded over 'sp' on the sequence dim, an
+  all_to_all regroups (all-heads, seq-shard) into (head-subset,
+  full-seq) around each attention, positions offset per shard.  The
+  long-context axis, composed with the other three.
 
 Everything runs inside ONE ``shard_map`` over the full mesh, jitted
 once; the optimizer (Adam) updates sharded params in place outside the
@@ -111,9 +116,16 @@ def _ln(x, g, b, eps=1e-5):
     return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
 
 
-def _block(layer, h, *, n_heads_local, tp_axis, tp):
+def _block(layer, h, *, n_heads_local, tp_axis, tp, sp_axis=None, sp=1):
     """One transformer block on the LOCAL tp shard of its weights.
-    h (mb, S, D) replicated across tp; psum('tp') at each residual."""
+    h (mb, S_local, D) replicated across tp, sequence-sharded across
+    sp; psum('tp') at each residual join.
+
+    sp > 1: Ulysses sequence parallelism (ref capability upgrade,
+    SURVEY §2.3 SP) — an all_to_all over 'sp' regroups the local
+    (all-heads, seq-shard) layout into (head-subset, full-seq) for the
+    attention itself, and back after; LN/FFN are per-position and need
+    nothing."""
     mb, S, D = h.shape
     a = _ln(h, layer["ln1_g"], layer["ln1_b"])
     qkv = jnp.einsum("bsd,dke->bske", a, layer["wqkv"])
@@ -124,12 +136,24 @@ def _block(layer, h, *, n_heads_local, tp_axis, tp):
     def heads(t):
         return t.reshape(mb, S, n_heads_local, hd).transpose(0, 2, 1, 3)
 
-    q, k, v = heads(q), heads(k), heads(v)
+    q, k, v = heads(q), heads(k), heads(v)          # (mb, h, S, hd)
+    if sp > 1:
+        # heads -> sp groups, sequence shards -> full sequence (the
+        # device order of the concat IS the sequence order)
+        def gather_seq(t):
+            return jax.lax.all_to_all(t, sp_axis, split_axis=1,
+                                      concat_axis=2, tiled=True)
+
+        q, k, v = gather_seq(q), gather_seq(k), gather_seq(v)
+    Sf = q.shape[2]
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / (hd ** 0.5)
-    mask = jnp.tril(jnp.ones((S, S), bool))
+    mask = jnp.tril(jnp.ones((Sf, Sf), bool))
     logits = jnp.where(mask, logits, -1e9)
     attn = jax.nn.softmax(logits, axis=-1)
     ctx = jnp.einsum("bhqk,bhkd->bhqd", attn, v)
+    if sp > 1:
+        ctx = jax.lax.all_to_all(ctx, sp_axis, split_axis=2,
+                                 concat_axis=1, tiled=True)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(mb, S, dl)
     attn_out = ctx @ layer["wo"]                # row-parallel partial
     if tp > 1:
@@ -143,24 +167,28 @@ def _block(layer, h, *, n_heads_local, tp_axis, tp):
     return h + ff + layer["b2"]
 
 
-def _stage(blocks_local, h, *, n_heads_local, tp_axis, tp):
+def _stage(blocks_local, h, *, n_heads_local, tp_axis, tp,
+           sp_axis=None, sp=1):
     """Scan this device's L/P layers (leaves shaped (lp, ...))."""
 
     def body(h, layer):
         return _block(layer, h, n_heads_local=n_heads_local,
-                      tp_axis=tp_axis, tp=tp), None
+                      tp_axis=tp_axis, tp=tp, sp_axis=sp_axis,
+                      sp=sp), None
 
     h, _ = jax.lax.scan(body, h, blocks_local)
     return h
 
 
-def _lm_sharded(params, toks, targets, *, n_micro, P, tp, n_heads,
-                pp_axis, tp_axis, dp_axis):
-    """Runs inside shard_map over the FULL (dp, tp, pp) mesh.
+def _lm_sharded(params, toks, targets, *, n_micro, P, tp, sp, n_heads,
+                pp_axis, tp_axis, dp_axis, sp_axis):
+    """Runs inside shard_map over the FULL (dp, [sp,] tp, pp) mesh.
 
-    toks/targets local shards: (n_micro, mb_local, S) int32.
-    Returns the global mean CE loss, replicated on every device."""
+    toks/targets local shards: (n_micro, mb_local, S_local) int32
+    (S_local = S/sp when sequence-parallel).  Returns the global mean
+    CE loss, replicated on every device."""
     idx = jax.lax.axis_index(pp_axis)
+    axes = {dp_axis, tp_axis, pp_axis} | ({sp_axis} if sp_axis else set())
 
     def vma3(x):
         # mark fully varying (free physically).  Embed/head are USED
@@ -170,7 +198,7 @@ def _lm_sharded(params, toks, targets, *, n_micro, P, tp, n_heads,
         # other devices never join (deadlock).  Casting here moves the
         # transpose psum to this (unconditional) point.
         have = getattr(jax.typeof(x), "vma", frozenset())
-        missing = tuple({dp_axis, tp_axis, pp_axis} - set(have))
+        missing = tuple(axes - set(have))
         return jax.lax.pcast(x, missing, to="varying") if missing else x
 
     blocks = jax.tree.map(lambda p: p[0], params["blocks"])  # local stage
@@ -179,10 +207,16 @@ def _lm_sharded(params, toks, targets, *, n_micro, P, tp, n_heads,
     n_heads_local = n_heads // tp
     mb, S = toks.shape[1], toks.shape[2]
     D = emb["tok"].shape[1]
+    if sp > 1:
+        # this shard's sequence offset into the position table
+        sp_off = jax.lax.axis_index(sp_axis) * S
+    else:
+        sp_off = 0
 
     def embed_mb(t):
         tok_mb = toks[jnp.minimum(t, n_micro - 1)]
-        return emb["tok"][tok_mb] + emb["pos"][None, :S]
+        pos = jax.lax.dynamic_slice(emb["pos"], (sp_off, 0), (S, D))
+        return emb["tok"][tok_mb] + pos[None]
 
     def head_loss(h, t):
         tgt = targets[jnp.minimum(t, n_micro - 1)]
@@ -205,7 +239,7 @@ def _lm_sharded(params, toks, targets, *, n_micro, P, tp, n_heads,
         inp = jax.lax.cond(idx == 0, lambda: vma(embed_mb(t)),
                            lambda: vma(acts))
         out = _stage(blocks, inp, n_heads_local=n_heads_local,
-                     tp_axis=tp_axis, tp=tp)
+                     tp_axis=tp_axis, tp=tp, sp_axis=sp_axis, sp=sp)
         # last stage computes head+loss for microbatch t-(P-1)
         emit_t = t - (P - 1)
         loss_t = jax.lax.cond(
@@ -224,6 +258,9 @@ def _lm_sharded(params, toks, targets, *, n_micro, P, tp, n_heads,
     mask = (idx == P - 1).astype(loss.dtype)
     loss = jax.lax.psum(loss * mask, pp_axis)
     loss = jax.lax.pmean(loss, dp_axis)
+    if sp_axis and sp > 1:
+        # each sp shard scored its own sequence slice
+        loss = jax.lax.pmean(loss, sp_axis)
     # identical on every tp member already; make it collective-visible
     loss = jax.lax.pmean(loss, tp_axis)
     # value is now equal on every device: cast back to replicated so
@@ -243,7 +280,7 @@ class PipelineLMTrainer:
     """
 
     def __init__(self, params, mesh, n_heads, n_micro=None, lr=1e-3,
-                 dp_axis="dp", tp_axis="tp", pp_axis="pp"):
+                 dp_axis="dp", tp_axis="tp", pp_axis="pp", sp_axis="sp"):
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as Ps
 
@@ -255,10 +292,16 @@ class PipelineLMTrainer:
         self.P = mesh.shape[pp_axis]
         self.tp = mesh.shape[tp_axis]
         self.dp = mesh.shape[dp_axis]
+        # sequence parallelism is opt-in: only engaged when the mesh
+        # carries the axis with size > 1
+        self.sp = mesh.shape.get(sp_axis, 1)
+        self._sp_axis = sp_axis if self.sp > 1 else None
+        self._dp_axis = dp_axis
         self.n_heads = n_heads
-        if n_heads % self.tp:
-            raise MXNetError(f"n_heads {n_heads} must be divisible by "
-                             f"the tp axis size {self.tp}")
+        if n_heads % (self.tp * self.sp):
+            raise MXNetError(
+                f"n_heads {n_heads} must be divisible by tp*sp = "
+                f"{self.tp}*{self.sp} (Ulysses splits heads over both)")
         n_stages = params["blocks"]["wqkv"].shape[0]
         if n_stages != self.P:
             # silently sharding a P-stacked tree over a different pp
@@ -280,11 +323,11 @@ class PipelineLMTrainer:
         self._t = 0
         self.lr = lr
 
-        data_spec = Ps(None, dp_axis, None)
+        data_spec = Ps(None, dp_axis, self._sp_axis)
         lm = functools.partial(
             _lm_sharded, n_micro=self.n_micro, P=self.P, tp=self.tp,
-            n_heads=n_heads, pp_axis=pp_axis, tp_axis=tp_axis,
-            dp_axis=dp_axis)
+            sp=self.sp, n_heads=n_heads, pp_axis=pp_axis,
+            tp_axis=tp_axis, dp_axis=dp_axis, sp_axis=self._sp_axis)
         sharded_loss = jax.shard_map(
             lm, mesh=mesh,
             in_specs=(self._specs, data_spec, data_spec),
@@ -324,11 +367,17 @@ class PipelineLMTrainer:
                 f"batch {B} must divide dp*n_micro = {group}")
         mb = B // group
 
+        if tokens.shape[1] % self.sp:
+            raise MXNetError(
+                f"seq_len {tokens.shape[1]} must be divisible by the "
+                f"sp axis size {self.sp}")
+
         def stage_batch(arr):
             a = np.asarray(arr).reshape(self.n_micro, self.dp * mb, -1)
             return jax.device_put(
                 jnp.asarray(a, jnp.int32),
-                NamedSharding(self.mesh, Ps(None, "dp", None)))
+                NamedSharding(self.mesh,
+                              Ps(None, self._dp_axis, self._sp_axis)))
 
         self._t += 1
         loss, self.params, self._opt_m, self._opt_v = self._step(

@@ -108,12 +108,38 @@ def test_box_nms_out_format_conversion():
     assert np.allclose(back[0, 2:6], [5, 5, 4, 2])
 
 
-def test_roi_align_position_sensitive_rejected():
-    img = nd.zeros((1, 4, 4, 4))
-    rois = nd.array(np.array([[0, 0, 0, 3, 3]], np.float32))
+def test_roi_align_position_sensitive():
+    """PSROIAlign (r3: was NotImplementedError): output channel c at
+    cell (iy, ix) pools input channel (c*ph + iy)*pw + ix with the
+    plain ROIAlign bilinear grid."""
+    rng = np.random.RandomState(9)
+    D, ph, pw = 2, 2, 2
+    img = rng.rand(1, D * ph * pw, 6, 6).astype(np.float32)
+    rois = np.array([[0, 0.5, 0.5, 4.5, 4.5]], np.float32)
+    got = nd.contrib.ROIAlign(nd.array(img), nd.array(rois),
+                              pooled_size=(ph, pw),
+                              position_sensitive=True).asnumpy()
+    assert got.shape == (1, D, ph, pw)
+    plain = nd.contrib.ROIAlign(nd.array(img), nd.array(rois),
+                                pooled_size=(ph, pw)).asnumpy()
+    for d in range(D):
+        for iy in range(ph):
+            for ix in range(pw):
+                np.testing.assert_allclose(
+                    got[0, d, iy, ix],
+                    plain[0, (d * ph + iy) * pw + ix, iy, ix],
+                    rtol=1e-5)
+    # channel-count mismatch is loud
     with pytest.raises(Exception):
-        nd.contrib.ROIAlign(img, rois, pooled_size=(2, 2),
-                            position_sensitive=True)
+        nd.contrib.ROIAlign(nd.zeros((1, 5, 6, 6)), nd.array(rois),
+                            pooled_size=(2, 2), position_sensitive=True)
+    # grads flow through the gather
+    from mxnet_tpu.test_utils import check_numeric_gradient
+
+    check_numeric_gradient(
+        lambda d: nd.contrib.ROIAlign(d, nd.array(rois),
+                                      pooled_size=(ph, pw),
+                                      position_sensitive=True), [img])
 
 
 def test_sample_multinomial_get_prob_differentiable():

@@ -179,7 +179,13 @@ def test_pipeline_lm_matches_reference_all_axes():
              ({"dp": 1, "tp": 2, "pp": 1}, 2, 1),
              ({"dp": 2, "tp": 1, "pp": 1}, 2, 1),
              ({"dp": 1, "tp": 1, "pp": 4}, 4, 4),
-             ({"dp": 2, "tp": 2, "pp": 2}, 8, 2)]
+             ({"dp": 2, "tp": 2, "pp": 2}, 8, 2),
+             # sequence parallelism (Ulysses all_to_all inside the
+             # blocks), alone and composed into the full 4D mesh
+             ({"dp": 1, "sp": 2, "tp": 1, "pp": 1}, 2, 1),
+             ({"dp": 1, "sp": 4, "tp": 1, "pp": 1}, 4, 1),
+             ({"dp": 1, "sp": 2, "tp": 2, "pp": 2}, 8, 2),
+             ({"dp": 2, "sp": 2, "tp": 1, "pp": 2}, 8, 2)]
     for shape, n_dev, stages in cases:
         params = plm.init_pipeline_lm(V, D, L, F, H, S,
                                       n_stages=stages, seed=0)
@@ -214,6 +220,30 @@ def test_pipeline_lm_trains_on_3d_mesh():
     bad = plm.init_pipeline_lm(V, D, L, F, H, S, n_stages=4, seed=0)
     with pytest.raises(mx.MXNetError, match="n_stages"):
         plm.PipelineLMTrainer(bad, mesh, n_heads=H)
+    # heads must divide tp*sp for the Ulysses head split
+    mesh4 = mesh_mod.make_mesh({"dp": 1, "sp": 2, "tp": 2, "pp": 2})
+    p2 = plm.init_pipeline_lm(V, D, L, F, 2, S, n_stages=2, seed=0)
+    with pytest.raises(mx.MXNetError, match="tp\\*sp"):
+        plm.PipelineLMTrainer(p2, mesh4, n_heads=2)
+
+
+def test_pipeline_lm_trains_on_4d_mesh():
+    """dp x sp x tp x pp simultaneously: the long-context axis
+    (Ulysses sequence parallelism) composes with the other three."""
+    from mxnet_tpu.parallel import mesh as mesh_mod
+    from mxnet_tpu.parallel import pipeline_lm as plm
+
+    V, D, L, F, H, S = 64, 32, 4, 64, 4, 16
+    params = plm.init_pipeline_lm(V, D, L, F, H, S, n_stages=2, seed=0)
+    mesh = mesh_mod.make_mesh({"dp": 1, "sp": 2, "tp": 2, "pp": 2})
+    tr = plm.PipelineLMTrainer(params, mesh, n_heads=H, n_micro=2,
+                               lr=3e-3)
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, V, (8, S))
+    tgts = np.roll(toks, -1, axis=1)
+    losses = [tr.step(toks, tgts) for _ in range(10)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.75, losses
 
 
 def test_moe_top2_oracle_and_ep():
