@@ -29,7 +29,8 @@ class MoEDecoderLayer(gluon.HybridBlock):
     """Pre-norm causal self-attention (the fused multihead_attention
     op: packed QKV + sdpa + output projection) followed by an MoE FFN."""
 
-    def __init__(self, d_model, n_heads, n_experts, d_hidden, **kw):
+    def __init__(self, d_model, n_heads, n_experts, d_hidden, top_k=1,
+                 **kw):
         super().__init__(**kw)
         self._h = n_heads
         self.norm1 = gluon.nn.LayerNorm()
@@ -42,7 +43,8 @@ class MoEDecoderLayer(gluon.HybridBlock):
                                           shape=(d_model, d_model))
         self.out_bias = self.params.get("out_bias", shape=(d_model,),
                                         init="zeros")
-        self.moe = gluon.contrib.nn.MoEFFN(n_experts, d_model, d_hidden)
+        self.moe = gluon.contrib.nn.MoEFFN(n_experts, d_model, d_hidden,
+                                           top_k=top_k)
 
     def hybrid_forward(self, F, x, in_weight, in_bias, out_weight,
                        out_bias):
@@ -61,13 +63,13 @@ class MoETransformerLM(gluon.HybridBlock):
     from relative content alone.)"""
 
     def __init__(self, vocab, d_model=64, n_layers=2, n_heads=4,
-                 n_experts=4, d_hidden=128, **kw):
+                 n_experts=4, d_hidden=128, top_k=1, **kw):
         super().__init__(**kw)
         self.embed = gluon.nn.Embedding(vocab, d_model)
         self.layers = []
         for i in range(n_layers):
             layer = MoEDecoderLayer(d_model, n_heads, n_experts,
-                                    d_hidden)
+                                    d_hidden, top_k=top_k)
             setattr(self, f"layer{i}", layer)   # register as child
             self.layers.append(layer)
         self.head = gluon.nn.Dense(vocab, flatten=False)
@@ -97,6 +99,8 @@ def main():
     p.add_argument("--steps", type=int, default=300)
     p.add_argument("--lr", type=float, default=3e-3)
     p.add_argument("--aux-weight", type=float, default=0.01)
+    p.add_argument("--top-k", type=int, default=1, choices=[1, 2],
+                   help="experts per token (1=Switch, 2=GShard)")
     p.add_argument("--disp", type=int, default=50)
     add_cpu_flag(p)
     args = p.parse_args()
@@ -104,7 +108,7 @@ def main():
 
     mx.random.seed(0)
     rng = np.random.RandomState(0)
-    net = MoETransformerLM(args.vocab)
+    net = MoETransformerLM(args.vocab, top_k=args.top_k)
     net.initialize(mx.init.Xavier())
     net.hybridize()
     sce = gluon.loss.SoftmaxCrossEntropyLoss(axis=-1)

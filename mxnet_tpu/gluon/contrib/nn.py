@@ -49,9 +49,9 @@ class SparseEmbedding(Embedding):
 
 
 class MoEFFN(HybridBlock):
-    """Mixture-of-Experts feed-forward (Switch-style top-1 routing with
-    static capacity; GShard einsum dispatch — see parallel/moe.py for
-    the expert-parallel sharded form).
+    """Mixture-of-Experts feed-forward (Switch top-1 or GShard top-2
+    routing via ``top_k``, static capacity; GShard einsum dispatch —
+    see parallel/moe.py for the expert-parallel sharded form).
 
     Input (batch, d_model) -> (output (batch, d_model), aux_loss (1,)).
     Add ``aux_weight * aux_loss`` to the training objective for load
@@ -59,11 +59,13 @@ class MoEFFN(HybridBlock):
     """
 
     def __init__(self, num_experts, d_model, d_hidden,
-                 capacity_factor=1.25, weight_initializer=None, **kwargs):
+                 capacity_factor=1.25, top_k=1, weight_initializer=None,
+                 **kwargs):
         super().__init__(**kwargs)
         if num_experts < 2:
             raise ValueError("MoEFFN needs >= 2 experts")
         self._cf = float(capacity_factor)
+        self._top_k = int(top_k)
         self.router_weight = self.params.get(
             "router_weight", shape=(d_model, num_experts),
             init=weight_initializer)
@@ -80,7 +82,8 @@ class MoEFFN(HybridBlock):
 
     def hybrid_forward(self, F, x, router_weight, w1, b1, w2, b2):
         return F._contrib_MoEFFN(x, router_weight, w1, b1, w2, b2,
-                                 capacity_factor=self._cf)
+                                 capacity_factor=self._cf,
+                                 top_k=self._top_k)
 
 
 class SyncBatchNorm(_nn.BatchNorm):

@@ -356,16 +356,20 @@ def _k_roi_align(data, rois, *, pooled_size, spatial_scale=1.0,
             xt = x1i.reshape(pw, sr)
             wy = wy1.reshape(ph, sr)
             wx = wx1.reshape(pw, sr)
-            A = jnp.arange(ph)[:, None, None, None]   # cell row
-            B = jnp.arange(pw)[None, :, None, None]   # cell col
-            sy = jnp.arange(sr)[None, None, :, None]  # tap row
-            sx = jnp.arange(sr)[None, None, None, :]  # tap col
-            wyc = wy[A, sy]
-            wxc = wx[B, sx]
-            g = (imgr[:, A, B, yb[A, sy], xb[B, sx]] * (1 - wyc) * (1 - wxc)
-                 + imgr[:, A, B, yb[A, sy], xt[B, sx]] * (1 - wyc) * wxc
-                 + imgr[:, A, B, yt[A, sy], xb[B, sx]] * wyc * (1 - wxc)
-                 + imgr[:, A, B, yt[A, sy], xt[B, sx]] * wyc * wxc)
+            cy = jnp.arange(ph)[:, None, None, None]   # cell row
+            cx = jnp.arange(pw)[None, :, None, None]   # cell col
+            sy = jnp.arange(sr)[None, None, :, None]   # tap row
+            sx = jnp.arange(sr)[None, None, None, :]   # tap col
+            wyc = wy[cy, sy]
+            wxc = wx[cx, sx]
+            g = (imgr[:, cy, cx, yb[cy, sy], xb[cx, sx]]
+                 * (1 - wyc) * (1 - wxc)
+                 + imgr[:, cy, cx, yb[cy, sy], xt[cx, sx]]
+                 * (1 - wyc) * wxc
+                 + imgr[:, cy, cx, yt[cy, sy], xb[cx, sx]]
+                 * wyc * (1 - wxc)
+                 + imgr[:, cy, cx, yt[cy, sy], xt[cx, sx]]
+                 * wyc * wxc)
             return g.mean(axis=(3, 4))                 # (D, ph, pw)
         # gather 4 corners: (C, ph*sr, pw*sr)
         g = (img[:, y0i[:, None], x0i[None, :]] *
@@ -809,14 +813,15 @@ register("_contrib_MultiBoxDetection", _k_multibox_detection,
 # same math with mesh=None — under a DataParallelTrainer the 'ep'
 # constraint is applied by sharding the expert-stacked params)
 
-def _k_moe_ffn(data, router_w, w1, b1, w2, b2, *, capacity_factor=1.25):
-    """Switch-style top-1 MoE FFN: data (S, M) -> (y (S, M), aux (1,)).
-    See parallel/moe.py for the GShard einsum formulation and EP
-    sharding."""
+def _k_moe_ffn(data, router_w, w1, b1, w2, b2, *, capacity_factor=1.25,
+               top_k=1):
+    """MoE FFN, top-1 (Switch) or top-2 (GShard) routing: data (S, M)
+    -> (y (S, M), aux (1,)).  See parallel/moe.py for the GShard einsum
+    formulation and EP sharding."""
     from ..parallel.moe import moe_ffn
 
     y, aux = moe_ffn(data, router_w, w1, b1, w2, b2, mesh=None,
-                     capacity_factor=capacity_factor)
+                     capacity_factor=capacity_factor, top_k=int(top_k))
     return y, aux.reshape(1)
 
 

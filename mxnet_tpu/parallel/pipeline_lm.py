@@ -272,11 +272,15 @@ def _lm_sharded(params, toks, targets, *, n_micro, P, tp, sp, n_heads,
 
 
 class PipelineLMTrainer:
-    """Trainer-level entry for dp x tp x pp causal-LM training.
+    """Trainer-level entry for dp x [sp x] tp x pp causal-LM training.
 
-    mesh must carry axes ('dp', 'tp', 'pp') (any sizes; 1 allowed).
-    step(tokens, targets) -> float loss; tokens (B, S) int32 with
-    B % (dp * n_micro) == 0.
+    mesh must carry axes ('dp', 'tp', 'pp') (any sizes; 1 allowed) and
+    MAY carry 'sp' for Ulysses sequence parallelism (opt-in when the
+    axis size is > 1; requires n_heads % (tp*sp) == 0 and
+    seq_len % sp == 0).  step(tokens, targets) -> float loss; tokens
+    (B, S) int32 with B % (dp * n_micro) == 0.  save_states /
+    load_states checkpoint params + Adam moments + the step counter
+    with exact-resume semantics.
     """
 
     def __init__(self, params, mesh, n_heads, n_micro=None, lr=1e-3,
@@ -355,6 +359,54 @@ class PipelineLMTrainer:
             return loss, new_p, new_m, new_v
 
         self._step = jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def save_states(self, path):
+        """Checkpoint params + Adam moments + step counter to one
+        ``.npz`` (host-gathered; see DataParallelTrainer.save_states
+        for the sharded-async large-model form).  Resume-deterministic:
+        load_states + step reproduces the unbroken run."""
+        flat = {}
+        for name, tree in (("p", self.params), ("m", self._opt_m),
+                           ("v", self._opt_v)):
+            leaves = jax.tree_util.tree_leaves_with_path(tree)
+            for key, leaf in leaves:
+                flat[name + jax.tree_util.keystr(key)] = np.asarray(leaf)
+        np.savez(path, __step__=self._t, **flat)
+
+    def load_states(self, path):
+        """Inverse of save_states; shards every leaf back onto this
+        trainer's mesh with its own PartitionSpec.  Validates the WHOLE
+        checkpoint before touching any trainer state, so a bad file
+        leaves the trainer exactly as it was."""
+        from jax.sharding import NamedSharding
+
+        with np.load(path) as z:
+            step = int(z["__step__"])
+            blobs = {k: z[k] for k in z.files if k != "__step__"}
+
+        def restore(name, tree, specs):
+            leaves = jax.tree_util.tree_leaves_with_path(tree)
+            spec_leaves = jax.tree_util.tree_leaves(specs)
+            out = []
+            for (key, leaf), spec in zip(leaves, spec_leaves):
+                k = name + jax.tree_util.keystr(key)
+                if k not in blobs:
+                    raise MXNetError(f"checkpoint missing {k}")
+                if blobs[k].shape != leaf.shape:
+                    raise MXNetError(
+                        f"checkpoint {k} shape {blobs[k].shape} != "
+                        f"{leaf.shape}")
+                out.append(jax.device_put(
+                    blobs[k], NamedSharding(self.mesh, spec)))
+            treedef = jax.tree_util.tree_structure(tree)
+            return jax.tree_util.tree_unflatten(treedef, out)
+
+        new_p = restore("p", self.params, self._specs)
+        new_m = restore("m", self._opt_m, self._specs)
+        new_v = restore("v", self._opt_v, self._specs)
+        # commit only after every tree restored cleanly
+        self._t = step
+        self.params, self._opt_m, self._opt_v = new_p, new_m, new_v
 
     def step(self, tokens, targets):
         from jax.sharding import NamedSharding
