@@ -116,6 +116,17 @@ def _ln(x, g, b, eps=1e-5):
     return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
 
 
+def _causal_attention(q, k, v):
+    """Causal SDPA on (b, h, S, hd), via the op library's shared
+    dispatch (ops/attention._k_sdpa): the Pallas flash kernel on TPU
+    with MXU-tiling shapes (VMEM-blockwise, no (S,S) score matrix in
+    HBM — the long-context enabler), the XLA reference otherwise;
+    MXTPU_DISABLE_PALLAS=1 forces the reference."""
+    from ..ops.attention import _k_sdpa
+
+    return _k_sdpa(q, k, v, causal=True)
+
+
 def _block(layer, h, *, n_heads_local, tp_axis, tp, sp_axis=None, sp=1):
     """One transformer block on the LOCAL tp shard of its weights.
     h (mb, S_local, D) replicated across tp, sequence-sharded across
@@ -145,12 +156,7 @@ def _block(layer, h, *, n_heads_local, tp_axis, tp, sp_axis=None, sp=1):
                                       concat_axis=2, tiled=True)
 
         q, k, v = gather_seq(q), gather_seq(k), gather_seq(v)
-    Sf = q.shape[2]
-    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / (hd ** 0.5)
-    mask = jnp.tril(jnp.ones((Sf, Sf), bool))
-    logits = jnp.where(mask, logits, -1e9)
-    attn = jax.nn.softmax(logits, axis=-1)
-    ctx = jnp.einsum("bhqk,bhkd->bhqd", attn, v)
+    ctx = _causal_attention(q, k, v)
     if sp > 1:
         ctx = jax.lax.all_to_all(ctx, sp_axis, split_axis=2,
                                  concat_axis=1, tiled=True)

@@ -340,3 +340,38 @@ def test_pipeline_lm_checkpoint_resume(tmp_path):
     tr3 = plm.PipelineLMTrainer(small, mesh, n_heads=H, n_micro=2)
     with pytest.raises(mx.MXNetError, match="shape"):
         tr3.load_states(ck)
+
+
+def test_pipeline_causal_attention_flash_parity(interpret_pallas,
+                                                monkeypatch):
+    """_causal_attention's TPU route (Pallas flash, no (S,S) matrix in
+    HBM) must match the XLA reference — checked in interpret mode with
+    the backend probe forced to the TPU branch, and with a spy proving
+    the kernel ACTUALLY ran (a silent fallback would make this
+    naive-vs-naive)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.pallas import flash_attention as fa_mod
+    from mxnet_tpu.parallel import pipeline_lm as plm
+
+    rng = np.random.RandomState(4)
+    q, k, v = (jnp.asarray(rng.rand(2, 2, 128, 64).astype(np.float32))
+               for _ in range(3))
+    calls = []
+    orig = fa_mod._flash_sdpa
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(fa_mod, "_flash_sdpa", spy)
+    monkeypatch.setenv("MXTPU_DISABLE_PALLAS", "1")
+    naive = plm._causal_attention(q, k, v)
+    assert not calls  # reference side really was the reference
+    monkeypatch.delenv("MXTPU_DISABLE_PALLAS")
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    flash = plm._causal_attention(q, k, v)
+    assert calls, "flash kernel never ran (silent fallback)"
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(naive),
+                               rtol=2e-5, atol=2e-5)
