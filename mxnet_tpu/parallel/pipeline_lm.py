@@ -174,20 +174,30 @@ def _block(layer, h, *, n_heads_local, tp_axis, tp, sp_axis=None, sp=1):
 
 
 def _stage(blocks_local, h, *, n_heads_local, tp_axis, tp,
-           sp_axis=None, sp=1):
-    """Scan this device's L/P layers (leaves shaped (lp, ...))."""
+           sp_axis=None, sp=1, remat=False):
+    """Scan this device's L/P layers (leaves shaped (lp, ...)).
+
+    remat=True wraps each block in jax.checkpoint: activations inside a
+    block are recomputed in backward instead of stored across the whole
+    GPipe schedule — the standard memory/FLOPs trade for long-context
+    training."""
+    blk = functools.partial(_block, n_heads_local=n_heads_local,
+                            tp_axis=tp_axis, tp=tp, sp_axis=sp_axis,
+                            sp=sp)
+    if remat:
+        # scan already prevents CSE across iterations; keeping the
+        # default prevent_cse=True would only add fusion barriers
+        blk = jax.checkpoint(blk, prevent_cse=False)
 
     def body(h, layer):
-        return _block(layer, h, n_heads_local=n_heads_local,
-                      tp_axis=tp_axis, tp=tp, sp_axis=sp_axis,
-                      sp=sp), None
+        return blk(layer, h), None
 
     h, _ = jax.lax.scan(body, h, blocks_local)
     return h
 
 
 def _lm_sharded(params, toks, targets, *, n_micro, P, tp, sp, n_heads,
-                pp_axis, tp_axis, dp_axis, sp_axis):
+                pp_axis, tp_axis, dp_axis, sp_axis, remat=False):
     """Runs inside shard_map over the FULL (dp, [sp,] tp, pp) mesh.
 
     toks/targets local shards: (n_micro, mb_local, S_local) int32
@@ -245,7 +255,8 @@ def _lm_sharded(params, toks, targets, *, n_micro, P, tp, sp, n_heads,
         inp = jax.lax.cond(idx == 0, lambda: vma(embed_mb(t)),
                            lambda: vma(acts))
         out = _stage(blocks, inp, n_heads_local=n_heads_local,
-                     tp_axis=tp_axis, tp=tp, sp_axis=sp_axis, sp=sp)
+                     tp_axis=tp_axis, tp=tp, sp_axis=sp_axis, sp=sp,
+                     remat=remat)
         # last stage computes head+loss for microbatch t-(P-1)
         emit_t = t - (P - 1)
         loss_t = jax.lax.cond(
@@ -290,7 +301,8 @@ class PipelineLMTrainer:
     """
 
     def __init__(self, params, mesh, n_heads, n_micro=None, lr=1e-3,
-                 dp_axis="dp", tp_axis="tp", pp_axis="pp", sp_axis="sp"):
+                 dp_axis="dp", tp_axis="tp", pp_axis="pp", sp_axis="sp",
+                 remat=False):
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as Ps
 
@@ -337,7 +349,8 @@ class PipelineLMTrainer:
         lm = functools.partial(
             _lm_sharded, n_micro=self.n_micro, P=self.P, tp=self.tp,
             sp=self.sp, n_heads=n_heads, pp_axis=pp_axis,
-            tp_axis=tp_axis, dp_axis=dp_axis, sp_axis=self._sp_axis)
+            tp_axis=tp_axis, dp_axis=dp_axis, sp_axis=self._sp_axis,
+            remat=bool(remat))
         sharded_loss = jax.shard_map(
             lm, mesh=mesh,
             in_specs=(self._specs, data_spec, data_spec),
