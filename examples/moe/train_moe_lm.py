@@ -101,6 +101,10 @@ def main():
     p.add_argument("--aux-weight", type=float, default=0.01)
     p.add_argument("--top-k", type=int, default=1, choices=[1, 2],
                    help="experts per token (1=Switch, 2=GShard)")
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel axis size: experts sharded "
+                        "over 'ep' via DataParallelTrainer (needs "
+                        "dp*ep devices; dp = remaining devices)")
     p.add_argument("--disp", type=int, default=50)
     add_cpu_flag(p)
     args = p.parse_args()
@@ -112,22 +116,62 @@ def main():
     net.initialize(mx.init.Xavier())
     net.hybridize()
     sce = gluon.loss.SoftmaxCrossEntropyLoss(axis=-1)
-    trainer = gluon.Trainer(net.collect_params(), "adam",
-                            {"learning_rate": args.lr})
+    if args.ep > 1:
+        # expert parallelism: one compiled SPMD step over a dp x ep
+        # mesh, MoE expert-stacked params sharded over 'ep' (GSPMD
+        # inserts the token all_to_all from the shardings alone)
+        import jax
 
-    t0 = time.time()
-    for step in range(1, args.steps + 1):
-        toks, targets = synthetic_batch(rng, args.batch_size,
-                                        args.seq_len, args.vocab)
-        x, y = nd.array(toks), nd.array(targets)
-        with autograd.record():
-            logits, aux = net(x)
-            loss = sce(logits, y).mean() + args.aux_weight * aux.sum()
-        loss.backward()
-        trainer.step(1)
-        if step % args.disp == 0 or step == args.steps:
-            print(f"step {step:4d}  loss {float(loss.asscalar()):.4f}  "
-                  f"({time.time() - t0:.1f}s)")
+        from mxnet_tpu.parallel import data_parallel, mesh as mesh_mod
+        from mxnet_tpu.parallel.moe import gluon_moe_param_spec_fn
+
+        n_dev = len(jax.devices())
+        dp = max(1, n_dev // args.ep)
+        mesh = mesh_mod.make_mesh({"dp": dp, "ep": args.ep},
+                                  devices=jax.devices()[:dp * args.ep])
+
+        class _LMLoss:
+            def __call__(self, out, label):
+                logits, aux = out
+                return (sce(logits, label).mean()
+                        + args.aux_weight * aux.sum())
+
+        sp_trainer = data_parallel.DataParallelTrainer(
+            net, _LMLoss(), "adam", {"learning_rate": args.lr},
+            mesh=mesh, param_spec_fn=gluon_moe_param_spec_fn(mesh))
+        t0 = time.time()
+        for step in range(1, args.steps + 1):
+            toks, targets = synthetic_batch(rng, args.batch_size,
+                                            args.seq_len, args.vocab)
+            loss = sp_trainer.step(toks.astype(np.float32),
+                                   targets.astype(np.float32))
+            if step % args.disp == 0 or step == args.steps:
+                print(f"step {step:4d}  loss "
+                      f"{float(loss.asscalar()):.4f}  "
+                      f"({time.time() - t0:.1f}s)  mesh "
+                      f"{dict(mesh.shape)}")
+        # the SPMD trainer owns its own param buffers: write them back
+        # into the block before the eager accuracy evaluation below
+        sp_trainer.sync_to_block()
+    else:
+        trainer = gluon.Trainer(net.collect_params(), "adam",
+                                {"learning_rate": args.lr})
+
+        t0 = time.time()
+        for step in range(1, args.steps + 1):
+            toks, targets = synthetic_batch(rng, args.batch_size,
+                                            args.seq_len, args.vocab)
+            x, y = nd.array(toks), nd.array(targets)
+            with autograd.record():
+                logits, aux = net(x)
+                loss = sce(logits, y).mean() \
+                    + args.aux_weight * aux.sum()
+            loss.backward()
+            trainer.step(1)
+            if step % args.disp == 0 or step == args.steps:
+                print(f"step {step:4d}  loss "
+                      f"{float(loss.asscalar()):.4f}  "
+                      f"({time.time() - t0:.1f}s)")
 
     toks, targets = synthetic_batch(np.random.RandomState(7), 64,
                                     args.seq_len, args.vocab)

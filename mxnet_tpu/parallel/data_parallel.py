@@ -36,7 +36,8 @@ class DataParallelTrainer:
 
     def __init__(self, block, loss_fn, optimizer="sgd", optimizer_params=None,
                  mesh=None, shard_params=False, donate=True,
-                 shard_opt_states=False, compute_dtype=None, remat=False):
+                 shard_opt_states=False, compute_dtype=None, remat=False,
+                 param_spec_fn=None):
         self.block = block
         self.loss_fn = loss_fn
         self.mesh = mesh if mesh is not None else mesh_mod.make_mesh()
@@ -51,6 +52,12 @@ class DataParallelTrainer:
         self._opt_name = optimizer
         self._opt_params = opt_params
         self._shard_params = shard_params
+        # optional (name, shape) -> PartitionSpec-or-None override: the
+        # hook for non-tp layouts (e.g. expert parallelism: shard
+        # MoEFFN's expert-stacked params over an 'ep' axis — see
+        # parallel/moe.gluon_moe_param_spec_fn); None falls through to
+        # the default rule
+        self._param_spec_fn = param_spec_fn
         self._donate = donate
         # ZeRO-style: optimizer state sharded over 'dp'; XLA inserts the
         # gather/scatter collectives (ref: kvstore_dist_server.h
@@ -94,12 +101,16 @@ class DataParallelTrainer:
         self._param_shardings = []
         for name, p in self._named:
             raw = p.data()._data
-            if self._shard_params:
-                spec = mesh_mod.shard_param_spec(raw.shape, self.mesh)
-            else:
-                from jax.sharding import PartitionSpec
+            from jax.sharding import PartitionSpec
 
-                spec = PartitionSpec()
+            spec = None
+            if self._param_spec_fn is not None:
+                spec = self._param_spec_fn(name, raw.shape)
+            if spec is None:
+                if self._shard_params:
+                    spec = mesh_mod.shard_param_spec(raw.shape, self.mesh)
+                else:
+                    spec = PartitionSpec()
             sh = NamedSharding(self.mesh, spec)
             # explicit copy: device_put may alias `raw` (same device), and
             # the step donates its param inputs — donating an aliased
@@ -124,22 +135,31 @@ class DataParallelTrainer:
                     break
         return NamedSharding(self.mesh, PartitionSpec(*dims))
 
-    def _place_state(self, raw):
+    def _place_state(self, raw, param_sharding=None):
         z = jnp.zeros_like(raw)
+        # a param sharded by param_spec_fn (e.g. experts over 'ep')
+        # keeps its optimizer state under the SAME sharding — a
+        # replicated Adam state for an ep-sharded weight would cost
+        # ep x the memory the sharding saved
+        spec = getattr(param_sharding, "spec", None)
+        if spec is not None and any(s is not None for s in spec):
+            return jax.device_put(z, param_sharding)
         return jax.device_put(z, self._opt_state_sharding(z.shape))
 
     def _init_opt_states(self):
         name = self._opt_name
         states = []
         # built below; stored as a tuple to keep jit pytree structure stable
-        for raw, trainable in zip(self._params, self._trainable):
+        for raw, sh, trainable in zip(self._params,
+                                      self._param_shardings,
+                                      self._trainable):
             if not trainable:
                 states.append(None)
             elif name == "sgd" and self._opt_params.get("momentum", 0):
-                states.append(self._place_state(raw))
+                states.append(self._place_state(raw, sh))
             elif name in ("adam", "adamw", "lamb"):
-                states.append((self._place_state(raw),
-                               self._place_state(raw)))
+                states.append((self._place_state(raw, sh),
+                               self._place_state(raw, sh)))
             elif name == "sgd":
                 states.append(None)
             else:

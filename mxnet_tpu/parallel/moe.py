@@ -131,3 +131,31 @@ class MoEBlock:
         return (PartitionSpec(), PartitionSpec(axis, None, None),
                 PartitionSpec(axis, None), PartitionSpec(axis, None, None),
                 PartitionSpec(axis, None))
+
+
+def gluon_moe_param_spec_fn(mesh, axis="ep"):
+    """(name, shape) -> PartitionSpec hook for DataParallelTrainer:
+    shard gluon ``MoEFFN`` expert-stacked parameters (w1/b1/w2/b2,
+    leading dim = num_experts) over the ``axis`` mesh dim; router and
+    every non-MoE parameter fall through to the trainer's default.
+    GSPMD then inserts the token all_to_all from these shardings alone
+    — the trainer-level entry to expert parallelism."""
+    from jax.sharding import PartitionSpec
+
+    if axis not in mesh.axis_names or mesh.shape[axis] <= 1:
+        return lambda name, shape: None
+    E = mesh.shape[axis]
+
+    def fn(name, shape):
+        if "moeffn" in name and "router" not in name and len(shape) >= 2:
+            if shape[0] % E:
+                # silently replicating here would let a run CLAIM
+                # expert parallelism while sharding nothing
+                raise MXNetError(
+                    f"expert dim {shape[0]} of {name} does not divide "
+                    f"the '{axis}' mesh axis ({E}); pick num_experts "
+                    f"divisible by {axis}")
+            return PartitionSpec(axis, *([None] * (len(shape) - 1)))
+        return None
+
+    return fn
