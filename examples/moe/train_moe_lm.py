@@ -126,6 +126,11 @@ def main():
         from mxnet_tpu.parallel.moe import gluon_moe_param_spec_fn
 
         n_dev = len(jax.devices())
+        if n_dev < args.ep:
+            raise SystemExit(
+                f"--ep {args.ep} needs at least {args.ep} devices, "
+                f"have {n_dev}; on CPU run under XLA_FLAGS="
+                f"--xla_force_host_platform_device_count=N")
         dp = max(1, n_dev // args.ep)
         mesh = mesh_mod.make_mesh({"dp": dp, "ep": args.ep},
                                   devices=jax.devices()[:dp * args.ep])

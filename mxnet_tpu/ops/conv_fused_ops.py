@@ -126,8 +126,11 @@ def _k_bn_fold(data, gamma, beta, moving_mean, moving_var, *, eps=1e-5,
         x2d = data.reshape(n, C)
         try:
             from .pallas import batch_norm as _pbn
+            from .pallas.conv_fused import _use_pallas
 
-            if _pbn.stats_supported(n, C):
+            # same gate as the sibling kernels: off-TPU the pallas
+            # stats kernel fails at XLA lowering, past this except
+            if _use_pallas() and _pbn.stats_supported(n, C):
                 ss, qq = _pbn.bn_stats(x2d)
             else:
                 raise ValueError

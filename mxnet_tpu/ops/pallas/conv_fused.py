@@ -65,7 +65,17 @@ def _tile_plan(M, K, N, itemsize):
 def _use_pallas():
     from ...base import getenv
 
-    return not getenv("DISABLE_PALLAS", False, bool)
+    if getenv("DISABLE_PALLAS", False, bool):
+        return False
+    if getenv("CONV_FUSED_INTERPRET", False, bool):
+        return True  # tests: pallas_call monkeypatched to interpret
+    # off-TPU the kernels would fail at XLA lowering (pallas on CPU is
+    # interpret-only), past any trace-time try/except — fall back to
+    # the jnp reference forms instead
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:
+        return False
 
 
 # ---------------------------------------------------------------------------

@@ -99,18 +99,22 @@ class DataParallelTrainer:
 
         params = []
         self._param_shardings = []
+        self._custom_spec = []  # which params param_spec_fn placed
         for name, p in self._named:
             raw = p.data()._data
             from jax.sharding import PartitionSpec
 
             spec = None
+            custom = False
             if self._param_spec_fn is not None:
                 spec = self._param_spec_fn(name, raw.shape)
+                custom = spec is not None
             if spec is None:
                 if self._shard_params:
                     spec = mesh_mod.shard_param_spec(raw.shape, self.mesh)
                 else:
                     spec = PartitionSpec()
+            self._custom_spec.append(custom)
             sh = NamedSharding(self.mesh, spec)
             # explicit copy: device_put may alias `raw` (same device), and
             # the step donates its param inputs — donating an aliased
@@ -119,6 +123,16 @@ class DataParallelTrainer:
             params.append(jax.device_put(jnp.array(raw, copy=True), sh))
             self._param_shardings.append(sh)
         self._params = tuple(params)
+        if self._param_spec_fn is not None and not any(self._custom_spec):
+            # an explicitly-passed spec fn that placed NOTHING is a
+            # misconfiguration (e.g. a custom block prefix the matcher
+            # doesn't see) — training would silently replicate what the
+            # user asked to shard
+            raise MXNetError(
+                "param_spec_fn matched no parameters; check the "
+                "parameter names it filters on (e.g. "
+                "gluon_moe_param_spec_fn expects the default 'moeffn' "
+                "prefix)")
         self._trainable = [p.grad_req != "null" for _, p in self._named]
 
     def _opt_state_sharding(self, shape):
@@ -135,31 +149,34 @@ class DataParallelTrainer:
                     break
         return NamedSharding(self.mesh, PartitionSpec(*dims))
 
-    def _place_state(self, raw, param_sharding=None):
+    def _place_state(self, raw, param_sharding=None, custom=False):
         z = jnp.zeros_like(raw)
-        # a param sharded by param_spec_fn (e.g. experts over 'ep')
+        # a param placed by param_spec_fn (e.g. experts over 'ep')
         # keeps its optimizer state under the SAME sharding — a
         # replicated Adam state for an ep-sharded weight would cost
-        # ep x the memory the sharding saved
-        spec = getattr(param_sharding, "spec", None)
-        if spec is not None and any(s is not None for s in spec):
-            return jax.device_put(z, param_sharding)
+        # ep x the memory the sharding saved.  Default tp-sharded
+        # params (shard_params=True) keep the ZeRO dp placement.
+        if custom:
+            spec = getattr(param_sharding, "spec", None)
+            if spec is not None and any(s is not None for s in spec):
+                return jax.device_put(z, param_sharding)
         return jax.device_put(z, self._opt_state_sharding(z.shape))
 
     def _init_opt_states(self):
         name = self._opt_name
         states = []
         # built below; stored as a tuple to keep jit pytree structure stable
-        for raw, sh, trainable in zip(self._params,
-                                      self._param_shardings,
-                                      self._trainable):
+        for raw, sh, custom, trainable in zip(self._params,
+                                              self._param_shardings,
+                                              self._custom_spec,
+                                              self._trainable):
             if not trainable:
                 states.append(None)
             elif name == "sgd" and self._opt_params.get("momentum", 0):
-                states.append(self._place_state(raw, sh))
+                states.append(self._place_state(raw, sh, custom))
             elif name in ("adam", "adamw", "lamb"):
-                states.append((self._place_state(raw, sh),
-                               self._place_state(raw, sh)))
+                states.append((self._place_state(raw, sh, custom),
+                               self._place_state(raw, sh, custom)))
             elif name == "sgd":
                 states.append(None)
             else:

@@ -139,11 +139,15 @@ def gluon_moe_param_spec_fn(mesh, axis="ep"):
     leading dim = num_experts) over the ``axis`` mesh dim; router and
     every non-MoE parameter fall through to the trainer's default.
     GSPMD then inserts the token all_to_all from these shardings alone
-    — the trainer-level entry to expert parallelism."""
+    — the trainer-level entry to expert parallelism.  Returns None
+    (= "no hook") when the mesh has no usable ``axis``, so
+    ``param_spec_fn=gluon_moe_param_spec_fn(mesh)`` is safe to pass
+    unconditionally and the trainer's matched-nothing misconfiguration
+    check only applies when EP is actually requested."""
     from jax.sharding import PartitionSpec
 
     if axis not in mesh.axis_names or mesh.shape[axis] <= 1:
-        return lambda name, shape: None
+        return None
     E = mesh.shape[axis]
 
     def fn(name, shape):

@@ -9,6 +9,14 @@ import mxnet_tpu as mx
 from mxnet_tpu import autograd, nd
 
 
+@pytest.fixture(autouse=True)
+def _force_fused_kernels(monkeypatch):
+    """Off-TPU the kernels gate themselves off (lowering would fail);
+    the interpret_pallas fixture makes them runnable here, so force
+    the pallas route for every test in this module."""
+    monkeypatch.setenv("MXTPU_CONV_FUSED_INTERPRET", "1")
+
+
 def _jnp():
     import jax.numpy as jnp
 
@@ -227,3 +235,23 @@ def test_fused_resnet50_step_matches_standard(interpret_pallas,
                                rtol=2e-4, atol=2e-4)
     np.testing.assert_allclose(losses["pallas"][1], losses[""][1],
                                rtol=0.05)
+
+
+def test_fused_flag_on_plain_cpu_falls_back(monkeypatch):
+    """MXTPU_CONV_EPILOGUE=pallas on a CPU backend WITHOUT interpret
+    mode must run the jnp reference forms, not die in pallas lowering
+    (pallas on CPU is interpret-only, and the failure surfaces at
+    compile time — past any trace-time try/except)."""
+    monkeypatch.setenv("MXTPU_CONV_EPILOGUE", "pallas")
+    monkeypatch.delenv("MXTPU_CONV_FUSED_INTERPRET", raising=False)
+    from mxnet_tpu.gluon.model_zoo.vision import resnet as rn
+
+    blk = rn.BottleneckV1(64, 1, layout="NHWC")
+    blk.initialize(mx.init.Xavier())
+    x = nd.random.uniform(shape=(2, 8, 8, 64))
+    blk(x)
+    with autograd.record():
+        y = blk(x)
+    y.sum().backward()
+    assert y.shape == (2, 8, 8, 64)
+    assert np.isfinite(y.asnumpy()).all()
