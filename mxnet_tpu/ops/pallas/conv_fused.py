@@ -25,11 +25,12 @@ HBM transfers per layer to 2 (write raw conv out, read it back into
 the next matmul).  Both kernels carry custom VJPs (the backward runs
 as plain XLA matmuls — the forward traffic is what bounds the step).
 
-Used by ``gluon.contrib.FusedConv1x1BNReLU`` and the
-``MXTPU_CONV_EPILOGUE=pallas`` resnet path; falls back to jnp
-reference forms when shapes don't tile or Pallas is disabled
-(``MXTPU_DISABLE_PALLAS=1``).  Interpret-mode parity tests:
-tests/test_conv_fused.py.
+Used by ops/conv_fused_ops.py (the `_contrib_conv1x1_bn_act` /
+`_contrib_bn_fold` registry ops) behind the
+``MXTPU_CONV_EPILOGUE=pallas`` resnet BottleneckV1 path; falls back to
+jnp reference forms when shapes don't tile, off-TPU, or when Pallas is
+disabled (``MXTPU_DISABLE_PALLAS=1``).  Interpret-mode parity tests:
+tests/test_conv_fused.py (forced via MXTPU_CONV_FUSED_INTERPRET=1).
 """
 from __future__ import annotations
 
