@@ -48,10 +48,6 @@ def _bench_trainer(jax, trainer, x, y, steps, tokens_per_step, metric,
     """Shared harness: warmup, best-of-3 bulk-scan timing, FLOPs via
     cost analysis, chip-aggregated MFU, one JSON line. `extra` keys
     override the defaults (e.g. a different "unit")."""
-    import jax.numpy as jnp
-
-    from mxnet_tpu import random as _random
-
     trainer.step(x, y).wait_to_read()
     trainer.step_many(x, y, n_steps=steps).asnumpy()  # compile scan
     dt = None
@@ -62,23 +58,14 @@ def _bench_trainer(jax, trainer, x, y, steps, tokens_per_step, metric,
         w = time.perf_counter() - t0
         dt = w if dt is None or w < dt else dt
 
-    flops = None
-    try:
-        xj = tuple(jnp.asarray(v) for v in x) if isinstance(
-            x, (tuple, list)) else jnp.asarray(x)
-        # lower() traces abstractly — only shapes/dtypes matter, so the
-        # trainer's own lr scalar serves
-        lowered = trainer._step_fn.lower(
-            trainer._params, trainer._states, xj, jnp.asarray(y),
-            _random.next_key(),
-            jnp.asarray(trainer._lr, jnp.float32),
-            jnp.asarray(3.0, jnp.float32))
-        cost = lowered.cost_analysis()
-        c = cost[0] if isinstance(cost, (list, tuple)) else cost
-        flops = float(c.get("flops", 0.0)) or None
-    except Exception:
-        pass
     dev = jax.devices()[0]
+    # shared cost machinery with bench.py: compiled post-fusion cost
+    # analysis on TPU (real HBM traffic -> roofline bound), HLO-level
+    # lowering off-TPU
+    from bench import _roofline_bound, _step_cost
+
+    flops, nbytes = _step_cost(trainer, x, y,
+                               allow_compile=(dev.platform != "cpu"))
     # cost_analysis FLOPs cover the GLOBAL batch over the dp mesh, so
     # peak must aggregate every chip the step ran on (as bench.py does)
     chip_peak = _peak_flops(dev)
@@ -88,6 +75,7 @@ def _bench_trainer(jax, trainer, x, y, steps, tokens_per_step, metric,
     print(json.dumps(dict({
         "metric": metric, "value": round(steps * tokens_per_step / dt),
         "unit": "tokens/sec", "mfu": round(mfu, 4) if mfu else None,
+        "roofline_mfu_bound": _roofline_bound(flops, nbytes, dev),
         "device_kind": dev.device_kind, "platform": dev.platform,
         "final_loss": round(float(losses.asnumpy()[-1]), 4)}, **extra)))
 
