@@ -419,6 +419,14 @@ def _err_tail(err):
     return err.strip().splitlines()[-1][:200] if err.strip() else "no output"
 
 
+def _probe_is_tpu(rc, out):
+    """Shared parse of the --probe leaf's `PROBE_OK <platform> <kind>`
+    line: True iff the probe ran and came up on a non-cpu backend."""
+    if rc != 0 or "PROBE_OK" not in out:
+        return False
+    return "cpu" not in out.split("PROBE_OK", 1)[1].split()[0]
+
+
 def _measure(model, tpu_ok, note):
     """Run one workload leaf: TPU (2 attempts) then CPU fallback.
     Returns (record_or_None, tpu_still_ok)."""
@@ -436,9 +444,19 @@ def _measure(model, tpu_ok, note):
                         f"(rc={rc}): {_err_tail(err)}")
             if attempt == 0:
                 time.sleep(15)
-        tpu_ok = False
-        note.append(f"{model}: tpu declared dead for this run; "
-                    "falling back to CPU")
+        # Distinguish a workload-specific failure (e.g. model OOM) from
+        # a dead backend: re-run the cheap probe.  Only a failed probe
+        # latches tpu_ok=False for the remaining workloads — a healthy
+        # chip keeps its TPU records even if one leaf keeps failing.
+        rc, out, err = _run(["--probe"], timeout=180)
+        if _probe_is_tpu(rc, out):
+            note.append(f"{model}: tpu leaf failed twice but probe is "
+                        "healthy; falling back to CPU for this workload "
+                        "only")
+        else:
+            tpu_ok = False
+            note.append(f"{model}: tpu re-probe failed (rc={rc}); tpu "
+                        "declared dead for this run")
     # a cold scanned-step compile on a busy CPU host can exceed 900s
     # (observed when the TPU tunnel was down and the CPU carried the
     # round); give the fallback generous headroom
@@ -456,7 +474,7 @@ def main():
     for attempt in range(2):
         rc, out, err = _run(["--probe"], timeout=180)
         if rc == 0 and "PROBE_OK" in out:
-            tpu_ok = "cpu" not in out.split("PROBE_OK", 1)[1].split()[0]
+            tpu_ok = _probe_is_tpu(rc, out)
             if not tpu_ok:
                 note.append("probe came up on CPU (no TPU registered)")
             break

@@ -1,0 +1,315 @@
+"""Embedded-orchestrator helpers for the flat C ABI (src/c_api.cc).
+
+The C library hosts a CPython interpreter (DESIGN.md "C ABI" section:
+the deliberate inversion of the reference's native-core/Python-shell
+layering).  Every trainable-surface entry point — symbol compose,
+executor bind/forward/backward, CachedOp, optimizer update, data
+iterators, kvstore — lands here as a flat function taking/returning
+plain Python objects; the C side only marshals handles (PyObject*) and
+scalars.  Keeping the logic on this side keeps src/c_api.cc a thin,
+auditable FFI layer.
+
+Ref (behavioral parity): include/mxnet/c_api.h — MXSymbolCreateAtomic
+Symbol/MXSymbolCompose, MXExecutorBindEX/Forward/Backward,
+MXCreateCachedOpEx/MXInvokeCachedOpEx, MXOptimizerCreateOptimizer/
+MXOptimizerUpdate (pre-1.0 surface; later frontends ride KVStore),
+MXDataIterCreateIter/Next, MXKVStoreInit/Push/Pull.
+"""
+from __future__ import annotations
+
+import ast
+
+from . import autograd as _autograd  # noqa: F401  (C side reaches it here)
+from . import io as _io
+from . import kvstore as _kvstore_mod
+from . import optimizer as _optimizer_mod
+from .base import MXNetError
+from .context import Context
+from .ndarray import ndarray as _nd_mod
+from .symbol import symbol as _symbol_mod
+
+
+def _parse_val(v):
+    """The reference C API's stringly-typed kwarg convention: values
+    arrive as strings and parse as Python literals, falling back to the
+    raw string ("(2,2)" -> tuple, "relu" -> "relu")."""
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def _kwargs(keys, vals):
+    return {k: _parse_val(v) for k, v in zip(keys, vals)}
+
+
+def _parse_ctx(ctx):
+    if not ctx:
+        return None
+    dev, _, idx = ctx.partition("(")
+    return Context(dev, int(idx.rstrip(")")) if idx else 0)
+
+
+# ---------------------------------------------------------------------------
+# Symbol (ref: MXSymbolCreateVariable / CreateAtomicSymbol + Compose)
+
+
+def symbol_variable(name):
+    return _symbol_mod.var(name)
+
+
+def symbol_invoke(op_name, inputs, input_keys, attr_keys, attr_vals,
+                  name):
+    """Atomic-symbol creation + composition in one call: positional
+    ``inputs`` (or keyword, via parallel ``input_keys``) are parent
+    symbols; attrs are the op's stringly-typed params."""
+    fn = getattr(_symbol_mod, op_name, None)
+    if fn is None or not callable(fn):
+        raise MXNetError(f"unknown op for symbol_invoke: {op_name}")
+    kwargs = _kwargs(attr_keys, attr_vals)
+    if name:
+        kwargs["name"] = name
+    args = []
+    if input_keys:
+        for k, s in zip(input_keys, inputs):
+            kwargs[k] = s
+    else:
+        args = list(inputs)
+    return fn(*args, **kwargs)
+
+
+def symbol_list_arguments(sym):
+    return list(sym.list_arguments())
+
+
+def symbol_list_aux(sym):
+    return list(sym.list_auxiliary_states())
+
+
+def symbol_list_outputs(sym):
+    return list(sym.list_outputs())
+
+
+def symbol_infer_shape(sym, known_names, known_shapes):
+    """Ref: MXSymbolInferShape.  Returns (arg_shapes, aux_shapes) as
+    tuples aligned with list_arguments / list_auxiliary_states."""
+    kw = {n: tuple(s) for n, s in zip(known_names, known_shapes)}
+    arg_shapes, _out_shapes, aux_shapes = sym.infer_shape(**kw)
+    return list(arg_shapes), list(aux_shapes)
+
+
+def symbol_tojson(sym):
+    return sym.tojson()
+
+
+def symbol_fromjson(js):
+    return _symbol_mod.fromjson(js)
+
+
+# ---------------------------------------------------------------------------
+# Executor (ref: MXExecutorBindEX / Forward / Backward / Outputs)
+
+
+def executor_bind(sym, ctx, args, grad_req, auxs):
+    """Bind with args (list, ``list_arguments`` order) and aux states
+    (``list_auxiliary_states`` order).  ``grad_req`` is one req for all
+    args or a comma-separated per-arg list (the MXExecutorBindEX
+    per-arg form — lets data/label bind as 'null' so backward doesn't
+    compute input gradients nobody reads).  Gradient buffers are
+    allocated here (zeros) for every non-'null' arg; the caller reads
+    them back per-name after backward."""
+    ctx = _parse_ctx(ctx) or Context.default_ctx()
+    arg_names = sym.list_arguments()
+    aux_names = sym.list_auxiliary_states()
+    if len(args) != len(arg_names):
+        raise MXNetError(
+            f"executor_bind: {len(arg_names)} args required "
+            f"({arg_names}), got {len(args)}")
+    if len(auxs) != len(aux_names):
+        raise MXNetError(
+            f"executor_bind: {len(aux_names)} aux states required, "
+            f"got {len(auxs)}")
+    grad_req = grad_req or "null"
+    if "," in grad_req:
+        reqs = [r.strip() for r in grad_req.split(",")]
+        if len(reqs) != len(arg_names):
+            raise MXNetError(
+                f"executor_bind: per-arg grad_req has {len(reqs)} "
+                f"entries for {len(arg_names)} arguments")
+        req_map = dict(zip(arg_names, reqs))
+    else:
+        req_map = {n: grad_req for n in arg_names}
+    args_grad = None
+    if any(r != "null" for r in req_map.values()):
+        args_grad = {n: _nd_mod.zeros(a.shape, dtype=a.dtype, ctx=ctx)
+                     for n, a in zip(arg_names, args)
+                     if req_map[n] != "null"}
+    return sym.bind(ctx, args=list(args), args_grad=args_grad,
+                    grad_req=req_map, aux_states=list(auxs) or None)
+
+
+def executor_forward(ex, is_train):
+    return list(ex.forward(is_train=bool(is_train)))
+
+
+def executor_backward(ex, out_grads):
+    ex.backward(out_grads=list(out_grads) if out_grads else None)
+
+
+def executor_arg_grad(ex, name):
+    g = ex.grad_dict.get(name)
+    if g is None:
+        raise MXNetError(f"no gradient buffer for argument {name!r}")
+    return g
+
+
+# ---------------------------------------------------------------------------
+# CachedOp (ref: MXCreateCachedOpEx / MXInvokeCachedOpEx): the whole
+# graph runs as ONE XLA computation per (shapes, train) key — the same
+# machinery gluon hybridize rides (symbol/_graph_fn + the jitted-
+# executable cache), exposed over a flat handle.
+
+
+class CApiCachedOp:
+    def __init__(self, sym):
+        self.sym = sym
+        self.arg_names = sym.list_arguments()
+        self.aux_names = sym.list_auxiliary_states()
+        self._ex = None
+        self._n_in = len(self.arg_names) + len(self.aux_names)
+
+    def invoke(self, arrays, is_train):
+        if len(arrays) != self._n_in:
+            raise MXNetError(
+                f"CachedOp: expects {len(self.arg_names)} args + "
+                f"{len(self.aux_names)} aux = {self._n_in} inputs, "
+                f"got {len(arrays)}")
+        n_args = len(self.arg_names)
+        args, auxs = arrays[:n_args], arrays[n_args:]
+        if self._ex is None:
+            ctx = args[0].context if args else Context.default_ctx()
+            self._ex = self.sym.bind(ctx, args=list(args),
+                                     grad_req="null",
+                                     aux_states=list(auxs) or None)
+        else:
+            for name, a in zip(self.arg_names, args):
+                self._ex.arg_dict[name] = a
+            for name, a in zip(self.aux_names, auxs):
+                self._ex.aux_dict[name] = a
+        return list(self._ex.forward(is_train=bool(is_train)))
+
+
+def cachedop_create(sym):
+    return CApiCachedOp(sym)
+
+
+def cachedop_invoke(op, arrays, is_train):
+    return op.invoke(list(arrays), is_train)
+
+
+# ---------------------------------------------------------------------------
+# Optimizer (ref: MXOptimizerCreateOptimizer/MXOptimizerUpdate; state
+# per index managed server-side exactly like KVStoreDistServer does)
+
+
+class CApiOptimizer:
+    def __init__(self, name, kwargs):
+        self.opt = _optimizer_mod.create(name, **kwargs)
+        self.states = {}
+
+    def update(self, index, weight, grad):
+        if index not in self.states:
+            self.states[index] = self.opt.create_state_multi_precision(
+                index, weight)
+        self.opt.update_multi_precision(index, weight, grad,
+                                        self.states[index])
+
+
+def optimizer_create(name, keys, vals):
+    return CApiOptimizer(name, _kwargs(keys, vals))
+
+
+def optimizer_update(opt, index, weight, grad):
+    opt.update(index, weight, grad)
+
+
+# ---------------------------------------------------------------------------
+# Data iterators (ref: MXDataIterCreateIter by registry name /
+# MXDataIterNext / GetData / GetLabel / BeforeFirst)
+
+
+class CApiDataIter:
+    def __init__(self, name, kwargs):
+        cls = getattr(_io, name, None)
+        if cls is None or not isinstance(cls, type):
+            raise MXNetError(f"unknown data iterator: {name}")
+        self.it = cls(**kwargs)
+        self.batch = None
+
+    def next(self):
+        try:
+            self.batch = self.it.next()
+            return True
+        except StopIteration:
+            self.batch = None
+            return False
+
+    def data(self):
+        if self.batch is None:
+            raise MXNetError("no current batch (call next first)")
+        return self.batch.data[0]
+
+    def label(self):
+        if self.batch is None:
+            raise MXNetError("no current batch (call next first)")
+        return self.batch.label[0]
+
+    def reset(self):
+        self.it.reset()
+        self.batch = None
+
+
+def dataiter_create(name, keys, vals):
+    return CApiDataIter(name, _kwargs(keys, vals))
+
+
+def dataiter_next(it):
+    return it.next()
+
+
+def dataiter_data(it):
+    return it.data()
+
+
+def dataiter_label(it):
+    return it.label()
+
+
+def dataiter_reset(it):
+    it.reset()
+
+
+# ---------------------------------------------------------------------------
+# KVStore (ref: MXKVStoreCreate/Init/Push/Pull — int keys, the classic
+# worker protocol)
+
+
+def kvstore_create(type_str):
+    return _kvstore_mod.create(type_str or "local")
+
+
+def kvstore_init(kv, keys, vals, priority=0):
+    # priority accepted (and ignored) so the C side can marshal init/
+    # push/pull through one keyed-call path
+    for k, v in zip(keys, vals):
+        kv.init(int(k), v)
+
+
+def kvstore_push(kv, keys, vals, priority):
+    for k, v in zip(keys, vals):
+        kv.push(int(k), v, priority=priority)
+
+
+def kvstore_pull(kv, keys, outs, priority):
+    for k, o in zip(keys, outs):
+        kv.pull(int(k), out=o, priority=priority)

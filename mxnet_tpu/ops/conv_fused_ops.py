@@ -28,6 +28,8 @@ matters.
 """
 from __future__ import annotations
 
+import warnings
+
 import jax.numpy as jnp
 from jax import lax
 
@@ -124,17 +126,23 @@ def _k_bn_fold(data, gamma, beta, moving_mean, moving_var, *, eps=1e-5,
     n = data.size // C
     if _train:
         x2d = data.reshape(n, C)
-        try:
-            from .pallas import batch_norm as _pbn
-            from .pallas.conv_fused import _use_pallas
+        from .pallas import batch_norm as _pbn
+        from .pallas.conv_fused import _use_pallas
 
-            # same gate as the sibling kernels: off-TPU the pallas
-            # stats kernel fails at XLA lowering, past this except
-            if _use_pallas() and _pbn.stats_supported(n, C):
+        # same gate as the sibling kernels: off-TPU the pallas stats
+        # kernel fails at XLA lowering, so only dispatch it when the
+        # backend gate and shape support both say yes; the except
+        # covers ONLY the pallas call itself, so a real kernel defect
+        # is not silently hidden behind the jnp fallback
+        ss = qq = None
+        if _use_pallas() and _pbn.stats_supported(n, C):
+            try:
                 ss, qq = _pbn.bn_stats(x2d)
-            else:
-                raise ValueError
-        except Exception:
+            except Exception as e:  # pragma: no cover - TPU-only path
+                warnings.warn(
+                    f"pallas bn_stats failed ({type(e).__name__}: {e}); "
+                    "falling back to the XLA reduction")
+        if ss is None:
             xf = x2d.astype(jnp.float32)
             ss = jnp.sum(xf, axis=0, keepdims=True)
             qq = jnp.sum(xf * xf, axis=0, keepdims=True)

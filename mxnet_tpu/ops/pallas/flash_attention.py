@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import functools
 import math
+import time
 
 import jax
 import jax.numpy as jnp
@@ -326,6 +327,8 @@ def _tiles_ok(q, k, block_q=128, block_k=128):
 
 
 _D64_PROBE_OK = None
+_D64_PROBE_TRANSIENT_FAILS = 0
+_D64_PROBE_LAST_STRIKE_T = float("-inf")
 
 
 def _headdim64_allowed():
@@ -350,7 +353,7 @@ def _headdim64_allowed():
         on_tpu = False
     if not on_tpu:
         return True
-    global _D64_PROBE_OK
+    global _D64_PROBE_OK, _D64_PROBE_TRANSIENT_FAILS
     if _D64_PROBE_OK is None:
         try:
             # probe value-and-grad in both training dtypes so a Mosaic
@@ -369,8 +372,21 @@ def _headdim64_allowed():
                 _D64_PROBE_OK = False
             else:
                 # transient (tunnel RPC, compile-service hiccup): fall
-                # back THIS call but leave the verdict open so a later
-                # call re-probes after the backend recovers
+                # back THIS call and leave the verdict open so a later
+                # call re-probes after the backend recovers — but a
+                # PERSISTENT non-Mosaic failure (e.g. probe OOM) must
+                # not re-run the full compile probe on every dispatch.
+                # Strikes are counted at most once per 60s window so a
+                # brief outage (many dispatches, one cause) is ONE
+                # strike; latching False needs 3 strikes spread over
+                # >=2 minutes, i.e. a genuinely persistent failure.
+                global _D64_PROBE_LAST_STRIKE_T
+                now = time.monotonic()
+                if now - _D64_PROBE_LAST_STRIKE_T >= 60.0:
+                    _D64_PROBE_TRANSIENT_FAILS += 1
+                    _D64_PROBE_LAST_STRIKE_T = now
+                if _D64_PROBE_TRANSIENT_FAILS >= 3:
+                    _D64_PROBE_OK = False
                 return False
     return _D64_PROBE_OK
 

@@ -415,6 +415,13 @@ class PipelineLMTrainer:
                     raise MXNetError(
                         f"checkpoint {k} shape {blobs[k].shape} != "
                         f"{leaf.shape}")
+                if blobs[k].dtype != leaf.dtype:
+                    # loading e.g. a float32 checkpoint into a bfloat16
+                    # trainer would silently switch param/opt dtype and
+                    # recompile the step with different numerics
+                    raise MXNetError(
+                        f"checkpoint {k} dtype {blobs[k].dtype} != "
+                        f"trainer dtype {leaf.dtype}")
                 out.append(jax.device_put(
                     blobs[k], NamedSharding(self.mesh, spec)))
             treedef = jax.tree_util.tree_structure(tree)

@@ -40,6 +40,8 @@ bool g_initialized = false;
 PyObject* g_nd_module = nullptr;      // mxnet_tpu.ndarray.ops (op table)
 PyObject* g_nd_array_fn = nullptr;    // mxnet_tpu.nd.array
 PyObject* g_registry = nullptr;       // mxnet_tpu.ops.registry module
+PyObject* g_capi = nullptr;           // mxnet_tpu.capi helper module
+PyObject* g_autograd = nullptr;       // mxnet_tpu.autograd module
 
 thread_local std::string tl_last_error;
 
@@ -48,6 +50,13 @@ std::vector<std::string> g_op_names;
 std::vector<const char*> g_op_name_ptrs;
 
 void set_error_from_python() {
+  // No pending Python exception means the specific message was already
+  // recorded in tl_last_error by C-side validation (e.g. capacity
+  // checks) — keep it rather than clobbering with the generic string.
+  if (!PyErr_Occurred()) {
+    if (tl_last_error.empty()) tl_last_error = "unknown error";
+    return;
+  }
   PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
   PyErr_Fetch(&type, &value, &tb);
   PyErr_NormalizeException(&type, &value, &tb);
@@ -128,6 +137,10 @@ int init_body(const char* platform) {
     if (!g_nd_array_fn) break;
     g_registry = PyImport_ImportModule("mxnet_tpu.ops.registry");
     if (!g_registry) break;
+    g_capi = PyImport_ImportModule("mxnet_tpu.capi");
+    if (!g_capi) break;
+    g_autograd = PyImport_ImportModule("mxnet_tpu.autograd");
+    if (!g_autograd) break;
     // snapshot op names once; pointers stay valid for the process life
     PyObject* keys = PyObject_CallMethod(g_registry, "list_ops", nullptr);
     if (!keys) break;
@@ -537,6 +550,685 @@ MXTPU_API int MXTPUOpGetDoc(const char* op_name, const char** out_doc) {
   set_error_from_python();
   return -1;
 }
+
+// ===========================================================================
+// Trainable surface (VERDICT r3 #4): symbol compose, executor
+// bind/forward/backward, CachedOp, autograd, optimizer update, data
+// iterators, kvstore.  Logic lives in mxnet_tpu/capi.py (embedded
+// orchestrator); these entry points marshal handles and scalars only.
+// All opaque handles own one PyObject*; free any of them with the
+// matching *Free (they share one implementation).
+// ===========================================================================
+
+typedef void* SymbolHandle;
+typedef void* ExecutorHandle;
+typedef void* CachedOpHandle;
+typedef void* OptimizerHandle;
+typedef void* DataIterHandle;
+typedef void* KVStoreHandle;
+
+namespace {
+
+bool require_init() {
+  if (!g_initialized) {
+    tl_last_error = "MXTPUCAPIInit not called";
+    return false;
+  }
+  return true;
+}
+
+// Build a Python list from C handles, INCREFing each element.
+PyObject* handle_list(void** handles, int n) {
+  PyObject* l = PyList_New(n);
+  if (!l) return nullptr;
+  for (int i = 0; i < n; ++i) {
+    PyObject* o = static_cast<PyObject*>(handles[i]);
+    Py_INCREF(o);
+    PyList_SET_ITEM(l, i, o);
+  }
+  return l;
+}
+
+PyObject* str_list(const char** strs, int n) {
+  PyObject* l = PyList_New(n);
+  if (!l) return nullptr;
+  for (int i = 0; i < n; ++i) {
+    PyObject* s = PyUnicode_FromString(strs[i]);
+    if (!s) { Py_DECREF(l); return nullptr; }
+    PyList_SET_ITEM(l, i, s);
+  }
+  return l;
+}
+
+// Copy a Python list of NDArrays out to caller handles (new refs).
+int list_to_handles(PyObject* list, void** out, int* n_out /* in: cap */) {
+  PyObject* seq = PySequence_Fast(list, "expected a sequence");
+  if (!seq) return -1;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  if (n > *n_out) {
+    Py_DECREF(seq);
+    tl_last_error = "output capacity too small: need " + std::to_string(n);
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PySequence_Fast_GET_ITEM(seq, i);
+    Py_INCREF(o);
+    out[i] = o;
+  }
+  *n_out = static_cast<int>(n);
+  Py_DECREF(seq);
+  return 0;
+}
+
+// Thread-local string-list storage for List* style returns (valid until
+// the next List* call on the same thread — same contract as the
+// reference's MXSymbolListArguments).
+thread_local std::vector<std::string> tl_strlist;
+thread_local std::vector<const char*> tl_strlist_ptrs;
+
+int return_str_list(PyObject* list, int* out_size, const char*** out) {
+  PyObject* seq = PySequence_Fast(list, "expected a name list");
+  if (!seq) return -1;
+  tl_strlist.clear();
+  tl_strlist_ptrs.clear();
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* c = PyUnicode_AsUTF8(PySequence_Fast_GET_ITEM(seq, i));
+    if (!c) { Py_DECREF(seq); return -1; }
+    tl_strlist.emplace_back(c);
+  }
+  Py_DECREF(seq);
+  for (auto& s : tl_strlist) tl_strlist_ptrs.push_back(s.c_str());
+  *out_size = static_cast<int>(tl_strlist_ptrs.size());
+  *out = tl_strlist_ptrs.data();
+  return 0;
+}
+
+// Call mxnet_tpu.capi.<fn>(*args). Returns a new reference or nullptr
+// (python error pending).
+PyObject* capi_call(const char* fn, PyObject* args /* stolen */) {
+  if (!args) return nullptr;
+  PyObject* f = PyObject_GetAttrString(g_capi, fn);
+  if (!f) { Py_DECREF(args); return nullptr; }
+  PyObject* r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_DECREF(args);
+  return r;
+}
+
+int handle_free(void* h) {
+  if (!h) return 0;
+  Gil gil;
+  Py_DECREF(static_cast<PyObject*>(h));
+  return 0;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// Symbol (ref: MXSymbolCreateVariable / CreateAtomicSymbol + Compose /
+// ListArguments / SaveToJSON)
+
+MXTPU_API int MXTPUSymbolCreateVariable(const char* name,
+                                        SymbolHandle* out) {
+  if (!require_init()) return -1;
+  Gil gil;
+  PyObject* r = capi_call("symbol_variable",
+                          Py_BuildValue("(s)", name));
+  if (!r) { set_error_from_python(); return -1; }
+  *out = r;
+  return 0;
+}
+
+// Atomic symbol creation + composition in one call (the reference
+// splits these into CreateAtomicSymbol + Compose; one shot is the same
+// surface without partially-composed intermediate states).  `in_keys`
+// may be NULL (positional inputs in the op's declared order).
+MXTPU_API int MXTPUSymbolInvoke(const char* op_name, SymbolHandle* inputs,
+                                int num_inputs, const char** in_keys,
+                                const char** keys, const char** vals,
+                                int num_kwargs, const char* name,
+                                SymbolHandle* out) {
+  if (!require_init()) return -1;
+  Gil gil;
+  do {
+    PyObject* ins = handle_list(inputs, num_inputs);
+    PyObject* ikeys = in_keys ? str_list(in_keys, num_inputs) : Py_None;
+    if (ikeys == Py_None) Py_INCREF(Py_None);
+    PyObject* ks = str_list(keys, num_kwargs);
+    PyObject* vs = str_list(vals, num_kwargs);
+    if (!ins || !ikeys || !ks || !vs) {
+      Py_XDECREF(ins); Py_XDECREF(ikeys); Py_XDECREF(ks); Py_XDECREF(vs);
+      break;
+    }
+    PyObject* r = capi_call(
+        "symbol_invoke",
+        Py_BuildValue("(sNNNNs)", op_name, ins, ikeys, ks, vs,
+                      name ? name : ""));
+    if (!r) break;
+    *out = r;
+    return 0;
+  } while (false);
+  set_error_from_python();
+  return -1;
+}
+
+MXTPU_API int MXTPUSymbolListArguments(SymbolHandle sym, int* out_size,
+                                       const char*** out) {
+  if (!require_init()) return -1;
+  Gil gil;
+  PyObject* r = capi_call("symbol_list_arguments",
+                          Py_BuildValue("(O)",
+                                        static_cast<PyObject*>(sym)));
+  if (!r || return_str_list(r, out_size, out) != 0) {
+    Py_XDECREF(r);
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXTPUSymbolListAuxiliaryStates(SymbolHandle sym,
+                                             int* out_size,
+                                             const char*** out) {
+  if (!require_init()) return -1;
+  Gil gil;
+  PyObject* r = capi_call("symbol_list_aux",
+                          Py_BuildValue("(O)",
+                                        static_cast<PyObject*>(sym)));
+  if (!r || return_str_list(r, out_size, out) != 0) {
+    Py_XDECREF(r);
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+// Shape inference across the ABI (ref: MXSymbolInferShape). Known
+// shapes arrive as (names, ndims, concatenated dims); results land in
+// thread-local arrays valid until the next call on this thread:
+// per-array ndim plus one concatenated dim vector, args first then aux.
+static thread_local std::vector<int> tl_shape_ndims;
+static thread_local std::vector<int64_t> tl_shape_dims;
+
+MXTPU_API int MXTPUSymbolInferShape(SymbolHandle sym, int num_known,
+                                    const char** known_names,
+                                    const int* known_ndims,
+                                    const int64_t* known_dims_concat,
+                                    int* out_num_args, int* out_num_aux,
+                                    const int** out_ndims,
+                                    const int64_t** out_dims_concat) {
+  if (!require_init()) return -1;
+  Gil gil;
+  do {
+    PyObject* names = str_list(known_names, num_known);
+    if (!names) break;
+    PyObject* shapes = PyList_New(num_known);
+    if (!shapes) { Py_DECREF(names); break; }
+    int64_t off = 0;
+    for (int i = 0; i < num_known; ++i) {
+      PyObject* t = PyTuple_New(known_ndims[i]);
+      for (int d = 0; d < known_ndims[i]; ++d)
+        PyTuple_SET_ITEM(t, d,
+                         PyLong_FromLongLong(known_dims_concat[off + d]));
+      off += known_ndims[i];
+      PyList_SET_ITEM(shapes, i, t);
+    }
+    PyObject* r = capi_call(
+        "symbol_infer_shape",
+        Py_BuildValue("(ONN)", static_cast<PyObject*>(sym), names,
+                      shapes));
+    if (!r) break;
+    PyObject *arg_shapes, *aux_shapes;
+    if (!PyArg_ParseTuple(r, "OO", &arg_shapes, &aux_shapes)) {
+      Py_DECREF(r);
+      break;
+    }
+    tl_shape_ndims.clear();
+    tl_shape_dims.clear();
+    int n_args = 0, n_aux = 0;
+    bool ok = true;
+    for (PyObject* lst : {arg_shapes, aux_shapes}) {
+      Py_ssize_t n = PyList_Size(lst);
+      (lst == arg_shapes ? n_args : n_aux) = static_cast<int>(n);
+      for (Py_ssize_t i = 0; i < n && ok; ++i) {
+        PyObject* t = PyList_GetItem(lst, i);
+        PyObject* tup = PySequence_Tuple(t);
+        if (!tup) { ok = false; break; }
+        Py_ssize_t nd = PyTuple_Size(tup);
+        tl_shape_ndims.push_back(static_cast<int>(nd));
+        for (Py_ssize_t d = 0; d < nd; ++d)
+          tl_shape_dims.push_back(
+              PyLong_AsLongLong(PyTuple_GetItem(tup, d)));
+        Py_DECREF(tup);
+      }
+    }
+    Py_DECREF(r);
+    if (!ok) break;
+    *out_num_args = n_args;
+    *out_num_aux = n_aux;
+    *out_ndims = tl_shape_ndims.data();
+    *out_dims_concat = tl_shape_dims.data();
+    return 0;
+  } while (false);
+  set_error_from_python();
+  return -1;
+}
+
+// In-place device copy dst <- src (ref: MXNDArraySyncCopyFromNDArray);
+// feeds new batches into bound executor args.
+MXTPU_API int MXTPUNDArrayCopyFrom(NDArrayHandle dst, NDArrayHandle src) {
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(static_cast<PyObject*>(src),
+                                    "copyto", "O",
+                                    static_cast<PyObject*>(dst));
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+static thread_local std::string tl_symbol_json;
+
+MXTPU_API int MXTPUSymbolSaveToJSON(SymbolHandle sym,
+                                    const char** out_json) {
+  if (!require_init()) return -1;
+  Gil gil;
+  PyObject* r = capi_call("symbol_tojson",
+                          Py_BuildValue("(O)",
+                                        static_cast<PyObject*>(sym)));
+  if (!r) { set_error_from_python(); return -1; }
+  const char* c = PyUnicode_AsUTF8(r);
+  if (!c) { Py_DECREF(r); set_error_from_python(); return -1; }
+  tl_symbol_json = c;
+  Py_DECREF(r);
+  *out_json = tl_symbol_json.c_str();
+  return 0;
+}
+
+MXTPU_API int MXTPUSymbolCreateFromJSON(const char* json,
+                                        SymbolHandle* out) {
+  if (!require_init()) return -1;
+  Gil gil;
+  PyObject* r = capi_call("symbol_fromjson", Py_BuildValue("(s)", json));
+  if (!r) { set_error_from_python(); return -1; }
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXTPUSymbolFree(SymbolHandle h) { return handle_free(h); }
+
+// ---------------------------------------------------------------------------
+// Executor (ref: MXExecutorBindEX / Forward / Backward / Outputs).
+// Gradient buffers are allocated inside bind for every non-'null' arg;
+// read them back per-name with MXTPUExecutorArgGrad after backward.
+
+MXTPU_API int MXTPUExecutorBind(SymbolHandle sym, const char* ctx,
+                                NDArrayHandle* args, int num_args,
+                                const char* grad_req,
+                                NDArrayHandle* auxs, int num_aux,
+                                ExecutorHandle* out) {
+  if (!require_init()) return -1;
+  Gil gil;
+  PyObject* a = handle_list(args, num_args);
+  PyObject* x = handle_list(auxs, num_aux);
+  if (!a || !x) {
+    Py_XDECREF(a); Py_XDECREF(x);
+    set_error_from_python();
+    return -1;
+  }
+  PyObject* r = capi_call(
+      "executor_bind",
+      Py_BuildValue("(OsNsN)", static_cast<PyObject*>(sym),
+                    ctx ? ctx : "", a, grad_req ? grad_req : "write", x));
+  if (!r) { set_error_from_python(); return -1; }
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXTPUExecutorForward(ExecutorHandle ex, int is_train,
+                                   NDArrayHandle* outputs,
+                                   int* num_outputs /* in: capacity */) {
+  if (!require_init()) return -1;
+  Gil gil;
+  PyObject* r = capi_call(
+      "executor_forward",
+      Py_BuildValue("(Oi)", static_cast<PyObject*>(ex), is_train));
+  if (!r || list_to_handles(r, outputs, num_outputs) != 0) {
+    Py_XDECREF(r);
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXTPUExecutorBackward(ExecutorHandle ex,
+                                    NDArrayHandle* out_grads,
+                                    int num_out_grads) {
+  if (!require_init()) return -1;
+  Gil gil;
+  PyObject* g = out_grads ? handle_list(out_grads, num_out_grads)
+                          : (Py_INCREF(Py_None), Py_None);
+  if (!g) { set_error_from_python(); return -1; }
+  PyObject* r = capi_call(
+      "executor_backward",
+      Py_BuildValue("(ON)", static_cast<PyObject*>(ex), g));
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXTPUExecutorArgGrad(ExecutorHandle ex, const char* name,
+                                   NDArrayHandle* out) {
+  if (!require_init()) return -1;
+  Gil gil;
+  PyObject* r = capi_call(
+      "executor_arg_grad",
+      Py_BuildValue("(Os)", static_cast<PyObject*>(ex), name));
+  if (!r) { set_error_from_python(); return -1; }
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXTPUExecutorFree(ExecutorHandle h) {
+  return handle_free(h);
+}
+
+// ---------------------------------------------------------------------------
+// CachedOp (ref: MXCreateCachedOpEx / MXInvokeCachedOpEx): whole graph
+// as ONE XLA computation, executable cache keyed by shapes+train flag.
+// Inputs arrive in list_arguments order followed by aux states.
+
+MXTPU_API int MXTPUCreateCachedOp(SymbolHandle sym, CachedOpHandle* out) {
+  if (!require_init()) return -1;
+  Gil gil;
+  PyObject* r = capi_call(
+      "cachedop_create",
+      Py_BuildValue("(O)", static_cast<PyObject*>(sym)));
+  if (!r) { set_error_from_python(); return -1; }
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXTPUInvokeCachedOp(CachedOpHandle op,
+                                  NDArrayHandle* inputs, int num_inputs,
+                                  int is_train, NDArrayHandle* outputs,
+                                  int* num_outputs /* in: capacity */) {
+  if (!require_init()) return -1;
+  Gil gil;
+  PyObject* ins = handle_list(inputs, num_inputs);
+  if (!ins) { set_error_from_python(); return -1; }
+  PyObject* r = capi_call(
+      "cachedop_invoke",
+      Py_BuildValue("(ONi)", static_cast<PyObject*>(op), ins, is_train));
+  if (!r || list_to_handles(r, outputs, num_outputs) != 0) {
+    Py_XDECREF(r);
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXTPUCachedOpFree(CachedOpHandle h) {
+  return handle_free(h);
+}
+
+// ---------------------------------------------------------------------------
+// Autograd (ref: MXAutogradSetIsRecording/SetIsTraining/MarkVariables/
+// BackwardEx + MXNDArrayGetGrad) — the imperative training path.
+
+MXTPU_API int MXTPUAutogradSetIsRecording(int is_recording, int* prev) {
+  if (!require_init()) return -1;
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(g_autograd, "set_recording", "i",
+                                    is_recording);
+  if (!r) { set_error_from_python(); return -1; }
+  if (prev) *prev = PyObject_IsTrue(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXTPUAutogradSetIsTraining(int is_training, int* prev) {
+  if (!require_init()) return -1;
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(g_autograd, "set_training", "i",
+                                    is_training);
+  if (!r) { set_error_from_python(); return -1; }
+  if (prev) *prev = PyObject_IsTrue(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXTPUAutogradMarkVariables(int num, NDArrayHandle* vars,
+                                         NDArrayHandle* gradients) {
+  if (!require_init()) return -1;
+  Gil gil;
+  PyObject* v = handle_list(vars, num);
+  PyObject* g = handle_list(gradients, num);
+  if (!v || !g) {
+    Py_XDECREF(v); Py_XDECREF(g);
+    set_error_from_python();
+    return -1;
+  }
+  PyObject* r = PyObject_CallMethod(g_autograd, "mark_variables", "NN",
+                                    v, g);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXTPUAutogradBackward(int num_heads, NDArrayHandle* heads,
+                                    NDArrayHandle* head_grads,
+                                    int retain_graph) {
+  if (!require_init()) return -1;
+  Gil gil;
+  PyObject* h = handle_list(heads, num_heads);
+  PyObject* hg = head_grads ? handle_list(head_grads, num_heads)
+                            : (Py_INCREF(Py_None), Py_None);
+  if (!h || !hg) {
+    Py_XDECREF(h); Py_XDECREF(hg);
+    set_error_from_python();
+    return -1;
+  }
+  PyObject* r = PyObject_CallMethod(g_autograd, "backward", "NNi", h, hg,
+                                    retain_graph);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXTPUNDArrayGetGrad(NDArrayHandle h, NDArrayHandle* out) {
+  Gil gil;
+  PyObject* g = PyObject_GetAttrString(static_cast<PyObject*>(h), "grad");
+  if (!g) { set_error_from_python(); return -1; }
+  if (g == Py_None) {
+    Py_DECREF(g);
+    tl_last_error = "array has no gradient (mark_variables not called "
+                    "or backward not run)";
+    return -1;
+  }
+  *out = g;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Optimizer (ref: MXOptimizerCreateOptimizer / MXOptimizerUpdate;
+// per-index state lives behind the handle, as on a kvstore server).
+
+MXTPU_API int MXTPUOptimizerCreate(const char* name, const char** keys,
+                                   const char** vals, int num_kwargs,
+                                   OptimizerHandle* out) {
+  if (!require_init()) return -1;
+  Gil gil;
+  PyObject* ks = str_list(keys, num_kwargs);
+  PyObject* vs = str_list(vals, num_kwargs);
+  if (!ks || !vs) {
+    Py_XDECREF(ks); Py_XDECREF(vs);
+    set_error_from_python();
+    return -1;
+  }
+  PyObject* r = capi_call("optimizer_create",
+                          Py_BuildValue("(sNN)", name, ks, vs));
+  if (!r) { set_error_from_python(); return -1; }
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXTPUOptimizerUpdate(OptimizerHandle opt, int index,
+                                   NDArrayHandle weight,
+                                   NDArrayHandle grad) {
+  if (!require_init()) return -1;
+  Gil gil;
+  PyObject* r = capi_call(
+      "optimizer_update",
+      Py_BuildValue("(OiOO)", static_cast<PyObject*>(opt), index,
+                    static_cast<PyObject*>(weight),
+                    static_cast<PyObject*>(grad)));
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXTPUOptimizerFree(OptimizerHandle h) {
+  return handle_free(h);
+}
+
+// ---------------------------------------------------------------------------
+// Data iterators (ref: MXDataIterCreateIter / Next / GetData /
+// GetLabel / BeforeFirst) — iterator registry by name, stringly-typed
+// kwargs, one current batch per handle.
+
+MXTPU_API int MXTPUDataIterCreate(const char* name, const char** keys,
+                                  const char** vals, int num_kwargs,
+                                  DataIterHandle* out) {
+  if (!require_init()) return -1;
+  Gil gil;
+  PyObject* ks = str_list(keys, num_kwargs);
+  PyObject* vs = str_list(vals, num_kwargs);
+  if (!ks || !vs) {
+    Py_XDECREF(ks); Py_XDECREF(vs);
+    set_error_from_python();
+    return -1;
+  }
+  PyObject* r = capi_call("dataiter_create",
+                          Py_BuildValue("(sNN)", name, ks, vs));
+  if (!r) { set_error_from_python(); return -1; }
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXTPUDataIterNext(DataIterHandle it, int* out_more) {
+  if (!require_init()) return -1;
+  Gil gil;
+  PyObject* r = capi_call(
+      "dataiter_next", Py_BuildValue("(O)", static_cast<PyObject*>(it)));
+  if (!r) { set_error_from_python(); return -1; }
+  *out_more = PyObject_IsTrue(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXTPUDataIterGetData(DataIterHandle it,
+                                   NDArrayHandle* out) {
+  if (!require_init()) return -1;
+  Gil gil;
+  PyObject* r = capi_call(
+      "dataiter_data", Py_BuildValue("(O)", static_cast<PyObject*>(it)));
+  if (!r) { set_error_from_python(); return -1; }
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXTPUDataIterGetLabel(DataIterHandle it,
+                                    NDArrayHandle* out) {
+  if (!require_init()) return -1;
+  Gil gil;
+  PyObject* r = capi_call(
+      "dataiter_label",
+      Py_BuildValue("(O)", static_cast<PyObject*>(it)));
+  if (!r) { set_error_from_python(); return -1; }
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXTPUDataIterBeforeFirst(DataIterHandle it) {
+  if (!require_init()) return -1;
+  Gil gil;
+  PyObject* r = capi_call(
+      "dataiter_reset",
+      Py_BuildValue("(O)", static_cast<PyObject*>(it)));
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXTPUDataIterFree(DataIterHandle h) {
+  return handle_free(h);
+}
+
+// ---------------------------------------------------------------------------
+// KVStore (ref: MXKVStoreCreate / Init / Push / Pull — int keys, the
+// classic worker protocol; all types map onto the ICI/DCN collective
+// facades in mxnet_tpu/kvstore.py).
+
+MXTPU_API int MXTPUKVStoreCreate(const char* type, KVStoreHandle* out) {
+  if (!require_init()) return -1;
+  Gil gil;
+  PyObject* r = capi_call("kvstore_create",
+                          Py_BuildValue("(s)", type ? type : "local"));
+  if (!r) { set_error_from_python(); return -1; }
+  *out = r;
+  return 0;
+}
+
+namespace {
+int kvstore_keyed_call(const char* fn, KVStoreHandle kv, int num,
+                       const int* keys, NDArrayHandle* vals,
+                       int priority) {
+  Gil gil;
+  PyObject* ks = PyList_New(num);
+  if (!ks) { set_error_from_python(); return -1; }
+  for (int i = 0; i < num; ++i)
+    PyList_SET_ITEM(ks, i, PyLong_FromLong(keys[i]));
+  PyObject* vs = handle_list(vals, num);
+  if (!vs) {
+    Py_DECREF(ks);
+    set_error_from_python();
+    return -1;
+  }
+  PyObject* r = capi_call(
+      fn, Py_BuildValue("(ONNi)", static_cast<PyObject*>(kv), ks, vs,
+                        priority));
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+}  // namespace
+
+MXTPU_API int MXTPUKVStoreInit(KVStoreHandle kv, int num, const int* keys,
+                               NDArrayHandle* vals) {
+  if (!require_init()) return -1;
+  return kvstore_keyed_call("kvstore_init", kv, num, keys, vals, 0);
+}
+
+MXTPU_API int MXTPUKVStorePush(KVStoreHandle kv, int num, const int* keys,
+                               NDArrayHandle* vals, int priority) {
+  if (!require_init()) return -1;
+  return kvstore_keyed_call("kvstore_push", kv, num, keys, vals,
+                            priority);
+}
+
+MXTPU_API int MXTPUKVStorePull(KVStoreHandle kv, int num, const int* keys,
+                               NDArrayHandle* outs, int priority) {
+  if (!require_init()) return -1;
+  return kvstore_keyed_call("kvstore_pull", kv, num, keys, outs,
+                            priority);
+}
+
+MXTPU_API int MXTPUKVStoreFree(KVStoreHandle h) { return handle_free(h); }
 
 MXTPU_API int MXTPUNDArraySave(const char* fname, NDArrayHandle* handles,
                                const char** keys, int num) {

@@ -283,22 +283,29 @@ def bench_convfuse(bs=128, image=224, steps=20):
     x = np.random.RandomState(0).rand(bs, image, image, 3) \
         .astype(np.float32)
     y = np.random.RandomState(1).randint(0, 1000, bs).astype(np.float32)
-    for mode in ("xla", "pallas"):
-        os.environ["MXTPU_CONV_EPILOGUE"] = \
-            "" if mode == "xla" else "pallas"
-        from mxnet_tpu.gluon.model_zoo import vision
+    prev_epilogue = os.environ.get("MXTPU_CONV_EPILOGUE")
+    try:
+        for mode in ("xla", "pallas"):
+            os.environ["MXTPU_CONV_EPILOGUE"] = \
+                "" if mode == "xla" else "pallas"
+            from mxnet_tpu.gluon.model_zoo import vision
 
-        mx.random.seed(0)
-        net = vision.resnet50_v1(layout="NHWC")
-        net.initialize(mx.init.Xavier())
-        trainer = data_parallel.DataParallelTrainer(
-            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
-            {"learning_rate": 0.1, "momentum": 0.9},
-            compute_dtype="bfloat16")
-        _bench_trainer(jax, trainer, x, y, steps, bs,
-                       f"resnet50_convfuse_{mode}",
-                       {"unit": "images/sec", "batch_size": bs,
-                        "image_size": image, "conv_epilogue": mode})
+            mx.random.seed(0)
+            net = vision.resnet50_v1(layout="NHWC")
+            net.initialize(mx.init.Xavier())
+            trainer = data_parallel.DataParallelTrainer(
+                net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+                {"learning_rate": 0.1, "momentum": 0.9},
+                compute_dtype="bfloat16")
+            _bench_trainer(jax, trainer, x, y, steps, bs,
+                           f"resnet50_convfuse_{mode}",
+                           {"unit": "images/sec", "batch_size": bs,
+                            "image_size": image, "conv_epilogue": mode})
+    finally:
+        if prev_epilogue is None:
+            os.environ.pop("MXTPU_CONV_EPILOGUE", None)
+        else:
+            os.environ["MXTPU_CONV_EPILOGUE"] = prev_epilogue
 
 
 def bench_io(n_images=2048, size=256, batch_size=128, data_shape=96,
