@@ -120,7 +120,8 @@ class DataParallelTrainer:
             # the step donates its param inputs — donating an aliased
             # buffer would delete the block's own weights out from under
             # eager use (`Buffer has been deleted or donated`)
-            params.append(jax.device_put(jnp.array(raw, copy=True), sh))
+            params.append(mesh_mod.global_put(jnp.array(raw, copy=True),
+                                              sh))
             self._param_shardings.append(sh)
         self._params = tuple(params)
         if self._param_spec_fn is not None and not any(self._custom_spec):
@@ -159,8 +160,8 @@ class DataParallelTrainer:
         if custom:
             spec = getattr(param_sharding, "spec", None)
             if spec is not None and any(s is not None for s in spec):
-                return jax.device_put(z, param_sharding)
-        return jax.device_put(z, self._opt_state_sharding(z.shape))
+                return mesh_mod.global_put(z, param_sharding)
+        return mesh_mod.global_put(z, self._opt_state_sharding(z.shape))
 
     def _init_opt_states(self):
         name = self._opt_name
@@ -449,10 +450,11 @@ class DataParallelTrainer:
         self.build(x)
         data_sh = mesh_mod.batch_sharding(self.mesh)
         if multi:
-            x = tuple(jax.device_put(jnp.asarray(v), data_sh) for v in x)
+            x = tuple(mesh_mod.global_put(jnp.asarray(v), data_sh)
+                      for v in x)
         else:
-            x = jax.device_put(jnp.asarray(x), data_sh)
-        y = jax.device_put(jnp.asarray(y), data_sh)
+            x = mesh_mod.global_put(jnp.asarray(x), data_sh)
+        y = mesh_mod.global_put(jnp.asarray(y), data_sh)
         self._t += 1
         key = _random.next_key()
         loss, self._params, self._states = self._step_fn(
@@ -504,10 +506,11 @@ class DataParallelTrainer:
         else:
             put_sh = data_sh
         if multi:
-            x = tuple(jax.device_put(jnp.asarray(v), put_sh) for v in x)
+            x = tuple(mesh_mod.global_put(jnp.asarray(v), put_sh)
+                      for v in x)
         else:
-            x = jax.device_put(jnp.asarray(x), put_sh)
-        y = jax.device_put(jnp.asarray(y), put_sh)
+            x = mesh_mod.global_put(jnp.asarray(x), put_sh)
+        y = mesh_mod.global_put(jnp.asarray(y), put_sh)
         # consume the SAME key sequence n individual step() calls would
         keys = jnp.stack([_random.next_key() for _ in range(n_steps)])
         t0 = jnp.asarray(float(self._t + 1), jnp.float32)

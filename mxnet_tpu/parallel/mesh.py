@@ -40,11 +40,41 @@ def replicated(mesh):
     return NamedSharding(mesh, PartitionSpec())
 
 
-def batch_sharding(mesh, axis="dp"):
-    """Shard dim 0 over the data axis (split_and_load, SPMD form)."""
+def data_axes(mesh):
+    """The mesh axes the batch dim shards over.  A mesh axis named
+    'dcn' is the cross-slice/process data axis (ref: ps-lite workers ×
+    multi-GPU per worker, SURVEY §3.4); it composes OUTSIDE 'dp' so the
+    gradient reduction is hierarchical — reduce over ICI within the
+    slice, then over DCN across slices — exactly the pod shape."""
+    return tuple(a for a in ("dcn", "dp") if a in mesh.axis_names)
+
+
+def batch_sharding(mesh, axis=None):
+    """Shard dim 0 over the data axis/axes (split_and_load, SPMD form).
+    Default: ('dcn','dp') when a 'dcn' axis exists, else 'dp'."""
     from jax.sharding import NamedSharding, PartitionSpec
 
+    if axis is None:
+        axes = data_axes(mesh)
+        axis = axes if len(axes) > 1 else (axes[0] if axes else "dp")
     return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def global_put(value, sharding):
+    """device_put that also works on multi-process meshes.
+
+    Single process: plain jax.device_put.  Multi-process (the sharding
+    spans non-addressable devices): every process holds the same global
+    host value, and each places ONLY its addressable shards via
+    make_array_from_callback — no cross-host transfer needed (the DCN
+    data path stays inside compiled steps, where it belongs)."""
+    import jax
+
+    if jax.process_count() <= 1 or not hasattr(sharding, "mesh"):
+        return jax.device_put(value, sharding)
+    host = np.asarray(value)
+    return jax.make_array_from_callback(
+        host.shape, sharding, lambda idx: host[idx])
 
 
 def shard_param_spec(shape, mesh, tp_axis="tp"):

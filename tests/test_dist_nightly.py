@@ -82,6 +82,126 @@ def test_dist_async_conflict_three_workers(tmp_path, num_servers):
 
 
 @pytest.mark.slow
+@pytest.mark.skipif(__import__("shutil").which("mpirun") is None,
+                    reason="mpirun not installed")
+def test_dist_sync_kvstore_two_workers_mpi():
+    """VERDICT r3 #7: the mpi launcher transport (ref: dmlc_tracker/
+    mpi.py) — mpirun fans out ranks, the shim derives worker ids from
+    the MPI rank variable."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    res = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+         "-n", "2", "--launcher", "mpi", sys.executable,
+         os.path.join(_ROOT, "tests", "nightly", "dist_sync_kvstore.py")],
+        capture_output=True, text=True, timeout=240, env=env, cwd=_ROOT)
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out[-3000:]
+    assert "worker 0/2: dist_sync kvstore OK" in out
+    assert "worker 1/2: dist_sync kvstore OK" in out
+
+
+def test_mpi_shim_translates_rank():
+    """The --mpi-shim re-entry itself needs no mpirun: fake the OpenMPI
+    rank variable and check the env protocol lands in the child."""
+    env = dict(os.environ)
+    env.update({"OMPI_COMM_WORLD_RANK": "3", "MXTPU_NUM_WORKER": "4",
+                "DMLC_NUM_WORKER": "4"})
+    res = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+         "--mpi-shim", "--", sys.executable, "-c",
+         "import os; print('wid', os.environ['MXTPU_WORKER_ID'],"
+         " os.environ['DMLC_WORKER_ID'])"],
+        capture_output=True, text=True, timeout=60, env=env, cwd=_ROOT)
+    assert res.returncode == 0, res.stderr[-1000:]
+    assert "wid 3 3" in res.stdout
+    # no rank variable -> diagnosable failure, not a silent wrong id
+    env2 = {k: v for k, v in os.environ.items()
+            if "RANK" not in k and "PROCID" not in k}
+    res = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+         "--mpi-shim", "--", "true"],
+        capture_output=True, text=True, timeout=60, env=env2, cwd=_ROOT)
+    assert res.returncode == 2
+    assert "no MPI rank variable" in res.stderr
+
+
+def test_k8s_manifest_generator():
+    """--launcher k8s renders an indexed-Job manifest carrying the DMLC
+    env protocol (generator only; ref: dmlc_tracker yarn/k8s role)."""
+    res = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+         "-n", "4", "--launcher", "k8s", "--image", "example/img:1",
+         "--job-name", "trainjob", "python", "train.py"],
+        capture_output=True, text=True, timeout=60, cwd=_ROOT)
+    assert res.returncode == 0, res.stderr[-1000:]
+    out = res.stdout
+    import yaml
+
+    service, job = list(yaml.safe_load_all(out))
+    assert service["kind"] == "Service"
+    # k8s headless-service sentinel is the literal string "None"
+    assert service["spec"]["clusterIP"] == "None"
+    assert job["kind"] == "Job"
+    assert job["spec"]["completions"] == 4
+    assert job["spec"]["completionMode"] == "Indexed"
+    container = job["spec"]["template"]["spec"]["containers"][0]
+    assert container["image"] == "example/img:1"
+    assert container["command"] == ["python", "train.py"]
+    envs = {e["name"]: e for e in container["env"]}
+    assert envs["MXTPU_COORDINATOR"]["value"] == "trainjob-0.trainjob:9099"
+    assert envs["MXTPU_NUM_WORKER"]["value"] == "4"
+    assert "fieldRef" in envs["MXTPU_WORKER_ID"]["valueFrom"]
+
+
+@pytest.mark.slow
+def test_dist_hierarchical_dcn_x_ici(tmp_path):
+    """The pod shape (VERDICT r3 #5): 2 processes x 4 virtual devices
+    each — DataParallelTrainer on a 2-level {'dcn': 2, 'dp': 4} mesh
+    must reproduce the 8-device single-process losses exactly, and
+    kvstore('dist_sync') composed with an in-process 4-device psum must
+    reproduce the full-batch gradient (ref: ps-lite workers x
+    multi-GPU per worker, SURVEY §3.4)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import data_parallel
+    from mxnet_tpu.parallel import mesh as mesh_mod
+
+    # oracle: the same trainer, single process, flat 8-device dp mesh
+    # (conftest provides the virtual 8-CPU mesh)
+    rng = np.random.RandomState(0)
+    X = rng.rand(16, 20).astype(np.float32)
+    Y = rng.randint(0, 10, 16).astype(np.float32)
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"))
+    net.add(gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    trainer = data_parallel.DataParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1}, mesh=mesh_mod.make_mesh({"dp": 8}))
+    losses = [float(trainer.step(X, Y).asnumpy()) for _ in range(5)]
+    oracle_file = str(tmp_path / "hier_oracle.npz")
+    np.savez(oracle_file, losses=np.asarray(losses, np.float64))
+
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["MXTPU_ORACLE_FILE"] = oracle_file
+    res = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", sys.executable,
+         os.path.join(_ROOT, "tests", "nightly",
+                      "dist_hier_dcn_ici.py")],
+        capture_output=True, text=True, timeout=420, env=env, cwd=_ROOT)
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out[-3000:]
+    for r in (0, 1):
+        assert f"worker {r}/2: hier dcn x ici OK" in out
+
+
+@pytest.mark.slow
 @pytest.mark.parametrize("failure_mode", ["sigkill", "sigstop"])
 def test_dist_async_server_death_fails_fast(tmp_path, failure_mode):
     """Kill the dedicated parameter-server PROCESS mid-run: the worker

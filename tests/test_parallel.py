@@ -17,6 +17,32 @@ def test_make_mesh():
     assert m2.shape == {"dp": 4, "tp": 2}
 
 
+def test_trainer_two_level_dcn_mesh_matches_flat_dp():
+    """A {'dcn': 2, 'dp': 4} two-level mesh (the pod shape: DCN outer,
+    ICI inner) must reproduce the flat {'dp': 8} losses step for step —
+    the single-process half of VERDICT r3 #5 (the 2-process form runs
+    in tests/test_dist_nightly.py::test_dist_hierarchical_dcn_x_ici)."""
+    rng = np.random.RandomState(0)
+    X = rng.rand(16, 20).astype(np.float32)
+    Y = rng.randint(0, 10, 16).astype(np.float32)
+
+    def run(mesh_shape):
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(32, activation="relu"), nn.Dense(10))
+        net.initialize(mx.init.Xavier())
+        tr = data_parallel.DataParallelTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.1},
+            mesh=mesh_mod.make_mesh(mesh_shape))
+        return [float(tr.step(X, Y).asnumpy()) for _ in range(4)]
+
+    flat = run({"dp": 8})
+    hier = run({"dcn": 2, "dp": 4})
+    assert np.allclose(flat, hier, atol=1e-5), (flat, hier)
+    assert flat[-1] < flat[0]  # actually training
+
+
 def test_spmd_trainer_converges():
     np.random.seed(3)
     mx.random.seed(3)

@@ -43,11 +43,19 @@ def make_list(prefix, root, recursive=False, train_ratio=1.0):
     print(f"wrote {len(items)} entries to {prefix}.lst")
 
 
-def pack(prefix, root, quality=95, resize=0, color=1, pack_label=False):
+def pack(prefix, root, quality=95, resize=0, color=1, pack_label=False,
+         native=False):
     import numpy as np
     from PIL import Image
 
-    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    if native:
+        # record/index writing through src/recordio.cc (the im2rec.cc
+        # role); JPEG encode stays in Python — the bytes are identical
+        rec = recordio.NativeIndexedRecordIO(prefix + ".idx",
+                                             prefix + ".rec", "w")
+    else:
+        rec = recordio.MXIndexedRecordIO(prefix + ".idx",
+                                         prefix + ".rec", "w")
     n = 0
     with open(prefix + ".lst") as f:
         for line in f:
@@ -89,6 +97,9 @@ def main():
     ap.add_argument("--pack-label", action="store_true",
                     help="pack every .lst field between idx and path as "
                          "a float label vector (detection labels)")
+    ap.add_argument("--native", action="store_true",
+                    help="write records through the native C++ recordio "
+                         "writer (ref: tools/im2rec.cc)")
     args = ap.parse_args()
     if args.list:
         make_list(args.prefix, args.root, args.recursive)
@@ -96,7 +107,7 @@ def main():
         if not os.path.exists(args.prefix + ".lst"):
             make_list(args.prefix, args.root, recursive=True)
         pack(args.prefix, args.root, args.quality, args.resize, args.color,
-             pack_label=args.pack_label)
+             pack_label=args.pack_label, native=args.native)
 
 
 if __name__ == "__main__":

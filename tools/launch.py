@@ -68,6 +68,132 @@ def launch_local(n, cmd, port, num_servers=0):
     return code
 
 
+def launch_mpi(n, cmd, port, hostfile=None, mpirun="mpirun"):
+    """mpirun transport (ref: dmlc_tracker/mpi.py): mpirun fans out the
+    ranks; each rank derives its worker id from the MPI rank env var via
+    the --mpi-shim re-entry below, then execs the real command with the
+    DMLC env protocol complete."""
+    proto = {
+        "MXTPU_COORDINATOR": f"127.0.0.1:{port}",
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "MXTPU_NUM_WORKER": str(n), "DMLC_NUM_WORKER": str(n),
+        "DMLC_NUM_SERVER": "0", "DMLC_ROLE": "worker",
+    }
+    if hostfile:
+        # multi-host: the coordinator must be reachable from every rank
+        first = [h.strip().split()[0] for h in open(hostfile)
+                 if h.strip()][0]
+        proto["MXTPU_COORDINATOR"] = f"{first}:{port}"
+        proto["DMLC_PS_ROOT_URI"] = first
+    env = dict(os.environ)
+    env.update(proto)
+    mpi_cmd = [mpirun, "-n", str(n)]
+    if hostfile:
+        mpi_cmd += ["--hostfile", hostfile]
+    # --oversubscribe lets single-core CI hosts run n>1 ranks; harmless
+    # elsewhere (OpenMPI; ignored via allow-run-as-root fallback probe)
+    probe = subprocess.run([mpirun, "--oversubscribe", "-n", "1", "true"],
+                           capture_output=True)
+    if probe.returncode == 0:
+        mpi_cmd.insert(1, "--oversubscribe")
+    # carry the protocol vars on the COMMAND LINE (/usr/bin/env), not in
+    # mpirun's own environment: remote ranks don't inherit arbitrary env
+    # vars (OpenMPI would need -x per var, MPICH -envlist — dmlc-tracker
+    # mpi.py has the same workaround), and `env` works under both
+    mpi_cmd += ["env"] + [f"{k}={v}" for k, v in proto.items()]
+    mpi_cmd += [sys.executable, os.path.abspath(__file__),
+                "--mpi-shim", "--"] + cmd
+    return subprocess.call(mpi_cmd, env=env)
+
+
+def mpi_shim(cmd):
+    """Per-rank re-entry under mpirun: translate the MPI rank variable
+    (OpenMPI/PMI/MPICH spellings) into the worker-id env protocol, then
+    exec the user command in place."""
+    rank = None
+    for var in ("OMPI_COMM_WORLD_RANK", "PMIX_RANK", "PMI_RANK",
+                "MV2_COMM_WORLD_RANK", "SLURM_PROCID"):
+        if os.environ.get(var) is not None:
+            rank = os.environ[var]
+            break
+    if rank is None:
+        sys.stderr.write("launch.py --mpi-shim: no MPI rank variable "
+                         "found in the environment\n")
+        sys.exit(2)
+    os.environ["MXTPU_WORKER_ID"] = rank
+    os.environ["DMLC_WORKER_ID"] = rank
+    os.execvp(cmd[0], cmd)
+
+
+K8S_MANIFEST = """\
+# Generated by tools/launch.py --launcher k8s (ref: dmlc_tracker's yarn/
+# k8s transports). A headless Service gives worker-0 a stable DNS name
+# for the jax.distributed coordinator; an indexed Job runs one worker
+# per pod with the DMLC env protocol derived from the completion index.
+apiVersion: v1
+kind: Service
+metadata:
+  name: {name}
+spec:
+  clusterIP: None
+  selector:
+    job-name: {name}
+  ports:
+  - port: {port}
+---
+apiVersion: batch/v1
+kind: Job
+metadata:
+  name: {name}
+spec:
+  completions: {n}
+  parallelism: {n}
+  completionMode: Indexed
+  template:
+    metadata:
+      labels:
+        job-name: {name}
+    spec:
+      subdomain: {name}
+      restartPolicy: Never
+      containers:
+      - name: worker
+        image: {image}
+        command: {cmd_json}
+        env:
+        - name: MXTPU_WORKER_ID
+          valueFrom:
+            fieldRef:
+              fieldPath: metadata.annotations['batch.kubernetes.io/job-completion-index']
+        - name: DMLC_WORKER_ID
+          valueFrom:
+            fieldRef:
+              fieldPath: metadata.annotations['batch.kubernetes.io/job-completion-index']
+        - name: MXTPU_COORDINATOR
+          value: "{name}-0.{name}:{port}"
+        - name: DMLC_PS_ROOT_URI
+          value: "{name}-0.{name}"
+        - name: DMLC_PS_ROOT_PORT
+          value: "{port}"
+        - name: MXTPU_NUM_WORKER
+          value: "{n}"
+        - name: DMLC_NUM_WORKER
+          value: "{n}"
+        - name: DMLC_ROLE
+          value: worker
+"""
+
+
+def k8s_manifest(n, cmd, port, image, name="mxtpu-job"):
+    """Render the k8s Job+Service manifest for `kubectl apply -f -`.
+    A generator, not an applier: no cluster access is assumed here."""
+    import json
+
+    return K8S_MANIFEST.format(n=n, port=port, image=image, name=name,
+                               cmd_json=json.dumps(cmd))
+
+
 def launch_ssh(hosts, n, cmd, port):
     coordinator = hosts[0]
     procs = []
@@ -98,15 +224,26 @@ def launch_ssh(hosts, n, cmd, port):
 
 
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "--mpi-shim":
+        cmd = sys.argv[2:]
+        if cmd and cmd[0] == "--":
+            cmd = cmd[1:]
+        mpi_shim(cmd)
+        return  # unreachable (execvp)
     ap = argparse.ArgumentParser()
     ap.add_argument("-n", "--num-workers", type=int, required=True)
     ap.add_argument("-s", "--num-servers", type=int, default=0,
                     help="dedicated parameter-server processes for the "
                          "dist_async transport (dist_sync uses in-graph "
                          "DCN all-reduce and needs none)")
-    ap.add_argument("--launcher", choices=["local", "ssh"], default="local")
+    ap.add_argument("--launcher", choices=["local", "ssh", "mpi", "k8s"],
+                    default="local")
     ap.add_argument("-H", "--hostfile", default=None)
     ap.add_argument("-p", "--port", type=int, default=9099)
+    ap.add_argument("--image", default="mxnet-tpu:latest",
+                    help="container image for --launcher k8s")
+    ap.add_argument("--job-name", default="mxtpu-job",
+                    help="Job/Service name for --launcher k8s")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args()
     cmd = args.command
@@ -117,6 +254,18 @@ def main():
     if args.launcher == "local":
         sys.exit(launch_local(args.num_workers, cmd, args.port,
                               args.num_servers))
+    if args.num_servers and args.launcher in ("mpi", "k8s"):
+        # fail loudly rather than silently dropping the PS processes the
+        # dist_async transport needs
+        ap.error(f"--num-servers is not supported by the "
+                 f"{args.launcher} launcher (use --launcher local/ssh)")
+    if args.launcher == "mpi":
+        sys.exit(launch_mpi(args.num_workers, cmd, args.port,
+                            hostfile=args.hostfile))
+    if args.launcher == "k8s":
+        sys.stdout.write(k8s_manifest(args.num_workers, cmd, args.port,
+                                      args.image, args.job_name))
+        sys.exit(0)
     hosts = [h.strip() for h in open(args.hostfile) if h.strip()]
     sys.exit(launch_ssh(hosts, args.num_workers, cmd, args.port))
 
