@@ -36,8 +36,11 @@ class BERTForPretrain(HybridBlock):
         self._vocab = vocab_size
 
     def hybrid_forward(self, F, inputs, token_types, mlm_targets,
-                       nsp_labels, mask_weight):
-        mlm_scores, nsp_scores = self.model(inputs, token_types)
+                       nsp_labels, mask_weight, valid_length=None):
+        # valid_length masks attention over the [PAD] tail (real-corpus
+        # batches are padded; the BERT recipe never attends to pads)
+        mlm_scores, nsp_scores = self.model(inputs, token_types,
+                                            valid_length)
         mlm_log = F.log_softmax(mlm_scores)
         mlm_ll = F.pick(mlm_log, mlm_targets, axis=-1)
         mlm_loss = -F.sum(mlm_ll * mask_weight) / (F.sum(mask_weight) + 1)
@@ -55,8 +58,9 @@ def synthetic_batch(rng, bs, seq_len, vocab, mask_frac=0.15):
     targets = tokens.copy()
     inputs = np.where(mask > 0, 3, tokens)  # 3 = [MASK]
     nsp = rng.randint(0, 2, (bs,))
+    valid = np.full((bs,), seq_len, np.int32)
     return (inputs.astype(np.int32), types, targets.astype(np.int32),
-            nsp.astype(np.int32), mask)
+            nsp.astype(np.int32), mask, valid)
 
 
 def main():
@@ -74,6 +78,12 @@ def main():
                    help="rematerialize activations per child block "
                         "(jax.checkpoint): more FLOPs for less HBM "
                         "when activations don't fit")
+    p.add_argument("--data", default=None,
+                   help="path to a pretraining corpus (one sentence "
+                        "per line, blank line between documents); "
+                        "default trains on synthetic batches")
+    p.add_argument("--wordpiece-vocab", type=int, default=8000,
+                   help="WordPiece vocab size learned from --data")
     args = p.parse_args()
     apply_backend(args)
     if args.model == "tiny":
@@ -81,6 +91,24 @@ def main():
 
     mx.random.seed(0)
     rng = np.random.RandomState(0)
+
+    pipeline = None
+    if args.data:
+        # real-corpus path (VERDICT r3 #6): WordPiece + MLM/NSP from
+        # mxnet_tpu.data — swap the corpus, keep the training loop
+        from mxnet_tpu.data import WordPieceTokenizer
+        from mxnet_tpu.data.bert import BertPretrainPipeline
+
+        with open(args.data) as f:
+            lines = f.readlines()
+        tok = WordPieceTokenizer.build(
+            [ln for ln in lines if ln.strip()],
+            vocab_size=args.wordpiece_vocab)
+        args.vocab_size = len(tok)
+        pipeline = BertPretrainPipeline(lines, tok,
+                                        seq_len=args.seq_len, seed=0)
+        print(f"corpus {args.data}: wordpiece vocab {len(tok)}")
+
     backbone = getattr(bert, f"bert_{args.model}")(
         vocab_size=args.vocab_size)
     net = BERTForPretrain(backbone, args.vocab_size)
@@ -100,11 +128,20 @@ def main():
         net, _Identity(), "adamw",
         {"learning_rate": args.lr, "wd": 0.01}, remat=args.remat)
 
+    batch_stream = pipeline.batches(args.batch_size, args.steps) \
+        if pipeline else None
+
     tic, tic_n = time.time(), 0
     for step in range(args.steps):
-        inputs, types, targets, nsp, mask = synthetic_batch(
-            rng, args.batch_size, args.seq_len, args.vocab_size)
-        loss = trainer.step((inputs, types, targets, nsp, mask),
+        if batch_stream is not None:
+            b = next(batch_stream)
+            inputs, types, targets, nsp, mask, valid = (
+                b["input_ids"], b["token_types"], b["mlm_targets"],
+                b["nsp_labels"], b["mask_weight"], b["valid_length"])
+        else:
+            inputs, types, targets, nsp, mask, valid = synthetic_batch(
+                rng, args.batch_size, args.seq_len, args.vocab_size)
+        loss = trainer.step((inputs, types, targets, nsp, mask, valid),
                             np.zeros((args.batch_size,), np.float32))
         tic_n += args.batch_size * args.seq_len
         if step % args.disp == 0 and step:

@@ -1,0 +1,17 @@
+"""Dataset-readiness pipelines for the BASELINE workload configs
+(VERDICT r3 #6).
+
+Everything here runs on synthetic corpora in CI; a session WITH the
+real datasets (BookCorpus/Wikipedia, WMT14, GluonTS datasets) points
+the same loaders at files and trains — download-and-run.
+
+- text:       WordPiece + BPE subword tokenizers (trainable)
+- bert:       MLM masking + NSP pairing batch stream (GluonNLP
+              create_pretraining_data.py role)
+- nmt:        parallel-corpus BPE + length-bucketed batching (WMT
+              prep + Sockeye/GluonNLP data pipeline role)
+- timeseries: GluonTS-style ListDataset, age/scale/time features,
+              instance splitting, train/predict split (DeepAR)
+"""
+from . import bert, nmt, text, timeseries  # noqa: F401
+from .text import BPETokenizer, WordPieceTokenizer, learn_bpe  # noqa: F401

@@ -106,15 +106,49 @@ class DeepARNetwork(HybridBlock):
 
     def predict(self, context, prediction_length=24, num_samples=100,
                 covariates=None, seed=0):
-        """Ancestral sampling (host loop over the compiled step)."""
+        """Ancestral sampling (host loop over the compiled step).
+
+        ``covariates``: (b, context+prediction, C) known-future
+        features aligned with training's covariate layout — REQUIRED
+        when the network was trained with covariates (the LSTM input
+        width is baked in at first forward)."""
+        from ..base import MXNetError
         from ..ndarray import ndarray as _nd
 
         rng = np.random.RandomState(seed)
         b, t0 = context.shape[:2]
         paths = np.repeat(context.asnumpy()[:, :], num_samples, axis=0)
+        cov_rep = None
+        if covariates is not None:
+            cov_np = np.asarray(
+                covariates.asnumpy() if hasattr(covariates, "asnumpy")
+                else covariates, np.float32)
+            if cov_np.shape[:2] != (b, t0 + prediction_length):
+                raise MXNetError(
+                    f"predict covariates must be (batch, context+"
+                    f"prediction, C) = ({b}, {t0 + prediction_length}, "
+                    f"C); got {cov_np.shape}")
+            cov_rep = np.repeat(cov_np, num_samples, axis=0)
         for step in range(prediction_length):
-            feats_nd = _nd.array(paths.astype(np.float32))
-            out = self.lstm(self._lag_features_nd(feats_nd))
+            # training alignment: position t's input is lag1=target[t-1]
+            # (+ cov[t]) and its output parameterizes target[t].  To
+            # sample the NEXT value target[L] we therefore need a
+            # feature ROW AT POSITION L: extend the path with a dummy
+            # tail value (never read by position L's lag window) so the
+            # last LSTM output is conditioned on the newest sample and
+            # the current step's covariates.
+            L = paths.shape[1]
+            ext = np.concatenate(
+                [paths, np.zeros((paths.shape[0], 1), paths.dtype)],
+                axis=1)
+            feats_nd = _nd.array(ext.astype(np.float32))
+            lag = self._lag_features_nd(feats_nd)
+            if cov_rep is not None:
+                from .. import ndarray as F
+
+                cur = _nd.array(cov_rep[:, :L + 1])
+                lag = F.concat(lag, cur, dim=2)
+            out = self.lstm(lag)
             params = self.distr_output(out)
             if self._distr == "student_t":
                 mu, sigma, nu = [p.asnumpy()[:, -1] for p in params]

@@ -74,9 +74,46 @@ def _use_pallas():
     # interpret-only), past any trace-time try/except — fall back to
     # the jnp reference forms instead
     try:
-        return jax.default_backend() == "tpu"
+        on_tpu = jax.default_backend() == "tpu"
     except RuntimeError:
         return False
+    if not on_tpu:
+        return False
+    # on TPU: one-time Mosaic compile probe of the whole family so an
+    # un-lowerable tiling degrades to the XLA path instead of erroring
+    # mid-train (VERDICT r3 #2; MXTPU_PALLAS_CONV_FUSED_OK overrides)
+    from .probe import probe_ok
+
+    return probe_ok("conv_fused", _compile_probe)
+
+
+def _compile_probe():
+    """Compile (not run) tiny value-and-grad instances of all three
+    fused kernels plus the bn_stats epilogue, f32 and bf16."""
+    from . import batch_norm as _pbn
+
+    for dt in (jnp.float32, jnp.bfloat16):
+        x = jnp.zeros((256, 128), dt)
+        w = jnp.zeros((128, 128), dt)
+        sc = jnp.zeros((1, 128), dt)
+        sh = jnp.zeros((1, 128), dt)
+
+        def _loss_mm(a, b):
+            return matmul_bn_stats(a, b)[0].astype(jnp.float32).sum()
+
+        def _loss_act(a, s1, s2, b):
+            return bn_act_matmul(a, s1, s2, b).astype(jnp.float32).sum()
+
+        def _loss_act_stats(a, s1, s2, b):
+            return bn_act_matmul_stats(a, s1, s2, b)[0] \
+                .astype(jnp.float32).sum()
+
+        jax.jit(jax.grad(_loss_mm)).lower(x, w).compile()
+        jax.jit(jax.grad(_loss_act)).lower(x, sc, sh, w).compile()
+        jax.jit(jax.grad(_loss_act_stats)).lower(x, sc, sh, w).compile()
+        jax.jit(jax.grad(
+            lambda a: _pbn.bn_stats(a)[0].astype(jnp.float32).sum())) \
+            .lower(x).compile()
 
 
 # ---------------------------------------------------------------------------

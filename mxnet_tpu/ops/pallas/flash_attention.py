@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import functools
 import math
-import time
 
 import jax
 import jax.numpy as jnp
@@ -326,19 +325,14 @@ def _tiles_ok(q, k, block_q=128, block_k=128):
             and sq >= block_q and sk >= block_k)
 
 
-_D64_PROBE_OK = None
-_D64_PROBE_TRANSIENT_FAILS = 0
-_D64_PROBE_LAST_STRIKE_T = float("-inf")
-
-
 def _headdim64_allowed():
     """Whether the d%64 (non-128-multiple) tiling may hit the kernel.
 
     A Mosaic lowering failure for this tiling would surface at
     jit-compile time — after trace time, so past the try/except in
     ops/attention._k_sdpa — leaving no runtime fallback.  On real TPU we
-    therefore compile-probe a tiny d=64 instance ONCE per process
-    (eagerly, outside any enclosing trace) and cache the verdict; off
+    therefore compile-probe a tiny d=64 instance ONCE per process via
+    the shared pallas probe (ops/pallas/probe.py latching rules); off
     TPU (interpret mode) the kernel is interpreter-checked and always
     allowed.  MXTPU_FLASH_HEADDIM64=1/0 forces the answer either way.
     """
@@ -353,42 +347,20 @@ def _headdim64_allowed():
         on_tpu = False
     if not on_tpu:
         return True
-    global _D64_PROBE_OK, _D64_PROBE_TRANSIENT_FAILS
-    if _D64_PROBE_OK is None:
-        try:
-            # probe value-and-grad in both training dtypes so a Mosaic
-            # rejection of the BACKWARD d=64 tiling (or the bf16
-            # variant) is caught here, not at the user's jit compile
-            for dt in (jnp.float32, jnp.bfloat16):
-                q = jnp.zeros((1, 1, 128, 64), dt)
-                jax.jit(jax.grad(
-                    lambda a: _flash_sdpa(a, a, a, None, False, 0.125)
-                    .astype(jnp.float32).sum())).lower(q).compile()
-            _D64_PROBE_OK = True
-        except Exception as e:
-            if "mosaic" in f"{type(e).__name__} {e}".lower():
-                # the chip genuinely rejects this tiling: latch for the
-                # process lifetime
-                _D64_PROBE_OK = False
-            else:
-                # transient (tunnel RPC, compile-service hiccup): fall
-                # back THIS call and leave the verdict open so a later
-                # call re-probes after the backend recovers — but a
-                # PERSISTENT non-Mosaic failure (e.g. probe OOM) must
-                # not re-run the full compile probe on every dispatch.
-                # Strikes are counted at most once per 60s window so a
-                # brief outage (many dispatches, one cause) is ONE
-                # strike; latching False needs 3 strikes spread over
-                # >=2 minutes, i.e. a genuinely persistent failure.
-                global _D64_PROBE_LAST_STRIKE_T
-                now = time.monotonic()
-                if now - _D64_PROBE_LAST_STRIKE_T >= 60.0:
-                    _D64_PROBE_TRANSIENT_FAILS += 1
-                    _D64_PROBE_LAST_STRIKE_T = now
-                if _D64_PROBE_TRANSIENT_FAILS >= 3:
-                    _D64_PROBE_OK = False
-                return False
-    return _D64_PROBE_OK
+    from .probe import probe_ok
+
+    return probe_ok("flash_headdim64", _d64_compile_probe)
+
+
+def _d64_compile_probe():
+    """Compile value-and-grad in both training dtypes so a Mosaic
+    rejection of the BACKWARD d=64 tiling (or the bf16 variant) is
+    caught here, not at the user's jit compile."""
+    for dt in (jnp.float32, jnp.bfloat16):
+        q = jnp.zeros((1, 1, 128, 64), dt)
+        jax.jit(jax.grad(
+            lambda a: _flash_sdpa(a, a, a, None, False, 0.125)
+            .astype(jnp.float32).sum())).lower(q).compile()
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))

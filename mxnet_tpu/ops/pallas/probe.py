@@ -1,0 +1,76 @@
+"""Shared one-time Mosaic compile probes for Pallas kernel families.
+
+Generalizes flash-attention's d%64 probe (VERDICT r3 #2): a Mosaic
+lowering failure surfaces at jit-COMPILE time — after trace time, past
+any trace-time try/except — so an un-lowerable kernel would error in
+the middle of the user's train step with no runtime fallback.  Each
+kernel family therefore compile-probes a tiny instance ONCE per
+process on first TPU dispatch and falls back to the XLA path for the
+process lifetime if the chip rejects the tiling.
+
+Latching rules (same as _headdim64_allowed):
+- compile succeeds            -> True forever;
+- Mosaic rejection            -> False forever (the chip genuinely
+                                 can't lower this family);
+- transient failure (tunnel RPC, compile-service hiccup) -> False for
+  THIS call, verdict stays open; strikes are counted at most once per
+  60s window and 3 strikes latch False (persistent non-Mosaic failure,
+  e.g. probe OOM, must not re-compile on every dispatch).
+
+``MXTPU_PALLAS_<FAMILY>_OK=1/0`` forces the verdict either way.
+Re-entrant calls (the probe's own compile dispatching back through the
+family's gate) report True so the probe exercises the real Pallas path.
+"""
+from __future__ import annotations
+
+import time
+
+_state = {}
+
+
+def _family(name):
+    return _state.setdefault(name, {
+        "verdict": None, "strikes": 0,
+        "last_strike_t": float("-inf"), "probing": False})
+
+
+def reset(name=None):
+    """Test hook: forget cached verdicts."""
+    if name is None:
+        _state.clear()
+    else:
+        _state.pop(name, None)
+
+
+def probe_ok(name, compile_fn, max_strikes=3, strike_spacing=60.0,
+             _clock=time.monotonic):
+    """True iff kernel family `name` may be dispatched on this backend.
+    `compile_fn` must .lower().compile() tiny instances of every kernel
+    in the family (fwd AND bwd, f32 and bf16)."""
+    from ...base import getenv
+
+    forced = getenv(f"PALLAS_{name.upper()}_OK", None)
+    if forced is not None:
+        return forced not in ("0", "false", "False", "")
+    st = _family(name)
+    if st["probing"]:
+        return True  # re-entrant: let the probe reach the pallas path
+    if st["verdict"] is None:
+        st["probing"] = True
+        try:
+            compile_fn()
+            st["verdict"] = True
+        except Exception as e:
+            if "mosaic" in f"{type(e).__name__} {e}".lower():
+                st["verdict"] = False
+            else:
+                now = _clock()
+                if now - st["last_strike_t"] >= strike_spacing:
+                    st["strikes"] += 1
+                    st["last_strike_t"] = now
+                if st["strikes"] >= max_strikes:
+                    st["verdict"] = False
+                return False
+        finally:
+            st["probing"] = False
+    return st["verdict"]

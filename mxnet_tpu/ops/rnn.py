@@ -114,9 +114,34 @@ def _use_pallas_lstm():
     if impl == "pallas":
         return True
     try:
-        return jax.default_backend() == "tpu"
+        on_tpu = jax.default_backend() == "tpu"
     except Exception:
         return False
+    if not on_tpu:
+        return False
+    # auto on TPU: one-time Mosaic compile probe so an un-lowerable
+    # recurrence kernel degrades to the lax.scan path instead of
+    # erroring mid-train (VERDICT r3 #2; MXTPU_PALLAS_RNN_OK overrides)
+    from .pallas.probe import probe_ok
+
+    return probe_ok("rnn", _lstm_compile_probe)
+
+
+def _lstm_compile_probe():
+    """Compile tiny value-and-grad LSTM recurrences, f32 and bf16."""
+    from .pallas.rnn import lstm_layer
+
+    T, N, H = 2, 8, 128
+    for dt in (jnp.float32, jnp.bfloat16):
+        xp = jnp.zeros((T, N, 4 * H), dt)
+        wh = jnp.zeros((4 * H, H), dt)
+        h0 = jnp.zeros((N, H), dt)
+        c0 = jnp.zeros((N, H), dt)
+
+        def _loss(a, b, c, d):
+            return lstm_layer(a, b, c, d)[0].astype(jnp.float32).sum()
+
+        jax.jit(jax.grad(_loss)).lower(xp, wh, h0, c0).compile()
 
 
 def _pallas_lstm_fits(N, H, G=4):

@@ -290,8 +290,9 @@ def test_im2rec_native_flag_end_to_end(tmp_path):
     assert batch.data[0].shape == (2, 3, 32, 32)
 
 
-@pytest.mark.skipif(len(os.sched_getaffinity(0)) < 2,
-                    reason="thread-scaling needs >=2 available cores")
+@pytest.mark.skipif(len(os.sched_getaffinity(0)) < 3,
+                    reason="thread-scaling needs >=3 available cores "
+                           "(2 decode threads + the consumer)")
 def test_decode_pool_scales_with_threads(tmp_path):
     """VERDICT r3 #9: the decode pool must actually scale — >=2 threads
     beat 1 on a multi-core host (ref: iter_image_recordio_2.cc decode

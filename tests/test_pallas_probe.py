@@ -1,0 +1,105 @@
+"""Shared Mosaic compile-probe latching rules (VERDICT r3 #2:
+generalize flash-attention's d%64 probe to every Pallas kernel family).
+The probe itself is backend-independent logic, tested here with fake
+compile fns and a fake clock; the actual on-chip compiles run in
+tests/test_tpu_smoke.py."""
+import pytest
+
+from mxnet_tpu.ops.pallas import probe
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    probe.reset()
+    yield
+    probe.reset()
+
+
+def test_success_latches_true():
+    calls = []
+    assert probe.probe_ok("fam", lambda: calls.append(1))
+    assert probe.probe_ok("fam", lambda: calls.append(1))
+    assert len(calls) == 1  # compiled once, verdict cached
+
+
+def test_mosaic_rejection_latches_false():
+    calls = []
+
+    def failing():
+        calls.append(1)
+        raise RuntimeError("Mosaic failed to lower this tiling")
+
+    assert not probe.probe_ok("fam", failing)
+    assert not probe.probe_ok("fam", failing)
+    assert len(calls) == 1  # no re-probing after a Mosaic verdict
+
+
+def test_transient_failure_leaves_verdict_open():
+    t = [0.0]
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 2:
+            raise OSError("tunnel RPC deadline exceeded")
+
+    assert not probe.probe_ok("fam", flaky, _clock=lambda: t[0])
+    # backend recovered: the next call re-probes and succeeds
+    assert probe.probe_ok("fam", flaky, _clock=lambda: t[0])
+    assert len(calls) == 2
+
+
+def test_transient_strikes_are_spaced_then_latch():
+    t = [0.0]
+
+    def always_transient():
+        raise OSError("compile service unavailable")
+
+    clock = lambda: t[0]  # noqa: E731
+    # burst of failures within one 60s window = ONE strike
+    for _ in range(5):
+        assert not probe.probe_ok("fam", always_transient, _clock=clock)
+    assert probe._family("fam")["strikes"] == 1
+    t[0] = 61.0
+    assert not probe.probe_ok("fam", always_transient, _clock=clock)
+    assert probe._family("fam")["strikes"] == 2
+    t[0] = 122.0
+    assert not probe.probe_ok("fam", always_transient, _clock=clock)
+    # 3 spaced strikes: latched False, compile_fn no longer invoked
+    assert probe._family("fam")["verdict"] is False
+    boom = []
+    assert not probe.probe_ok("fam", lambda: boom.append(1),
+                              _clock=clock)
+    assert not boom
+
+
+def test_env_override_wins(monkeypatch):
+    monkeypatch.setenv("MXTPU_PALLAS_FAM_OK", "0")
+    assert not probe.probe_ok("fam", lambda: None)
+    monkeypatch.setenv("MXTPU_PALLAS_FAM_OK", "1")
+
+    def explode():
+        raise RuntimeError("never called")
+
+    assert probe.probe_ok("fam", explode)
+
+
+def test_reentrant_call_reports_true():
+    """The probe's own compile dispatches back through the family gate
+    (e.g. matmul_bn_stats -> _use_pallas -> probe_ok): that inner call
+    must say True so the probe compiles the real Pallas path."""
+    seen = []
+
+    def compiles():
+        seen.append(probe.probe_ok("fam", lambda: None))
+
+    assert probe.probe_ok("fam", compiles)
+    assert seen == [True]
+
+
+def test_families_are_independent():
+    def bad():
+        raise RuntimeError("mosaic rejects family a")
+
+    assert not probe.probe_ok("fam_a", bad)
+    assert probe.probe_ok("fam_b", lambda: None)

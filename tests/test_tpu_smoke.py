@@ -1,0 +1,85 @@
+"""On-TPU Mosaic validation smoke list (VERDICT r3 #2).
+
+Compiles (value-and-grad, f32 AND bf16) every Pallas kernel family on
+the real chip: flash attention (d=128 and the d%64 tiling, causal and
+key-padding-masked), all three conv-fused epilogue kernels + bn_stats,
+and the LSTM recurrence.  SKIPS off-TPU — interpret mode can't catch
+Mosaic lowering failures; this file is the first thing to run when a
+chip session opens (`pytest tests/test_tpu_smoke.py -v`).
+"""
+import jax
+import jax.numpy as jnp
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() != "tpu",
+    reason="Mosaic lowering is only real on TPU")
+
+
+@pytest.mark.parametrize("dt", [jnp.float32, jnp.bfloat16],
+                         ids=["f32", "bf16"])
+@pytest.mark.parametrize("d,causal,masked", [
+    (128, False, False), (128, True, False), (128, False, True),
+    (64, False, False), (64, True, False), (64, False, True),
+])
+def test_flash_attention_compiles(dt, d, causal, masked):
+    from mxnet_tpu.ops.pallas.flash_attention import _flash_sdpa
+
+    q = jnp.zeros((1, 2, 256, d), dt)
+    km = jnp.zeros((1, 256), jnp.float32) if masked else None
+
+    def loss(a):
+        return _flash_sdpa(a, a, a, km, causal, 0.125) \
+            .astype(jnp.float32).sum()
+
+    jax.jit(jax.grad(loss)).lower(q).compile()
+
+
+@pytest.mark.parametrize("dt", [jnp.float32, jnp.bfloat16],
+                         ids=["f32", "bf16"])
+def test_conv_fused_kernels_compile(dt):
+    from mxnet_tpu.ops.pallas import batch_norm as pbn
+    from mxnet_tpu.ops.pallas import conv_fused as cf
+
+    x = jnp.zeros((512, 256), dt)
+    w = jnp.zeros((256, 256), dt)
+    sc = jnp.zeros((1, 256), dt)
+    sh = jnp.zeros((1, 256), dt)
+    jax.jit(jax.grad(lambda a: cf.matmul_bn_stats(a, w)[0]
+                     .astype(jnp.float32).sum())).lower(x).compile()
+    jax.jit(jax.grad(lambda a: cf.bn_act_matmul(a, sc, sh, w)
+                     .astype(jnp.float32).sum())).lower(x).compile()
+    jax.jit(jax.grad(lambda a: cf.bn_act_matmul_stats(a, sc, sh, w)[0]
+                     .astype(jnp.float32).sum())).lower(x).compile()
+    jax.jit(jax.grad(lambda a: pbn.bn_stats(a)[0]
+                     .astype(jnp.float32).sum())).lower(x).compile()
+
+
+@pytest.mark.parametrize("dt", [jnp.float32, jnp.bfloat16],
+                         ids=["f32", "bf16"])
+def test_pallas_lstm_compiles(dt):
+    from mxnet_tpu.ops.pallas.rnn import lstm_layer
+
+    T, N, H = 4, 16, 128
+    xp = jnp.zeros((T, N, 4 * H), dt)
+    wh = jnp.zeros((4 * H, H), dt)
+    h0 = jnp.zeros((N, H), dt)
+    c0 = jnp.zeros((N, H), dt)
+    jax.jit(jax.grad(lambda a: lstm_layer(a, wh, h0, c0)[0]
+                     .astype(jnp.float32).sum())).lower(xp).compile()
+
+
+def test_probe_gates_report_on_chip():
+    """The family gates themselves: on a healthy chip every probe
+    should come back True (a False here IS the signal the kernels
+    can't lower — the XLA fallback keeps training alive)."""
+    from mxnet_tpu.ops.pallas.conv_fused import _use_pallas
+    from mxnet_tpu.ops.pallas.flash_attention import _headdim64_allowed
+    from mxnet_tpu.ops.rnn import _use_pallas_lstm
+
+    verdicts = {"conv_fused": _use_pallas(),
+                "rnn": _use_pallas_lstm(),
+                "flash_headdim64": _headdim64_allowed()}
+    print(f"pallas probe verdicts: {verdicts}")
+    # report, don't fail: a False verdict means the gate did its job
+    assert all(isinstance(v, bool) for v in verdicts.values())

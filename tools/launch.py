@@ -88,15 +88,20 @@ def launch_mpi(n, cmd, port, hostfile=None, mpirun="mpirun"):
         proto["DMLC_PS_ROOT_URI"] = first
     env = dict(os.environ)
     env.update(proto)
-    mpi_cmd = [mpirun, "-n", str(n)]
+    # --oversubscribe lets single-core hosts run n>1 ranks and OpenMPI
+    # under root needs --allow-run-as-root (container default); probe
+    # flag combos richest-first and keep the first that mpirun accepts
+    extra = []
+    for flags in (["--oversubscribe", "--allow-run-as-root"],
+                  ["--allow-run-as-root"], ["--oversubscribe"], []):
+        p = subprocess.run([mpirun] + flags + ["-n", "1", "true"],
+                           capture_output=True)
+        if p.returncode == 0:
+            extra = flags
+            break
+    mpi_cmd = [mpirun] + extra + ["-n", str(n)]
     if hostfile:
         mpi_cmd += ["--hostfile", hostfile]
-    # --oversubscribe lets single-core CI hosts run n>1 ranks; harmless
-    # elsewhere (OpenMPI; ignored via allow-run-as-root fallback probe)
-    probe = subprocess.run([mpirun, "--oversubscribe", "-n", "1", "true"],
-                           capture_output=True)
-    if probe.returncode == 0:
-        mpi_cmd.insert(1, "--oversubscribe")
     # carry the protocol vars on the COMMAND LINE (/usr/bin/env), not in
     # mpirun's own environment: remote ranks don't inherit arbitrary env
     # vars (OpenMPI would need -x per var, MPICH -envlist — dmlc-tracker
