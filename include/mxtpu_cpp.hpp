@@ -1,0 +1,297 @@
+// Header-only C++ frontend over the flat C ABI (lib/libmxtpu_capi.so).
+//
+// Ref (behavioral parity): cpp-package/include/mxnet-cpp/ — the
+// reference's header-only C++ API rides the same flat C ABI every other
+// frontend does.  Same story here: RAII handles + exceptions over the
+// MXTPU* surface; nothing in this header touches Python types, the
+// embedded orchestrator stays behind the C boundary (DESIGN.md "C
+// ABI").
+//
+// Usage: compile your program with g++ -I include, link -lmxtpu_capi.
+// See tests/capi_cpp_driver.cc for an end-to-end training example.
+#pragma once
+
+#include <cstdint>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+extern "C" {
+const char* MXTPUGetLastError(void);
+int MXTPUCAPIInit(const char* platform);
+int MXTPUNDArrayCreate(const void* data, const int64_t* shape, int ndim,
+                       int dtype, const char* ctx, void** out);
+int MXTPUNDArrayFree(void* h);
+int MXTPUNDArrayGetShape(void* h, int* out_ndim, int64_t* out_shape);
+int MXTPUNDArraySyncCopyToCPU(void* h, void* out, int64_t nbytes);
+int MXTPUNDArrayCopyFrom(void* dst, void* src);
+int MXTPUImperativeInvoke(const char* op, void** in, int n_in,
+                          const char** keys, const char** vals, int nkw,
+                          void** out, int* n_out);
+int MXTPUSymbolCreateVariable(const char* name, void** out);
+int MXTPUSymbolInvoke(const char* op, void** inputs, int n, const char** ik,
+                      const char** keys, const char** vals, int nkw,
+                      const char* name, void** out);
+int MXTPUSymbolListArguments(void* sym, int* n, const char*** names);
+int MXTPUSymbolInferShape(void* sym, int n_known, const char** names,
+                          const int* ndims, const int64_t* dims,
+                          int* n_args, int* n_aux, const int** out_ndims,
+                          const int64_t** out_dims);
+int MXTPUSymbolFree(void* h);
+int MXTPUExecutorBind(void* sym, const char* ctx, void** args, int n_args,
+                      const char* grad_req, void** auxs, int n_aux,
+                      void** out);
+int MXTPUExecutorForward(void* ex, int is_train, void** outputs, int* n);
+int MXTPUExecutorBackward(void* ex, void** out_grads, int n);
+int MXTPUExecutorArgGrad(void* ex, const char* name, void** out);
+int MXTPUExecutorFree(void* h);
+int MXTPUOptimizerCreate(const char* name, const char** keys,
+                         const char** vals, int nkw, void** out);
+int MXTPUOptimizerUpdate(void* opt, int index, void* weight, void* grad);
+int MXTPUOptimizerFree(void* h);
+}
+
+namespace mxtpu {
+
+inline void check(int rc, const char* what) {
+  if (rc != 0)
+    throw std::runtime_error(std::string(what) + ": " +
+                             MXTPUGetLastError());
+}
+
+inline void init(const std::string& platform = "") {
+  check(MXTPUCAPIInit(platform.c_str()), "init");
+}
+
+// string-keyed kwargs, the C API's stringly-typed convention
+using KWArgs = std::vector<std::pair<std::string, std::string>>;
+
+namespace detail {
+struct KwView {
+  std::vector<const char*> keys, vals;
+  explicit KwView(const KWArgs& kw) {
+    for (auto& p : kw) {
+      keys.push_back(p.first.c_str());
+      vals.push_back(p.second.c_str());
+    }
+  }
+};
+
+template <typename FreeFn>
+class Handle {
+ public:
+  Handle() = default;
+  explicit Handle(void* h) : h_(h) {}
+  Handle(Handle&& o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+  Handle& operator=(Handle&& o) noexcept {
+    if (this != &o) {
+      reset();
+      h_ = o.h_;
+      o.h_ = nullptr;
+    }
+    return *this;
+  }
+  Handle(const Handle&) = delete;
+  Handle& operator=(const Handle&) = delete;
+  ~Handle() { reset(); }
+  void* get() const { return h_; }
+  void reset() {
+    if (h_) FreeFn()(h_);
+    h_ = nullptr;
+  }
+
+ private:
+  void* h_ = nullptr;
+};
+
+struct NDFree { void operator()(void* h) { MXTPUNDArrayFree(h); } };
+struct SymFree { void operator()(void* h) { MXTPUSymbolFree(h); } };
+struct ExecFree { void operator()(void* h) { MXTPUExecutorFree(h); } };
+struct OptFree { void operator()(void* h) { MXTPUOptimizerFree(h); } };
+}  // namespace detail
+
+class NDArray {
+ public:
+  NDArray() = default;
+  explicit NDArray(void* raw) : h_(raw) {}
+  NDArray(const std::vector<float>& data,
+          const std::vector<int64_t>& shape,
+          const std::string& ctx = "") {
+    void* out = nullptr;
+    check(MXTPUNDArrayCreate(data.data(), shape.data(),
+                             static_cast<int>(shape.size()), /*f32*/ 0,
+                             ctx.c_str(), &out), "NDArray create");
+    h_ = detail::Handle<detail::NDFree>(out);
+  }
+  void* get() const { return h_.get(); }
+  std::vector<int64_t> shape() const {
+    int nd = 0;
+    int64_t dims[16];
+    check(MXTPUNDArrayGetShape(h_.get(), &nd, dims), "get shape");
+    return {dims, dims + nd};
+  }
+  int64_t size() const {
+    int64_t s = 1;
+    for (auto d : shape()) s *= d;
+    return s;
+  }
+  std::vector<float> as_vector() const {
+    std::vector<float> out(size());
+    check(MXTPUNDArraySyncCopyToCPU(
+              h_.get(), out.data(),
+              static_cast<int64_t>(out.size() * sizeof(float))),
+          "copy to cpu");
+    return out;
+  }
+  void copy_from(const NDArray& src) {
+    check(MXTPUNDArrayCopyFrom(h_.get(), src.get()), "copy_from");
+  }
+
+ private:
+  detail::Handle<detail::NDFree> h_;
+};
+
+inline std::vector<NDArray> invoke(const std::string& op,
+                                   const std::vector<NDArray*>& inputs,
+                                   const KWArgs& kw = {},
+                                   int max_outputs = 8) {
+  std::vector<void*> in;
+  for (auto* a : inputs) in.push_back(a->get());
+  detail::KwView v(kw);
+  std::vector<void*> out(max_outputs);
+  int n = max_outputs;
+  check(MXTPUImperativeInvoke(op.c_str(), in.data(),
+                              static_cast<int>(in.size()),
+                              v.keys.data(), v.vals.data(),
+                              static_cast<int>(kw.size()), out.data(),
+                              &n),
+        op.c_str());
+  std::vector<NDArray> res;
+  for (int i = 0; i < n; ++i) res.emplace_back(out[i]);
+  return res;
+}
+
+class Symbol {
+ public:
+  Symbol() = default;
+  explicit Symbol(void* raw) : h_(raw) {}
+  static Symbol Variable(const std::string& name) {
+    void* out = nullptr;
+    check(MXTPUSymbolCreateVariable(name.c_str(), &out), "sym var");
+    return Symbol(out);
+  }
+  static Symbol Op(const std::string& op,
+                   const std::vector<const Symbol*>& inputs,
+                   const KWArgs& kw = {}, const std::string& name = "") {
+    std::vector<void*> in;
+    for (auto* s : inputs) in.push_back(s->get());
+    detail::KwView v(kw);
+    void* out = nullptr;
+    check(MXTPUSymbolInvoke(op.c_str(), in.data(),
+                            static_cast<int>(in.size()), nullptr,
+                            v.keys.data(), v.vals.data(),
+                            static_cast<int>(kw.size()), name.c_str(),
+                            &out),
+          op.c_str());
+    return Symbol(out);
+  }
+  void* get() const { return h_.get(); }
+  std::vector<std::string> list_arguments() const {
+    int n = 0;
+    const char** names = nullptr;
+    check(MXTPUSymbolListArguments(h_.get(), &n, &names), "list args");
+    return {names, names + n};
+  }
+  // known input shapes -> every argument's shape
+  std::vector<std::vector<int64_t>> infer_arg_shapes(
+      const std::vector<std::pair<std::string, std::vector<int64_t>>>&
+          known) const {
+    std::vector<const char*> names;
+    std::vector<int> ndims;
+    std::vector<int64_t> dims;
+    for (auto& p : known) {
+      names.push_back(p.first.c_str());
+      ndims.push_back(static_cast<int>(p.second.size()));
+      dims.insert(dims.end(), p.second.begin(), p.second.end());
+    }
+    int n_args = 0, n_aux = 0;
+    const int* out_nd = nullptr;
+    const int64_t* out_dims = nullptr;
+    check(MXTPUSymbolInferShape(h_.get(),
+                                static_cast<int>(known.size()),
+                                names.data(), ndims.data(), dims.data(),
+                                &n_args, &n_aux, &out_nd, &out_dims),
+          "infer shape");
+    std::vector<std::vector<int64_t>> res;
+    int64_t off = 0;
+    for (int i = 0; i < n_args; ++i) {
+      res.emplace_back(out_dims + off, out_dims + off + out_nd[i]);
+      off += out_nd[i];
+    }
+    return res;
+  }
+
+ private:
+  detail::Handle<detail::SymFree> h_;
+};
+
+class Executor {
+ public:
+  Executor(const Symbol& sym, const std::vector<NDArray*>& args,
+           const std::string& grad_req = "write",
+           const std::string& ctx = "") {
+    std::vector<void*> a;
+    for (auto* x : args) a.push_back(x->get());
+    void* out = nullptr;
+    check(MXTPUExecutorBind(sym.get(), ctx.c_str(), a.data(),
+                            static_cast<int>(a.size()), grad_req.c_str(),
+                            nullptr, 0, &out),
+          "executor bind");
+    h_ = detail::Handle<detail::ExecFree>(out);
+  }
+  std::vector<NDArray> forward(bool is_train) {
+    std::vector<void*> outs(8);
+    int n = 8;
+    check(MXTPUExecutorForward(h_.get(), is_train ? 1 : 0, outs.data(),
+                               &n),
+          "forward");
+    std::vector<NDArray> res;
+    for (int i = 0; i < n; ++i) res.emplace_back(outs[i]);
+    return res;
+  }
+  void backward() {
+    check(MXTPUExecutorBackward(h_.get(), nullptr, 0), "backward");
+  }
+  NDArray arg_grad(const std::string& name) {
+    void* g = nullptr;
+    check(MXTPUExecutorArgGrad(h_.get(), name.c_str(), &g), "arg grad");
+    return NDArray(g);
+  }
+
+ private:
+  detail::Handle<detail::ExecFree> h_;
+};
+
+class Optimizer {
+ public:
+  explicit Optimizer(const std::string& name, const KWArgs& kw = {}) {
+    detail::KwView v(kw);
+    void* out = nullptr;
+    check(MXTPUOptimizerCreate(name.c_str(), v.keys.data(),
+                               v.vals.data(),
+                               static_cast<int>(kw.size()), &out),
+          "optimizer create");
+    h_ = detail::Handle<detail::OptFree>(out);
+  }
+  void update(int index, NDArray& weight, const NDArray& grad) {
+    check(MXTPUOptimizerUpdate(h_.get(), index, weight.get(),
+                               grad.get()),
+          "optimizer update");
+  }
+
+ private:
+  detail::Handle<detail::OptFree> h_;
+};
+
+}  // namespace mxtpu

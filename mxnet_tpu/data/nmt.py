@@ -91,6 +91,8 @@ class NMTBucketIter:
     @property
     def provide_data(self):
         return [DataDesc(self.data_name,
+                         (self.batch_size, self.default_bucket_key)),
+                DataDesc("tgt_in",
                          (self.batch_size, self.default_bucket_key))]
 
     @property

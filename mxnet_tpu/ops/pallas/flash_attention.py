@@ -338,9 +338,9 @@ def _headdim64_allowed():
     """
     from ...base import getenv
 
-    forced = getenv("FLASH_HEADDIM64", None)
+    forced = getenv("FLASH_HEADDIM64", None, bool)
     if forced is not None:
-        return forced not in ("0", "false", "False", "")
+        return forced
     try:
         on_tpu = jax.default_backend() == "tpu"
     except RuntimeError:

@@ -49,9 +49,9 @@ def probe_ok(name, compile_fn, max_strikes=3, strike_spacing=60.0,
     in the family (fwd AND bwd, f32 and bf16)."""
     from ...base import getenv
 
-    forced = getenv(f"PALLAS_{name.upper()}_OK", None)
+    forced = getenv(f"PALLAS_{name.upper()}_OK", None, bool)
     if forced is not None:
-        return forced not in ("0", "false", "False", "")
+        return forced
     st = _family(name)
     if st["probing"]:
         return True  # re-entrant: let the probe reach the pallas path

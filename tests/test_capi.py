@@ -124,3 +124,33 @@ def test_c_frontend_trains_lenet(tmp_path):
     assert "CAPI_TRAIN_OK" in r.stdout
     # the driver asserts the loss curve itself; sanity-check the print
     assert "epoch 2 loss" in r.stdout
+
+
+@pytest.mark.skipif(not _tool("g++") or not _tool("python3-config"),
+                    reason="native toolchain unavailable")
+def test_cpp_frontend_header_only_api(tmp_path):
+    """The cpp-package role: include/mxtpu_cpp.hpp (RAII + exceptions
+    over the flat C ABI) trains an MLP from C++ — a SECOND non-Python
+    frontend on the same boundary (ref: cpp-package/include/mxnet-cpp
+    over include/mxnet/c_api.h)."""
+    r = subprocess.run(["make", "lib/libmxtpu_capi.so"], cwd=REPO,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    exe = str(tmp_path / "capi_cpp_driver")
+    r = subprocess.run(
+        ["g++", "-std=c++17", "-I" + os.path.join(REPO, "include"),
+         os.path.join(REPO, "tests", "capi_cpp_driver.cc"),
+         "-o", exe, "-L" + os.path.join(REPO, "lib"), "-lmxtpu_capi",
+         "-Wl,-rpath," + os.path.join(REPO, "lib")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + [p for p in sys.path if "site-packages" in p])
+    env.pop("JAX_PLATFORMS", None)
+    r = subprocess.run([exe], capture_output=True, text=True,
+                       timeout=600, env=env, cwd=REPO)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    assert "CAPI_CPP_OK" in r.stdout

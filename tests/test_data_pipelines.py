@@ -87,8 +87,12 @@ def test_bert_pipeline_batch_contract():
     mask_id = tok.ids["[MASK]"]
     frac_mask = (b["input_ids"][masked] == mask_id).mean()
     assert 0.55 < frac_mask <= 1.0
-    # token types switch 0 -> 1 at the second segment
-    assert (np.diff(b["token_types"], axis=1) >= 0).all() or True
+    # token types are nondecreasing within the REAL tokens of each row
+    # (segment A then segment B; the pad tail outside valid_length is 0)
+    for r in range(16):
+        v = b["valid_length"][r]
+        assert (np.diff(b["token_types"][r, :v]) >= 0).all()
+        assert b["token_types"][r, v - 1] == 1  # segment B present
     # NSP labels carry both classes across a few batches
     labels = np.concatenate([x["nsp_labels"] for x in batches])
     assert 0 < labels.mean() < 1
@@ -242,6 +246,74 @@ def test_nmt_pipeline_trains_tiny_transformer():
             loss = trainer.step(tuple(b.data), b.label[0])
         losses.append(float(loss.asnumpy()))
     assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_nmt_bucket_iter_drives_bucketing_module():
+    """The bucketed pipeline through the LEGACY Module path: one
+    executor per length bucket sharing params (ref: BucketSentenceIter
+    + BucketingModule, the reference's actual seq2seq training story
+    and its only long-sequence scaling mechanism, SURVEY §5)."""
+    from mxnet_tpu import sym
+    from mxnet_tpu.module import BucketingModule
+
+    rng = np.random.RandomState(0)
+    pairs = dnmt.synthetic_parallel_corpus(rng, n=300, vocab=25)
+    bpe = dnmt.build_shared_bpe(pairs, num_merges=60)
+    enc = dnmt.encode_pairs(pairs, bpe, max_len=16)
+    it = dnmt.NMTBucketIter(enc, batch_size=16, buckets=(8, 16), seed=0)
+    V = len(bpe)
+
+    def sym_gen(bucket_key):
+        src = sym.var("src")
+        tgt_in = sym.var("tgt_in")
+        label = sym.var("tgt")
+        es = sym.Embedding(src, input_dim=V, output_dim=16,
+                           name="src_embed")
+        et = sym.Embedding(tgt_in, input_dim=V, output_dim=16,
+                           name="tgt_embed")
+        ctx_vec = sym.mean(es, axis=1, keepdims=True)
+        h = sym.broadcast_add(et, ctx_vec)
+        h = sym.Activation(
+            sym.FullyConnected(h, num_hidden=32, flatten=False,
+                               name="h1"), act_type="relu")
+        logits = sym.FullyConnected(h, num_hidden=V, flatten=False,
+                                    name="out")
+        out = sym.SoftmaxOutput(logits, label, preserve_shape=True,
+                                name="softmax")
+        return out, ("src", "tgt_in"), ("tgt",)
+
+    mod = BucketingModule(sym_gen,
+                          default_bucket_key=it.default_bucket_key,
+                          context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+
+    def epoch_nll():
+        it.reset()
+        tot, n = 0.0, 0
+        for b in it:
+            mod.forward(b, is_train=True)
+            probs = mod.get_outputs()[0].asnumpy()  # (b, L, V)
+            tgt = b.label[0]
+            real = tgt != 0
+            p = np.take_along_axis(probs, tgt[..., None], axis=-1)
+            tot += -np.log(np.clip(p[real[..., None]], 1e-8, 1)).sum()
+            n += int(real.sum())
+            mod.backward()
+            mod.update()
+        return tot / n
+
+    nlls = [epoch_nll() for _ in range(4)]
+    # mean-pooled context can't express position alignment, so the
+    # learnable part is the intra-word BPE transitions — a steady
+    # but bounded drop; the point here is the bucketing machinery
+    assert nlls[-1] < nlls[0] - 0.3, nlls
+    assert all(b <= a + 1e-3 for a, b in zip(nlls, nlls[1:])), nlls
+    # the same embedding params served BOTH buckets
+    arg_params, _ = mod.get_params()
+    assert arg_params["src_embed_weight"].shape == (V, 16)
+    assert len(mod._buckets) >= 2  # executors per bucket actually split
 
 
 # ---------------------------------------------------------------------------

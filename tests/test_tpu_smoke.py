@@ -11,8 +11,16 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+
+def _backend():
+    try:
+        return jax.default_backend()
+    except RuntimeError:  # backend init failed (e.g. tunnel down)
+        return "unavailable"
+
+
 pytestmark = pytest.mark.skipif(
-    jax.default_backend() != "tpu",
+    _backend() != "tpu",
     reason="Mosaic lowering is only real on TPU")
 
 
