@@ -12,7 +12,9 @@ Two compositions are asserted:
    (ICI); GSPMD emits the hierarchical all-reduce inside the compiled
    step.  Per-step losses must match the 8-device single-process
    oracle (computed by the launching pytest, passed via
-   MXTPU_ORACLE_FILE).
+   MXTPU_ORACLE_FILE).  1b repeats with {'dcn': 2, 'dp': 2, 'tp': 2}
+   + shard_params=True — DCN data parallelism composing with Megatron
+   tensor parallelism inside each slice, same oracle.
 2. kvstore('dist_sync') composed WITH an in-process 4-device psum:
    gradients reduce over the local mesh in-graph (CommDevice role),
    then push/pull through the dist kvstore's in-graph DCN all-reduce
@@ -54,28 +56,35 @@ Y = rng.randint(0, NCLS, GLOBAL_BATCH).astype(np.float32)
 
 oracle = np.load(os.environ["MXTPU_ORACLE_FILE"])
 
-# --- 1. trainer on the 2-level mesh ---------------------------------------
-mesh = mesh_mod.make_mesh({"dcn": 2, "dp": 4})
-# the outer axis must actually span processes (DCN), row r = process r
-for r in range(2):
-    assert all(d.process_index == r for d in mesh.devices[r].flat), (
-        "outer mesh axis does not align with process boundaries")
-
-mx.random.seed(0)
-net = gluon.nn.HybridSequential()
-net.add(gluon.nn.Dense(32, activation="relu"))
-net.add(gluon.nn.Dense(NCLS))
-net.initialize(mx.init.Xavier())
-trainer = data_parallel.DataParallelTrainer(
-    net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
-    {"learning_rate": 0.1}, mesh=mesh)
-
-losses = []
-for _ in range(5):
-    loss = trainer.step(X, Y)
-    losses.append(float(np.asarray(loss._data.addressable_data(0))))
+# --- 1. trainer on the 2-level mesh, then composed with TP ---------------
+# (a) pure hierarchical data parallelism {'dcn': 2, 'dp': 4};
+# (b) DCN x dp x Megatron-tp with sharded params — the pod's actual
+#     3-axis layout.  Both must match the flat-dp single-process oracle.
 ref = np.asarray(oracle["losses"])
-assert np.allclose(losses, ref, atol=1e-5), (losses, ref.tolist())
+for shape, extra in (({"dcn": 2, "dp": 4}, {}),
+                     ({"dcn": 2, "dp": 2, "tp": 2},
+                      {"shard_params": True})):
+    mesh = mesh_mod.make_mesh(shape)
+    # the outer axis must actually span processes (DCN), row r = proc r
+    for r in range(2):
+        assert all(d.process_index == r
+                   for d in mesh.devices[r].flat), (
+            f"outer mesh axis of {shape} does not align with process "
+            "boundaries")
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"))
+    net.add(gluon.nn.Dense(NCLS))
+    net.initialize(mx.init.Xavier())
+    trainer = data_parallel.DataParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1}, mesh=mesh, **extra)
+    losses = []
+    for _ in range(5):
+        loss = trainer.step(X, Y)
+        losses.append(float(np.asarray(loss._data.addressable_data(0))))
+    assert np.allclose(losses, ref, atol=1e-5), (shape, losses,
+                                                 ref.tolist())
 
 # --- 2. kvstore('dist_sync') x in-process psum ----------------------------
 # model: linear least squares; grads reduce hierarchically in two

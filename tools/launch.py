@@ -259,11 +259,12 @@ def main():
     if args.launcher == "local":
         sys.exit(launch_local(args.num_workers, cmd, args.port,
                               args.num_servers))
-    if args.num_servers and args.launcher in ("mpi", "k8s"):
+    if args.num_servers:
         # fail loudly rather than silently dropping the PS processes the
-        # dist_async transport needs
+        # dist_async transport needs (only the local launcher spawns
+        # DMLC_ROLE=server processes)
         ap.error(f"--num-servers is not supported by the "
-                 f"{args.launcher} launcher (use --launcher local/ssh)")
+                 f"{args.launcher} launcher (use --launcher local)")
     if args.launcher == "mpi":
         sys.exit(launch_mpi(args.num_workers, cmd, args.port,
                             hostfile=args.hostfile))
