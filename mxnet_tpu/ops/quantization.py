@@ -124,10 +124,37 @@ def _bias_to_i32(bias, min_b, max_b, min_d, max_d, min_w, max_w):
     return jnp.round(real_b * s_d * s_w).astype(jnp.int32)
 
 
-def _k_quantized_fully_connected(data, weight, bias, min_data, max_data,
-                                 min_weight, max_weight, min_bias=None,
-                                 max_bias=None, *, num_hidden, no_bias=False,
-                                 flatten=True):
+def _parse_q_inputs(no_bias, rest):
+    """Arity-aware input parsing shared by the int8 FC/conv kernels.
+
+    The reference's C++ ops adjust their EXPECTED input list on
+    ``no_bias`` (quantized_conv.cc/quantized_fully_connected.cc): with
+    a bias the inputs are (bias, min_data, max_data, min_weight,
+    max_weight, min_bias, max_bias); without, the bias slot and its
+    ranges are absent entirely — which is how the symbolic
+    quantize_model pass wires the graph.  The eager frontend instead
+    passes an explicit ``None`` placeholder in the bias slot; accept
+    both spellings."""
+    if no_bias:
+        # strip the bias slot by ARITY, not by None-ness: the eager
+        # frontend passes an explicit None there (5 trailing inputs),
+        # and a symbolically built call can carry a bound-but-ignored
+        # implicit bias variable (5 or, with bias ranges, 7); the
+        # 4-input form from quantize_model has no slot to strip
+        if len(rest) in (5, 7):
+            rest = rest[1:]
+        min_data, max_data, min_weight, max_weight = rest[:4]
+        return None, min_data, max_data, min_weight, max_weight, None, None
+    bias, min_data, max_data, min_weight, max_weight = rest[:5]
+    min_bias, max_bias = rest[5:7] if len(rest) >= 7 else (None, None)
+    return (bias, min_data, max_data, min_weight, max_weight, min_bias,
+            max_bias)
+
+
+def _k_quantized_fully_connected(data, weight, *rest, num_hidden,
+                                 no_bias=False, flatten=True):
+    (bias, min_data, max_data, min_weight, max_weight, min_bias,
+     max_bias) = _parse_q_inputs(no_bias, rest)
     x = data.reshape(data.shape[0], -1) if flatten and data.ndim > 2 else data
     out = lax.dot_general(x, weight, (((x.ndim - 1,), (1,)), ((), ())),
                           preferred_element_type=jnp.int32)
@@ -148,10 +175,11 @@ _CONV_DIMS = {1: ("NCW", "OIW", "NCW"),
               3: ("NCDHW", "OIDHW", "NCDHW")}
 
 
-def _k_quantized_conv(data, weight, bias, min_data, max_data, min_weight,
-                      max_weight, min_bias=None, max_bias=None, *, kernel,
+def _k_quantized_conv(data, weight, *rest, kernel,
                       stride=(), dilate=(), pad=(), num_filter=0,
                       num_group=1, no_bias=False, layout=None):
+    (bias, min_data, max_data, min_weight, max_weight, min_bias,
+     max_bias) = _parse_q_inputs(no_bias, rest)
     nd = len(kernel)
     stride = stride or (1,) * nd
     dilate = dilate or (1,) * nd

@@ -308,6 +308,70 @@ def bench_convfuse(bs=128, image=224, steps=20):
             os.environ["MXTPU_CONV_EPILOGUE"] = prev_epilogue
 
 
+def bench_quantized(bs=64, image=224, steps=20, network="resnet50_v1"):
+    """INT8 vs fp32 inference throughput on a model-zoo CNN — the
+    fork's specialty workload (ref: the ykim362 fork's MKL-DNN INT8
+    quantization tier; here int8 rides lax.dot_general int8 kernels,
+    SURVEY §2.2 quantization row).  Exports the gluon net to
+    symbol+params, quantizes FC/Conv to int8 via
+    contrib.quantization.quantize_model, and times executor forward
+    for both graphs.  Emits one JSON line per precision; the A/B delta
+    is the int8 speedup on this chip."""
+    import tempfile
+    import time as _time
+
+    jax = _setup_jax()
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu import symbol as sym_mod
+    from mxnet_tpu.contrib import quantization as qz
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    mx.random.seed(0)
+    net = getattr(vision, network)()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x_np = np.random.RandomState(0).rand(bs, 3, image, image) \
+        .astype(np.float32)
+    net(nd.array(x_np[:2]))  # build params
+    tmp = tempfile.mkdtemp(prefix="mxtpu_qbench_")
+    prefix = os.path.join(tmp, "net")
+    net.export(prefix)
+    symbol = sym_mod.load(prefix + "-symbol.json")
+    payload = nd.load(prefix + "-0000.params")
+    arg_params = {k[4:]: v for k, v in payload.items()
+                  if k.startswith("arg:")}
+    aux_params = {k[4:]: v for k, v in payload.items()
+                  if k.startswith("aux:")}
+
+    qsym, qargs, qaux = qz.quantize_model(
+        symbol, arg_params, aux_params, calib_mode="naive",
+        calib_data=x_np[: min(bs, 8)])
+
+    dev = jax.devices()[0]
+    x = nd.array(x_np)
+    for mode, s, a, aux in (("fp32", symbol, arg_params, aux_params),
+                            ("int8", qsym, qargs, qaux)):
+        ex = s.bind(mx.current_context(), dict(a, data=x),
+                    grad_req="null", aux_states=dict(aux))
+        ex.forward(is_train=False)[0].wait_to_read()  # compile
+        best = None
+        for _ in range(3):
+            t0 = _time.perf_counter()
+            for _ in range(steps):
+                out = ex.forward(is_train=False)[0]
+            out.wait_to_read()
+            w = (_time.perf_counter() - t0) / steps
+            best = w if best is None or w < best else best
+        print(json.dumps({
+            "metric": f"{network}_infer_{mode}",
+            "value": round(bs / best, 2), "unit": "images/sec",
+            "batch_size": bs, "image_size": image, "network": network,
+            "device_kind": dev.device_kind, "platform": dev.platform}))
+
+
 def bench_io(n_images=2048, size=256, batch_size=128, data_shape=96,
              threads=None):
     """Decode throughput through the native pipeline: JPEG .rec ->
@@ -367,11 +431,17 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("which", choices=["bert", "transformer", "deepar",
                                      "attention", "rnn", "convfuse",
-                                     "io", "all"])
+                                     "quantized", "io", "all"])
     p.add_argument("--batch-size", type=int, default=None,
                    help="override the per-benchmark default batch size")
     p.add_argument("--model", default="big", choices=["base", "big"],
                    help="transformer variant (transformer subcommand)")
+    p.add_argument("--network", default="resnet50_v1",
+                   help="model-zoo CNN for the quantized A/B")
+    p.add_argument("--image-size", type=int, default=224,
+                   help="input resolution for the quantized A/B")
+    p.add_argument("--steps", type=int, default=20,
+                   help="timed steps for the quantized A/B")
     args = p.parse_args()
     bs_kw = {"bs": args.batch_size} if args.batch_size else {}
     if args.which in ("bert", "all"):
@@ -386,6 +456,9 @@ def main():
         bench_rnn(**bs_kw)
     if args.which in ("convfuse", "all"):
         bench_convfuse(**bs_kw)
+    if args.which in ("quantized", "all"):
+        bench_quantized(network=args.network, image=args.image_size,
+                        steps=args.steps, **bs_kw)
     if args.which in ("io", "all"):
         bench_io(batch_size=args.batch_size or 128)
 
