@@ -313,3 +313,23 @@ def kvstore_push(kv, keys, vals, priority):
 def kvstore_pull(kv, keys, outs, priority):
     for k, o in zip(keys, outs):
         kv.pull(int(k), out=o, priority=priority)
+
+
+def kvstore_pushpull(kv, keys, vals, outs, priority):
+    """Fused push+pull (ref: MXKVStorePushPullEx) — the all-reduce
+    spelling Trainer.step uses."""
+    for k, v, o in zip(keys, vals, outs):
+        kv.pushpull(int(k), v, out=o, priority=priority)
+
+
+# ---------------------------------------------------------------------------
+# NDArray view/transform helpers (ref: MXNDArrayReshape64 / MXNDArraySlice)
+
+
+def ndarray_reshape(arr, shape):
+    return arr.reshape(tuple(int(d) for d in shape))
+
+
+def ndarray_slice(arr, begin, end):
+    # dim-0 slice, the MXNDArraySlice contract
+    return arr[int(begin):int(end)]

@@ -1185,23 +1185,32 @@ MXTPU_API int MXTPUKVStoreCreate(const char* type, KVStoreHandle* out) {
 }
 
 namespace {
+// Shared marshalling for every keyed kvstore call; `outs` is optional
+// (push/pull/init take one handle array, pushpull takes vals+outs).
 int kvstore_keyed_call(const char* fn, KVStoreHandle kv, int num,
                        const int* keys, NDArrayHandle* vals,
-                       int priority) {
+                       int priority, NDArrayHandle* outs = nullptr) {
   Gil gil;
   PyObject* ks = PyList_New(num);
   if (!ks) { set_error_from_python(); return -1; }
   for (int i = 0; i < num; ++i)
     PyList_SET_ITEM(ks, i, PyLong_FromLong(keys[i]));
   PyObject* vs = handle_list(vals, num);
-  if (!vs) {
+  PyObject* os = outs ? handle_list(outs, num) : nullptr;
+  if (!vs || (outs && !os)) {
     Py_DECREF(ks);
+    Py_XDECREF(vs);
+    Py_XDECREF(os);
     set_error_from_python();
     return -1;
   }
-  PyObject* r = capi_call(
-      fn, Py_BuildValue("(ONNi)", static_cast<PyObject*>(kv), ks, vs,
-                        priority));
+  PyObject* r = outs
+      ? capi_call(fn, Py_BuildValue("(ONNNi)",
+                                    static_cast<PyObject*>(kv), ks, vs,
+                                    os, priority))
+      : capi_call(fn, Py_BuildValue("(ONNi)",
+                                    static_cast<PyObject*>(kv), ks, vs,
+                                    priority));
   if (!r) { set_error_from_python(); return -1; }
   Py_DECREF(r);
   return 0;
@@ -1228,7 +1237,74 @@ MXTPU_API int MXTPUKVStorePull(KVStoreHandle kv, int num, const int* keys,
                             priority);
 }
 
+// Fused push+pull (ref: MXKVStorePushPullEx): vals in, reduced vals
+// out, one call — the Trainer.step all-reduce spelling.
+MXTPU_API int MXTPUKVStorePushPull(KVStoreHandle kv, int num,
+                                   const int* keys, NDArrayHandle* vals,
+                                   NDArrayHandle* outs, int priority) {
+  if (!require_init()) return -1;
+  return kvstore_keyed_call("kvstore_pushpull", kv, num, keys, vals,
+                            priority, outs);
+}
+
 MXTPU_API int MXTPUKVStoreFree(KVStoreHandle h) { return handle_free(h); }
+
+// ---------------------------------------------------------------------------
+// Version + NDArray view ops (ref: MXGetVersion, MXNDArrayReshape64,
+// MXNDArraySlice)
+
+static thread_local std::string tl_version;
+
+MXTPU_API int MXTPUGetVersion(const char** out) {
+  if (!require_init()) return -1;
+  Gil gil;
+  do {
+    PyObject* mx = PyImport_ImportModule("mxnet_tpu");
+    if (!mx) break;
+    PyObject* v = PyObject_GetAttrString(mx, "__version__");
+    Py_DECREF(mx);
+    if (!v) break;
+    const char* c = PyUnicode_AsUTF8(v);
+    if (!c) { Py_DECREF(v); break; }
+    tl_version = c;
+    Py_DECREF(v);
+    *out = tl_version.c_str();
+    return 0;
+  } while (false);
+  set_error_from_python();
+  return -1;
+}
+
+MXTPU_API int MXTPUNDArrayReshape(NDArrayHandle h, int ndim,
+                                  const int64_t* shape,
+                                  NDArrayHandle* out) {
+  if (!require_init()) return -1;
+  Gil gil;
+  PyObject* shp = PyList_New(ndim);
+  if (!shp) { set_error_from_python(); return -1; }
+  for (int i = 0; i < ndim; ++i)
+    PyList_SET_ITEM(shp, i, PyLong_FromLongLong(shape[i]));
+  PyObject* r = capi_call(
+      "ndarray_reshape",
+      Py_BuildValue("(ON)", static_cast<PyObject*>(h), shp));
+  if (!r) { set_error_from_python(); return -1; }
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXTPUNDArraySlice(NDArrayHandle h, int64_t begin,
+                                int64_t end, NDArrayHandle* out) {
+  if (!require_init()) return -1;
+  Gil gil;
+  PyObject* r = capi_call(
+      "ndarray_slice",
+      Py_BuildValue("(OLL)", static_cast<PyObject*>(h),
+                    static_cast<long long>(begin),
+                    static_cast<long long>(end)));
+  if (!r) { set_error_from_python(); return -1; }
+  *out = r;
+  return 0;
+}
 
 MXTPU_API int MXTPUNDArraySave(const char* fname, NDArrayHandle* handles,
                                const char** keys, int num) {

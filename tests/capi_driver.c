@@ -36,6 +36,11 @@ extern int MXTPUNDArrayLoad(const char* fname, int* out_size,
                             NDArrayHandle** out_handles,
                             int* out_name_size, const char*** out_names);
 extern int MXTPUOpGetDoc(const char* op_name, const char** out_doc);
+extern int MXTPUGetVersion(const char** out);
+extern int MXTPUNDArrayReshape(NDArrayHandle h, int ndim,
+                               const int64_t* shape, NDArrayHandle* out);
+extern int MXTPUNDArraySlice(NDArrayHandle h, int64_t begin, int64_t end,
+                             NDArrayHandle* out);
 
 #define CHECK(cond, msg)                                            \
   do {                                                              \
@@ -172,6 +177,31 @@ int main(int argc, char** argv) {
     MXTPUNDArrayFree(ld[i]);
   CHECK(MXTPUNDArrayLoad("/nonexistent/x.params", &ld_n, &ld,
                          &ld_names_n, &ld_names) != 0, "bad load rejected");
+
+  /* version + view ops (MXGetVersion / MXNDArrayReshape64 / Slice) */
+  {
+    const char* ver = NULL;
+    CHECK(MXTPUGetVersion(&ver) == 0 && ver && strlen(ver) > 0,
+          "get version");
+    NDArrayHandle r = NULL, s = NULL;
+    int64_t new_shape[2] = {3, 2};
+    CHECK(MXTPUNDArrayReshape(a, 2, new_shape, &r) == 0, "reshape");
+    int nd2 = 0;
+    int64_t d2[16];
+    CHECK(MXTPUNDArrayGetShape(r, &nd2, d2) == 0 && nd2 == 2 &&
+              d2[0] == 3 && d2[1] == 2, "reshaped dims");
+    CHECK(MXTPUNDArraySlice(r, 1, 3, &s) == 0, "slice");
+    float sl[4];
+    CHECK(MXTPUNDArraySyncCopyToCPU(s, sl, sizeof(sl)) == 0,
+          "copy slice");
+    CHECK(sl[0] == 3 && sl[3] == 6, "slice values");
+    int64_t bad_shape[1] = {7};
+    NDArrayHandle t = NULL;
+    CHECK(MXTPUNDArrayReshape(a, 1, bad_shape, &t) != 0,
+          "bad reshape rejected");
+    MXTPUNDArrayFree(r);
+    MXTPUNDArrayFree(s);
+  }
 
   /* op self-documentation crosses the ABI (dmlc parameter.h role) */
   const char* doc = NULL;

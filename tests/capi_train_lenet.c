@@ -97,6 +97,9 @@ extern int MXTPUKVStorePush(KVStoreHandle kv, int n, const int* keys,
                             NDArrayHandle* vals, int priority);
 extern int MXTPUKVStorePull(KVStoreHandle kv, int n, const int* keys,
                             NDArrayHandle* outs, int priority);
+extern int MXTPUKVStorePushPull(KVStoreHandle kv, int n, const int* keys,
+                                NDArrayHandle* vals, NDArrayHandle* outs,
+                                int priority);
 extern int MXTPUKVStoreFree(KVStoreHandle h);
 
 #define CHECK(cond, msg)                                            \
@@ -441,6 +444,16 @@ int main(int argc, char** argv) {
     for (int64_t j = 0; j < sz; ++j)
       if (fabsf(pulled[j] - gbuf[j]) > 1e-5f) match = 0;
     CHECK(match, "pull returns pushed gradient");
+    /* fused all-reduce spelling (MXKVStorePushPullEx role) */
+    CHECK(MXTPUKVStorePushPull(kv, 1, &key, &grads[1], &out_nd, 0) == 0,
+          "kv pushpull");
+    CHECK(MXTPUNDArraySyncCopyToCPU(out_nd, pulled,
+                                    sz * (int64_t)sizeof(float)) == 0,
+          "copy pushpulled");
+    match = 1;
+    for (int64_t j = 0; j < sz; ++j)
+      if (fabsf(pulled[j] - gbuf[j]) > 1e-5f) match = 0;
+    CHECK(match, "pushpull returns reduced gradient");
     free(pulled);
     free(gbuf);
     MXTPUNDArrayFree(out_nd);
