@@ -84,11 +84,16 @@ def main():
                    help="target-side parallel corpus")
     p.add_argument("--bpe-merges", type=int, default=8000,
                    help="joint BPE merges learned from the corpus")
+    p.add_argument("--translate", type=int, default=0,
+                   help="after training, beam-decode this many corpus "
+                        "sentences (Sockeye decode role; needs --data)")
     add_cpu_flag(p)
     args = p.parse_args()
     apply_backend(args)
     if bool(args.data_src) != bool(args.data_tgt):
         p.error("--data-src and --data-tgt must be given together")
+    if args.translate and not args.data_src:
+        p.error("--translate needs a corpus (--data-src/--data-tgt)")
     if args.model == "tiny":
         args.src_vocab = min(args.src_vocab, 1000)
         args.tgt_vocab = min(args.tgt_vocab, 1000)
@@ -150,6 +155,25 @@ def main():
             tic, tic_n = time.time(), 0
     loss.wait_to_read()
     print(f"done: final loss {float(loss.asscalar()):.4f}")
+
+    if args.translate and data_iter is not None:
+        # trained params live in the trainer's donated device buffers;
+        # decoding goes through the block
+        trainer.sync_to_block()
+        bos, eos = bpe.ids[bpe.BOS], bpe.ids[bpe.EOS]
+        n = min(args.translate, len(pairs))
+        L = buckets[-1]
+        src_ids = np.zeros((n, L), np.int32)
+        for i, (s, _) in enumerate(pairs[:n]):
+            ids = bpe.encode(s, eos=True)[:L]
+            src_ids[i, :len(ids)] = ids
+        from mxnet_tpu import nd
+
+        seqs, scores = net.model.beam_search_decode(
+            nd.array(src_ids), beam_size=4, max_len=L, bos=bos, eos=eos)
+        for i in range(n):
+            print(f"src: {pairs[i][0]!r} -> "
+                  f"{bpe.decode(list(seqs[i]))!r} ({scores[i]:.2f})")
 
 
 if __name__ == "__main__":

@@ -143,11 +143,99 @@ class TransformerModel(HybridBlock):
         for _ in range(max_len - 1):
             logits = self(src, _nd.array(out, dtype="int32"),
                           src_valid_len)
-            nxt = logits.asnumpy()[:, -1].argmax(-1).astype(np.int32)
+            # slice device-side: only the last step crosses to host
+            nxt = logits[:, -1].asnumpy().argmax(-1).astype(np.int32)
             out = np.concatenate([out, nxt[:, None]], axis=1)
             if (nxt == eos).all():
                 break
         return out
+
+
+    def beam_search_decode(self, src, beam_size=4, max_len=32, bos=1,
+                           eos=2, alpha=0.6, src_valid_len=None):
+        """Beam search with the GNMT length penalty (ref: the Sockeye
+        decode mode the Transformer-big WMT recipe ships with; host-side
+        loop over the compiled decode graph, like greedy_decode).
+
+        Returns ``(sequences, scores)``: best sequence per batch row
+        ((b, <=max_len) int32, BOS-led, truncated after EOS) and its
+        length-normalized log-prob."""
+        from ..ndarray import ndarray as _nd
+
+        src_np = np.asarray(src.asnumpy() if hasattr(src, "asnumpy")
+                            else src)
+        b = src_np.shape[0]
+        K = int(beam_size)
+        if K < 1:
+            raise ValueError(f"beam_size must be >= 1, got {K}")
+        src_k = _nd.array(np.repeat(src_np, K, axis=0))
+        svl_k = None
+        if src_valid_len is not None:
+            svl_np = np.asarray(
+                src_valid_len.asnumpy()
+                if hasattr(src_valid_len, "asnumpy") else src_valid_len)
+            svl_k = _nd.array(np.repeat(svl_np, K, axis=0))
+
+        seqs = np.full((b, K, 1), bos, np.int32)
+        # only beam 0 live at t=0 so the first expansion doesn't pick
+        # K copies of the same hypothesis
+        scores = np.full((b, K), -np.inf, np.float32)
+        scores[:, 0] = 0.0
+        finished = np.zeros((b, K), bool)
+
+        for t in range(max_len - 1):
+            logits = self(src_k,
+                          _nd.array(seqs.reshape(b * K, t + 1)), svl_k)
+            # slice device-side: only (b*K, V) crosses to host per step
+            last = logits[:, -1].asnumpy().astype(np.float32)
+            last = last - last.max(-1, keepdims=True)
+            logp = last - np.log(
+                np.exp(last).sum(-1, keepdims=True))
+            V = logp.shape[-1]
+            logp = logp.reshape(b, K, V)
+            # a finished hypothesis only continues as itself: EOS with
+            # zero added score, every other continuation impossible
+            frozen = np.full((V,), -np.inf, np.float32)
+            frozen[eos] = 0.0
+            step = np.where(finished[:, :, None], frozen[None, None, :],
+                            logp)
+            cand = scores[:, :, None] + step
+            flat = cand.reshape(b, K * V)
+            top = np.argpartition(-flat, K - 1, axis=1)[:, :K]
+            beam_idx, tok = top // V, (top % V).astype(np.int32)
+            scores = np.take_along_axis(flat, top, axis=1)
+            seqs = np.concatenate(
+                [np.take_along_axis(seqs, beam_idx[:, :, None], axis=1),
+                 tok[:, :, None]], axis=2)
+            finished = np.take_along_axis(finished, beam_idx, axis=1) \
+                | (tok == eos)
+            if finished.all():
+                break
+
+        # GNMT length penalty over GENERATED length (exclude BOS; count
+        # through EOS for finished rows)
+        gen_len = np.full((b, K), seqs.shape[2] - 1, np.float32)
+        for bi in range(b):
+            for ki in range(K):
+                hit = np.where(seqs[bi, ki, 1:] == eos)[0]
+                if hit.size:
+                    gen_len[bi, ki] = float(hit[0] + 1)
+        lp = ((5.0 + gen_len) / 6.0) ** alpha
+        norm = scores / lp
+        best = norm.argmax(axis=1)
+        out_seqs, out_scores = [], []
+        for bi in range(b):
+            s = seqs[bi, best[bi]]
+            hit = np.where(s[1:] == eos)[0]
+            if hit.size:
+                s = s[:hit[0] + 2]  # keep BOS..EOS
+            out_seqs.append(s)
+            out_scores.append(float(norm[bi, best[bi]]))
+        width = max(len(s) for s in out_seqs)
+        padded = np.full((b, width), eos, np.int32)
+        for bi, s in enumerate(out_seqs):
+            padded[bi, :len(s)] = s
+        return padded, np.asarray(out_scores, np.float32)
 
 
 def transformer_big(src_vocab, tgt_vocab, **kwargs):

@@ -113,8 +113,9 @@ def test_nmt_example_with_data_path(tmp_path):
         "nmt", "train_transformer.py",
         ["--model", "tiny", "--steps", "3", "--batch-size", "8",
          "--buckets", "16,32", "--data-src", src, "--data-tgt", tgt,
-         "--bpe-merges", "80", "--disp", "2"])
+         "--bpe-merges", "80", "--disp", "2", "--translate", "2"])
     assert "shared BPE vocab" in out and "final loss" in out
+    assert "src:" in out  # beam decode ran
 
 
 def test_deepar_example_with_data_path(tmp_path):
