@@ -65,7 +65,7 @@ def main():
         from mxnet_tpu.data import timeseries as dts
 
         ds = dts.ListDataset.from_jsonl(args.data, freq=args.freq)
-        train_ds, _test_ds = dts.train_test_split(
+        train_ds, test_ds = dts.train_test_split(
             ds, args.prediction_length)
         splitter = dts.InstanceSplitter(
             args.context_length, args.prediction_length,
@@ -110,6 +110,14 @@ def main():
                 prediction_length=args.prediction_length,
                 num_samples=50, covariates=nd.array(pred["covariates"]))
             samples = samples * pred["scale"][:, None, None]  # unscale
+            # GluonTS-style backtest: weighted quantile loss against
+            # the held-out tail of each series
+            truth = np.stack(
+                [e["target"][-args.prediction_length:]
+                 for e in test_ds])
+            m = dts.quantile_loss(truth, samples)
+            print("backtest " + " ".join(
+                f"{k}={v:.4f}" for k, v in sorted(m.items())))
         else:
             ctx_series = nd.array(
                 synthetic_series(rng, 4, args.context_length))

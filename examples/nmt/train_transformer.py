@@ -52,8 +52,10 @@ class Seq2SeqTrainNet(HybridBlock):
         super().__init__(**kwargs)
         self.model = model
 
-    def hybrid_forward(self, F, src, tgt_in):
-        return self.model(src, tgt_in)
+    def hybrid_forward(self, F, src, tgt_in, src_valid_len=None):
+        # masking the encoder's PAD tail in training keeps train-time
+        # and beam-decode-time encodings consistent
+        return self.model(src, tgt_in, src_valid_len)
 
 
 def synthetic_pairs(rng, bs, src_len, vocab):
@@ -139,13 +141,15 @@ def main():
                 b = data_iter.next()
             src, tgt_in = b.data
             tgt_out = b.label[0]
+            svl = b.src_valid_length
             L = b.bucket_key
         else:
             L = buckets[rng.randint(len(buckets))]  # bucketed lengths
             src, tgt_in, tgt_out = synthetic_pairs(
                 rng, args.batch_size, L,
                 min(args.src_vocab, args.tgt_vocab))
-        loss = trainer.step((src, tgt_in), tgt_out)
+            svl = np.full((args.batch_size,), L, np.int32)
+        loss = trainer.step((src, tgt_in, svl), tgt_out)
         tic_n += args.batch_size * L
         if step % args.disp == 0 and step:
             loss.wait_to_read()
@@ -164,13 +168,19 @@ def main():
         n = min(args.translate, len(pairs))
         L = buckets[-1]
         src_ids = np.zeros((n, L), np.int32)
+        src_len = np.zeros((n,), np.int32)
         for i, (s, _) in enumerate(pairs[:n]):
             ids = bpe.encode(s, eos=True)[:L]
             src_ids[i, :len(ids)] = ids
+            src_len[i] = len(ids)
         from mxnet_tpu import nd
 
+        # src_valid_len masks the PAD tail exactly as in training, so
+        # bucket-16-trained sentences decode identically when padded
+        # to the widest bucket here
         seqs, scores = net.model.beam_search_decode(
-            nd.array(src_ids), beam_size=4, max_len=L, bos=bos, eos=eos)
+            nd.array(src_ids), beam_size=4, max_len=L, bos=bos, eos=eos,
+            src_valid_len=nd.array(src_len))
         for i in range(n):
             print(f"src: {pairs[i][0]!r} -> "
                   f"{bpe.decode(list(seqs[i]))!r} ({scores[i]:.2f})")

@@ -162,6 +162,31 @@ def train_test_split(dataset, prediction_length):
     return ListDataset(train_entries, dataset.freq), dataset
 
 
+def quantile_loss(target, forecast_samples, quantiles=(0.1, 0.5, 0.9)):
+    """GluonTS Evaluator role: weighted quantile loss per quantile plus
+    the mean.  ``target``: (n, P) held-out future; ``forecast_samples``:
+    (n, num_samples, P) from ``DeepARNetwork.predict``.  Returns a dict
+    {'wQL[q]': float, ..., 'mean_wQL': float}."""
+    target = np.asarray(target, np.float32)
+    samples = np.asarray(forecast_samples, np.float32)
+    if samples.ndim != 3 or target.ndim != 2 or \
+            samples.shape[0] != target.shape[0] or \
+            samples.shape[2] != target.shape[1]:
+        raise MXNetError(
+            f"quantile_loss: samples must be (n, num_samples, P) "
+            f"aligned with target (n, P); got {samples.shape} vs "
+            f"{target.shape}")
+    denom = np.abs(target).sum()
+    out = {}
+    for q in quantiles:
+        pred = np.quantile(samples, q, axis=1)
+        diff = target - pred
+        ql = 2.0 * np.sum(np.maximum(q * diff, (q - 1.0) * diff))
+        out[f"wQL[{q}]"] = float(ql / max(denom, 1e-10))
+    out["mean_wQL"] = float(np.mean(list(out.values())))
+    return out
+
+
 def synthetic_dataset(rng, n_series=16, length=200, freq="H"):
     """Seasonal+level synthetic series in GluonTS entry form."""
     entries = []

@@ -452,6 +452,24 @@ def test_instance_splitter_contract():
         dts.InstanceSplitter(200, 24).training_instances(ds, 2)
 
 
+def test_quantile_loss_metric():
+    """GluonTS Evaluator role: the wQL metric is exact on a known
+    forecast and rejects misaligned shapes."""
+    rng = np.random.RandomState(0)
+    target = rng.rand(4, 6).astype(np.float32) + 1.0
+    # perfect point forecast at every quantile -> zero loss
+    perfect = np.repeat(target[:, None, :], 50, axis=1)
+    m = dts.quantile_loss(target, perfect)
+    assert m["mean_wQL"] < 1e-6, m
+    # biased forecast must be worse than an unbiased noisy one
+    noisy = perfect + rng.randn(4, 50, 6).astype(np.float32) * 0.05
+    biased = perfect + 0.5
+    assert dts.quantile_loss(target, noisy)["mean_wQL"] < \
+        dts.quantile_loss(target, biased)["mean_wQL"]
+    with pytest.raises(mx.MXNetError):
+        dts.quantile_loss(target, perfect[:, :, :3])
+
+
 def test_deepar_trains_on_pipeline_features():
     """InstanceSplitter windows + covariates drive DeepAR's NLL down —
     the GluonTS estimator contract."""

@@ -140,3 +140,4 @@ def test_deepar_example_with_data_path(tmp_path):
          "--predict"])
     assert "6 series" in out and "final nll" in out
     assert "forecast p50" in out  # covariate-aware sampling path
+    assert "backtest" in out and "wQL" in out  # GluonTS-style eval
