@@ -65,9 +65,22 @@ class BERTModel(HybridBlock):
 
     def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
                  num_layers=12, num_heads=12, max_length=512,
-                 type_vocab_size=2, dropout=0.1, **kwargs):
+                 type_vocab_size=2, dropout=0.1, use_pooler=True,
+                 use_decoder=True, use_classifier=True, **kwargs):
+        # use_pooler/use_decoder/use_classifier follow gluonnlp's
+        # BERTModel: fine-tuning builds the backbone WITHOUT the MLM
+        # decoder / NSP classifier heads (their params would otherwise
+        # sit deferred-uninitialized in the block tree)
         super().__init__(**kwargs)
+        if use_classifier and not use_pooler:
+            raise ValueError(
+                "use_classifier=True requires use_pooler=True (the NSP "
+                "head reads the pooled [CLS]); gluonnlp enforces the "
+                "same combination")
         self._units = units
+        self._use_pooler = use_pooler
+        self._use_decoder = use_decoder
+        self._use_classifier = use_classifier
         self.word_embed = nn.Embedding(vocab_size, units)
         self.token_type_embed = nn.Embedding(type_vocab_size, units)
         self.position_embed = nn.Embedding(max_length, units)
@@ -75,14 +88,24 @@ class BERTModel(HybridBlock):
         self.embed_dropout = nn.Dropout(dropout)
         self.encoder = BERTEncoder(num_layers, units, hidden_size,
                                    num_heads, dropout)
-        self.pooler = nn.Dense(units, flatten=False, activation="tanh")
-        # MLM head (decoder shares transform; tied embedding optional)
-        self.mlm_transform = nn.Dense(units, flatten=False)
-        self.mlm_ln = nn.LayerNorm(in_channels=units)
-        self.mlm_decoder = nn.Dense(vocab_size, flatten=False)
-        self.nsp_classifier = nn.Dense(2, flatten=False)
+        if use_pooler:
+            self.pooler = nn.Dense(units, flatten=False,
+                                   activation="tanh")
+        if use_decoder:
+            # MLM head (decoder shares transform; tied embedding
+            # optional)
+            self.mlm_transform = nn.Dense(units, flatten=False)
+            self.mlm_ln = nn.LayerNorm(in_channels=units)
+            self.mlm_decoder = nn.Dense(vocab_size, flatten=False)
+        if use_classifier:
+            self.nsp_classifier = nn.Dense(2, flatten=False)
 
-    def hybrid_forward(self, F, inputs, token_types, valid_length=None):
+    def _encode_sequence(self, inputs, token_types, valid_length=None):
+        """Embeddings + attention-masked encoder stack — shared by the
+        pretraining heads and fine-tune classifiers (ref: gluonnlp
+        BERTModel's encode path reused by BERTClassifier)."""
+        from .. import ndarray as F
+
         seq_len = inputs.shape[1]
         positions = F.arange(0, seq_len, dtype="int32")
         x = self.word_embed(inputs) + self.token_type_embed(token_types)
@@ -94,14 +117,34 @@ class BERTModel(HybridBlock):
             m = F.broadcast_lesser(
                 steps.reshape(1, -1), valid_length.reshape(-1, 1))
             mask = (m.reshape(m.shape[0], 1, 1, seq_len) - 1.0) * 1e9
-        seq = self.encoder(x, mask)
-        pooled = self.pooler(seq.slice_axis(1, 0, 1).reshape(
+        return self.encoder(x, mask)
+
+    def pool(self, seq):
+        """[CLS] representation through the tanh pooler."""
+        return self.pooler(seq.slice_axis(1, 0, 1).reshape(
             seq.shape[0], self._units))
+
+    def hybrid_forward(self, F, inputs, token_types, valid_length=None):
+        """Full heads: (mlm_scores, nsp_scores) — the pretraining
+        contract.  With use_decoder=False/use_classifier=False
+        (fine-tuning backbones) returns (sequence, pooled) or just the
+        sequence, matching gluonnlp's output arity rules."""
+        seq = self._encode_sequence(inputs, token_types, valid_length)
+        if not (self._use_decoder or self._use_classifier):
+            if not self._use_pooler:
+                return seq
+            return seq, self.pool(seq)
         mlm = self.mlm_decoder(
             self.mlm_ln(F.LeakyReLU(self.mlm_transform(seq),
-                                    act_type="gelu")))
-        nsp = self.nsp_classifier(pooled)
-        return mlm, nsp
+                                    act_type="gelu"))) \
+            if self._use_decoder else None
+        # pool only when the NSP head consumes it (an MLM-only model
+        # must not pay for a discarded pooler forward)
+        nsp = self.nsp_classifier(self.pool(seq)) \
+            if self._use_classifier else None
+        if mlm is not None and nsp is not None:
+            return mlm, nsp
+        return mlm if mlm is not None else nsp
 
 
 def bert_base(vocab_size=30522, **kwargs):
