@@ -174,11 +174,26 @@ def main():
     net = BERTClassifier(backbone, num_classes=args.num_classes)
     net.initialize(mx.init.TruncNorm(stdev=0.02))
     if args.params:
-        # warm start: load backbone weights, keep the fresh classifier
+        # warm start: load backbone weights (the checkpoint's MLM/NSP
+        # head params are ignored), keep the fresh classifier
         net.backbone.load_parameters(args.params,
                                      allow_missing=True,
                                      ignore_extra=True)
-        print(f"warm-started backbone from {args.params}")
+        # verify tensors actually landed — allow_missing would let a
+        # renamed checkpoint load as a silent no-op
+        loaded = {k: v for k, v in nd.load(args.params).items()}
+        own = net.backbone._collect_params_with_prefix()
+        matched = sum(
+            1 for k, v in loaded.items()
+            if k in own and v.shape == own[k].data().shape
+            and np.allclose(own[k].data().asnumpy(), v.asnumpy()))
+        if matched == 0:
+            raise SystemExit(
+                f"{args.params}: no checkpoint tensor matched the "
+                "backbone (renamed layers?); refusing a silent "
+                "cold start")
+        print(f"warm-started backbone from {args.params} "
+              f"({matched} tensors)")
 
     from mxnet_tpu.parallel import data_parallel
 

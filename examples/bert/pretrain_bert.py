@@ -84,6 +84,12 @@ def main():
                         "default trains on synthetic batches")
     p.add_argument("--wordpiece-vocab", type=int, default=8000,
                    help="WordPiece vocab size learned from --data")
+    p.add_argument("--save-params", default=None,
+                   help="save the full pretrain checkpoint here "
+                        "(backbone + MLM/NSP head params, "
+                        "save_parameters format; finetune_classifier "
+                        "--params warm-starts the backbone from it and "
+                        "ignores the heads)")
     args = p.parse_args()
     apply_backend(args)
     if args.model == "tiny":
@@ -152,6 +158,11 @@ def main():
             tic, tic_n = time.time(), 0
     loss.wait_to_read()
     print(f"done: final loss {float(loss.asscalar()):.4f}")
+
+    if args.save_params:
+        trainer.sync_to_block()
+        net.model.save_parameters(args.save_params)
+        print(f"saved pretrain checkpoint to {args.save_params}")
 
 
 if __name__ == "__main__":

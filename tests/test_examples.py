@@ -95,6 +95,27 @@ def test_bert_finetune_classifier_learns():
     assert acc >= 0.9, out[-500:]
 
 
+def test_bert_pretrain_then_finetune_warm_start(tmp_path):
+    """The full reference-era BERT story: pretrain -> save backbone ->
+    fine-tune --params warm-starts it (head-gated backbone loads the
+    full-head checkpoint with the MLM/NSP params ignored)."""
+    ckpt = str(tmp_path / "backbone.params")
+    out = _run_example(
+        "bert", "pretrain_bert.py",
+        ["--model", "tiny", "--steps", "3", "--batch-size", "8",
+         "--seq-len", "32", "--save-params", ckpt, "--disp", "2"])
+    assert "saved pretrain checkpoint" in out
+    assert os.path.exists(ckpt)
+    out = _run_example(
+        "bert", "finetune_classifier.py",
+        ["--model", "tiny", "--steps", "3", "--batch-size", "8",
+         "--seq-len", "32", "--params", ckpt, "--disp", "2"])
+    assert "warm-started backbone" in out and "accuracy" in out
+    # the example verifies tensors numerically; require a real count
+    n = int(out.rsplit("(", 1)[1].split()[0])
+    assert n > 5, out[-400:]
+
+
 def test_bert_finetune_classifier_with_tsv(tmp_path):
     """--data TSV path: sentence pairs + labels through the WordPiece
     vocab builder (download-and-run for real GLUE-style files)."""
