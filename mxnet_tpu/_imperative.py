@@ -41,7 +41,9 @@ def _attrs_key(kwargs):
 
 
 def get_jitted(fn, kwargs):
-    key = (fn, _attrs_key(kwargs))
+    # hot path: attr-less ops (all elementwise arithmetic) skip the
+    # sort entirely
+    key = (fn, ()) if not kwargs else (fn, _attrs_key(kwargs))
     jitted = _jit_cache.get(key)
     if jitted is None:
         if kwargs:
@@ -68,10 +70,26 @@ def get_vjp(fn, kwargs):
     return applier
 
 
+# The eager hot path runs these lookups on EVERY op call; repeated
+# `from . import` statements cost ~4-5us/op in importlib machinery
+# (profiled), a large slice of the ~15us dispatch budget the reference
+# amortizes with its engine.  Resolved lazily ONCE (circular imports
+# forbid resolving at module load).
+_lazy = None
+
+
+def _resolve_lazy():
+    global _lazy
+    from . import autograd, profiler
+    from .ndarray.ndarray import NDArray, _wrap
+
+    _lazy = (autograd, profiler, NDArray, _wrap)
+    return _lazy
+
+
 def _raw(x):
     """Unwrap NDArray / accept numpy & python scalars."""
-    from .ndarray.ndarray import NDArray
-
+    NDArray = (_lazy or _resolve_lazy())[2]
     if isinstance(x, NDArray):
         return x._data
     return x
@@ -83,11 +101,9 @@ def invoke(fn, *args, jit_compile=True, nondiff=False, **kwargs):
     The async boundary of ref §3.1 is implicit: the returned NDArray wraps
     a not-yet-computed buffer (PjRt future).
     """
-    from . import autograd
-    from .ndarray.ndarray import NDArray, _wrap
+    autograd, profiler, NDArray, _wrap = _lazy or _resolve_lazy()
 
-    raws = [_raw(a) for a in args]
-    from . import profiler
+    raws = [x._data if isinstance(x, NDArray) else x for x in args]
 
     if profiler.is_running():
         import time as _time
