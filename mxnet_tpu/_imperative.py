@@ -18,6 +18,7 @@ The autograd tape hook lives here (ref: Imperative::RecordOp).
 from __future__ import annotations
 
 import functools
+import time as _time
 
 import jax
 import numpy as np
@@ -87,14 +88,6 @@ def _resolve_lazy():
     return _lazy
 
 
-def _raw(x):
-    """Unwrap NDArray / accept numpy & python scalars."""
-    NDArray = (_lazy or _resolve_lazy())[2]
-    if isinstance(x, NDArray):
-        return x._data
-    return x
-
-
 def invoke(fn, *args, jit_compile=True, nondiff=False, **kwargs):
     """Invoke a registered op on NDArrays; returns NDArray or tuple.
 
@@ -106,8 +99,6 @@ def invoke(fn, *args, jit_compile=True, nondiff=False, **kwargs):
     raws = [x._data if isinstance(x, NDArray) else x for x in args]
 
     if profiler.is_running():
-        import time as _time
-
         t0 = _time.perf_counter() * 1e6
         if jit_compile:
             out = get_jitted(fn, kwargs)(*raws)
@@ -115,8 +106,11 @@ def invoke(fn, *args, jit_compile=True, nondiff=False, **kwargs):
             out = fn(*raws, **kwargs)
         if profiler._config.get("sync"):
             jax.block_until_ready(out)
-        profiler.record_op(getattr(fn, "__name__", "op").lstrip("_k_"),
-                           t0, _time.perf_counter() * 1e6)
+        # removeprefix, NOT lstrip: lstrip("_k_") strips a CHARACTER
+        # SET and would eat the real leading 'k' of e.g. _k_khatri_rao
+        profiler.record_op(
+            getattr(fn, "__name__", "op").removeprefix("_k_"),
+            t0, _time.perf_counter() * 1e6)
     elif jit_compile:
         try:
             out = get_jitted(fn, kwargs)(*raws)
