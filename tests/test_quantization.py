@@ -259,6 +259,25 @@ def test_quantize_net_gluon():
     assert rel < 0.08, rel
 
 
+def test_quantize_net_conv_no_bias():
+    """Eager int8 conv WITHOUT a bias (the resnet conv->BN pattern):
+    the explicit-None bias slot must parse (same arity rule as the
+    symbolic path's regression above)."""
+    from mxnet_tpu.gluon import nn
+
+    rs = np.random.RandomState(11)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, kernel_size=3, use_bias=False))
+    net.add(nn.Conv2D(4, kernel_size=1, use_bias=True))
+    net.initialize(mx.init.Xavier())
+    x = rs.rand(2, 3, 12, 12).astype(np.float32)
+    ref = net(nd.array(x)).asnumpy()
+    qnet = qz.quantize_net(net, calib_data=x, calib_mode="naive")
+    got = qnet(nd.array(x)).asnumpy()
+    rel = np.abs(got - ref).max() / max(np.abs(ref).max(), 1e-6)
+    assert rel < 0.08, rel
+
+
 def test_quantize_net_hybridized_drops_stale_cache():
     from mxnet_tpu.gluon import nn
 
