@@ -59,6 +59,26 @@ def _probe():
     feats["BF16"] = True
     feats["INT8_QUANTIZATION"] = True
     feats["DIST_KVSTORE"] = True
+    # r4 surface: workload data pipelines and the trainable C ABI tier
+    try:
+        from . import data  # noqa: F401
+
+        feats["DATA_PIPELINES"] = True
+    except Exception:
+        feats["DATA_PIPELINES"] = False
+    # probe an actual trainable-tier symbol: a stale pre-r4 .so exists
+    # but lacks it, and existence alone would misreport trainability
+    feats["CAPI_TRAINABLE"] = False
+    if feats["CAPI"]:
+        try:
+            import ctypes
+
+            lib = ctypes.CDLL(os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(
+                    __file__))), "lib", "libmxtpu_capi.so"))
+            feats["CAPI_TRAINABLE"] = hasattr(lib, "MXTPUCreateCachedOp")
+        except Exception:
+            pass
     return feats
 
 
