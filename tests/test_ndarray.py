@@ -136,6 +136,31 @@ def test_wait_async():
     assert np.isclose(y.asnumpy()[0, 0], 100)
 
 
+def test_save_load_dtype_round_trip(tmp_path):
+    """Every supported dtype survives the .params container exactly —
+    including bfloat16, the TPU-native compute/checkpoint dtype the
+    reference never had (ref: NDArray::Save/Load dtype preservation)."""
+    rng = np.random.RandomState(0)
+    arrs = {
+        "f32": nd.array(rng.rand(3, 2).astype(np.float32)),
+        "f16": nd.array(rng.rand(4).astype(np.float16)),
+        "bf16": nd.ones((2, 2), dtype="bfloat16") * 1.5,
+        "u8": nd.array(np.arange(5, dtype=np.uint8)),
+        "i8": nd.array(np.arange(-3, 3, dtype=np.int8)),
+        "i32": nd.array(np.arange(4, dtype=np.int32)),
+    }
+    path = str(tmp_path / "dtypes.params")
+    nd.save(path, arrs)
+    back = nd.load(path)
+    assert set(back) == set(arrs)
+    for k, orig in arrs.items():
+        got = back[k]
+        assert str(got.dtype) == str(orig.dtype), (k, got.dtype)
+        assert got.shape == orig.shape
+        assert np.array_equal(got.asnumpy().astype(np.float64),
+                              orig.asnumpy().astype(np.float64)), k
+
+
 def test_save_load_list_dict(tmp_path):
     f = str(tmp_path / "t.params")
     a, b = nd.ones((2, 2)), nd.arange(0, 4)
