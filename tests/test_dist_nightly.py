@@ -31,10 +31,15 @@ def _launch(script, n, num_servers=0, timeout=240, env_extra=None,
 
 
 @pytest.mark.slow
-def test_dist_sync_kvstore_two_workers():
-    out = _launch("dist_sync_kvstore.py", 2)
-    assert "worker 0/2: dist_sync kvstore OK" in out
-    assert "worker 1/2: dist_sync kvstore OK" in out
+@pytest.mark.parametrize("n,timeout", [(2, 240), (4, 360), (8, 600)])
+def test_dist_sync_kvstore_n_workers(n, timeout):
+    """In-graph DCN all-reduce at 2 (the reference nightly's base), 4
+    (VERDICT r2 #5: scale past 2) and 8 workers (a v5p-16 host-group's
+    process count — the largest local-launcher shape this box
+    carries)."""
+    out = _launch("dist_sync_kvstore.py", n, timeout=timeout)
+    for r in range(n):
+        assert f"worker {r}/{n}: dist_sync kvstore OK" in out
 
 
 @pytest.mark.slow
@@ -48,13 +53,6 @@ def test_dist_async_kvstore_two_workers(tmp_path, num_servers):
         assert f"worker {r}/2: dist_async kvstore OK" in out
 
 
-@pytest.mark.slow
-def test_dist_sync_kvstore_four_workers():
-    """The reference nightly ran -n 4 (VERDICT r2 #5: scale past 2);
-    also the >=3-process exercise of the in-graph DCN collective."""
-    out = _launch("dist_sync_kvstore.py", 4, timeout=360)
-    for r in range(4):
-        assert f"worker {r}/4: dist_sync kvstore OK" in out
 
 
 @pytest.mark.slow
