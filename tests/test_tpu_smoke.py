@@ -77,6 +77,45 @@ def test_pallas_lstm_compiles(dt):
                      .astype(jnp.float32).sum())).lower(xp).compile()
 
 
+def test_cpu_oracle_consistency_on_chip():
+    """The reference's single most important test idea (SURVEY §4:
+    check_consistency CPU-vs-GPU) on real hardware: the same ops on
+    XLA:CPU and the TPU must agree within dtype tolerance.  Covers the
+    op families the five workloads lean on."""
+    import numpy as np
+
+    from mxnet_tpu import nd
+    from mxnet_tpu.test_utils import check_consistency
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(4, 8, 14, 14).astype(np.float32)
+    w = (rng.rand(16, 8, 3, 3).astype(np.float32) - 0.5) * 0.2
+    m = rng.rand(32, 64).astype(np.float32)
+    n = rng.rand(64, 48).astype(np.float32)
+    # MXU-backed contractions at DEFAULT precision round f32 operands
+    # to bf16 passes (eps ~8e-3) — the tolerance users actually get
+    check_consistency(
+        lambda a, b: nd.Convolution(a, b, kernel=(3, 3), num_filter=16,
+                                    no_bias=True),
+        [x, w], rtol=2e-2, atol=2e-2)
+    check_consistency(lambda a, b: nd.dot(a, b), [m, n],
+                      rtol=2e-2, atol=2e-2)
+    # with highest precision forced, the oracle must match tightly
+    with jax.default_matmul_precision("highest"):
+        check_consistency(
+            lambda a, b: nd.Convolution(a, b, kernel=(3, 3),
+                                        num_filter=16, no_bias=True),
+            [x, w], rtol=1e-3, atol=1e-4)
+        check_consistency(lambda a, b: nd.dot(a, b), [m, n],
+                          rtol=1e-3, atol=1e-4)
+    # VPU paths (no MXU contraction): tight at default precision
+    s = rng.rand(4, 128).astype(np.float32)
+    check_consistency(lambda a: nd.softmax(a), [s])
+    check_consistency(lambda a: nd.LayerNorm(
+        a, nd.ones((128,), ctx=a.context),
+        nd.zeros((128,), ctx=a.context)), [s], rtol=1e-3, atol=1e-3)
+
+
 def test_probe_gates_report_on_chip():
     """The family gates themselves: on a healthy chip every probe
     should come back True (a False here IS the signal the kernels
