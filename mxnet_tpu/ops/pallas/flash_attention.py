@@ -29,7 +29,11 @@ _NEG_INF = -1e9
 
 def _flash_fwd_kernel(*refs, block_k, causal, scale, seq_k, has_mask):
     # refs carry a leading block dim of 1: (1, block_q, d) / (1, seq_k, d);
-    # with has_mask an additive key-padding row (1, seq_k) rides along
+    # with has_mask an additive key-padding row (1, 1, seq_k) rides along.
+    # lse rides as (1, block_q, 1): Mosaic's tiling rule wants the minor
+    # block dim equal to the array dim (here 1) or 128-divisible, and the
+    # sublane dim 8-divisible (block_q is) — a flat (1, block_q) row
+    # block violates it (sublane dim 1 vs array dim b*h).
     if has_mask:
         q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref = refs
     else:
@@ -52,7 +56,7 @@ def _flash_fwd_kernel(*refs, block_k, causal, scale, seq_k, has_mask):
         v = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         if km_ref is not None:
-            s = s + km_ref[0, pl.ds(kb * block_k, block_k)][None, :]
+            s = s + km_ref[0, 0, pl.ds(kb * block_k, block_k)][None, :]
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -77,13 +81,13 @@ def _flash_fwd_kernel(*refs, block_k, causal, scale, seq_k, has_mask):
         m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l))[:, 0]
+    lse_ref[0] = m + jnp.log(l)
 
 
 def _km_spec(h, sk):
     """BlockSpec mapping the flattened (b*h) grid dim onto the original
-    (b, sk) mask — no h-fold HBM copy of the mask is ever made."""
-    return pl.BlockSpec((1, sk), lambda i, j: (i // h, 0),
+    (b, 1, sk) mask — no h-fold HBM copy of the mask is ever made."""
+    return pl.BlockSpec((1, 1, sk), lambda i, j: (i // h, 0, 0),
                         memory_space=pltpu.VMEM)
 
 
@@ -108,7 +112,7 @@ def _flash_forward(q, k, v, *, causal, scale, kmask=None,
     args = [q3, k3, v3]
     if kmask is not None:
         in_specs.append(_km_spec(h, sk))
-        args.append(kmask.astype(jnp.float32))
+        args.append(kmask.astype(jnp.float32).reshape(b, 1, sk))
 
     grid = (bh, sq // block_q)
     out, lse = pl.pallas_call(
@@ -117,14 +121,14 @@ def _flash_forward(q, k, v, *, causal, scale, kmask=None,
                           has_mask=kmask is not None),
         out_shape=(
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
         ),
         grid=grid,
         in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
         ),
     )(*args)
@@ -144,8 +148,8 @@ def _flash_dq_kernel(*refs, block_k, causal, scale, seq_k, has_mask):
 
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]
-    delta = delta_ref[0][:, None]
+    lse = lse_ref[0]          # (block_q, 1)
+    delta = delta_ref[0]      # (block_q, 1)
     dq0 = jnp.zeros((block_q, d), jnp.float32)
     num_kb = seq_k // block_k
 
@@ -154,7 +158,7 @@ def _flash_dq_kernel(*refs, block_k, causal, scale, seq_k, has_mask):
         v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         s = scale * jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         if km_ref is not None:
-            s = s + km_ref[0, pl.ds(kb * block_k, block_k)][None, :]
+            s = s + km_ref[0, 0, pl.ds(kb * block_k, block_k)][None, :]
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -190,7 +194,7 @@ def _flash_dkv_kernel(*refs, block_q, causal, scale, seq_q, has_mask):
     k = k_ref[0].astype(jnp.float32)
     v = v_ref[0].astype(jnp.float32)
     # this k-block's additive mask column: constant across q-blocks
-    km_col = (km_ref[0, pl.ds(ki * block_k, block_k)][None, :]
+    km_col = (km_ref[0, 0, pl.ds(ki * block_k, block_k)][None, :]
               if km_ref is not None else None)
     dk0 = jnp.zeros((block_k, d), jnp.float32)
     dv0 = jnp.zeros((block_k, d), jnp.float32)
@@ -200,8 +204,8 @@ def _flash_dkv_kernel(*refs, block_q, causal, scale, seq_q, has_mask):
         dk, dv = carry
         q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qb * block_q, block_q)][:, None]
-        delta = delta_ref[0, pl.ds(qb * block_q, block_q)][:, None]
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q), :]
+        delta = delta_ref[0, pl.ds(qb * block_q, block_q), :]
         s = scale * jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         if km_col is not None:
             s = s + km_col
@@ -236,14 +240,15 @@ def _flash_backward(q, k, v, o, lse, do, *, causal, scale, kmask=None,
     q3, k3, v3 = (t.reshape(bh, -1, d) for t in (q, k, v))
     o3 = o.reshape(bh, sq, d)
     do3 = do.reshape(bh, sq, d)
-    # delta = rowsum(dO * O): one fused XLA elementwise+reduce
+    # delta = rowsum(dO * O): one fused XLA elementwise+reduce, carried
+    # as (bh, sq, 1) so its blocks satisfy Mosaic's minor-dim tiling rule
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
-                    axis=-1)
+                    axis=-1, keepdims=True)
 
     full_q = lambda i, j: (i, 0, 0)  # noqa: E731
-    full_r = lambda i, j: (i, 0)     # noqa: E731
     has_mask = kmask is not None
-    km3 = kmask.astype(jnp.float32) if has_mask else None
+    km3 = (kmask.astype(jnp.float32).reshape(b, 1, sk)
+           if has_mask else None)
     km_spec = _km_spec(h, sk)
 
     dq_specs = [
@@ -253,9 +258,9 @@ def _flash_backward(q, k, v, o, lse, do, *, causal, scale, kmask=None,
         pl.BlockSpec((1, sk, d), full_q, memory_space=pltpu.VMEM),
         pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, block_q), lambda i, j: (i, j),
+        pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, block_q), lambda i, j: (i, j),
+        pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0),
                      memory_space=pltpu.VMEM),
     ]
     dq_args = [q3, k3, v3, do3, lse, delta]
@@ -281,8 +286,8 @@ def _flash_backward(q, k, v, o, lse, do, *, causal, scale, kmask=None,
         pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0),
                      memory_space=pltpu.VMEM),
         pl.BlockSpec((1, sq, d), full_q, memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, sq), full_r, memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, sq), full_r, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, sq, 1), full_q, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, sq, 1), full_q, memory_space=pltpu.VMEM),
     ]
     dkv_args = [q3, k3, v3, do3, lse, delta]
     if has_mask:

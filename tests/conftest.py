@@ -15,13 +15,18 @@ if "host_platform_device_count" not in flags:
 # NOTE: this box's sitecustomize pins JAX_PLATFORMS=axon (real TPU tunnel);
 # tests must run on the virtual 8-device CPU mesh, so override via jax.config
 # (env alone is not enough — the axon plugin re-registers itself).
-os.environ["JAX_PLATFORMS"] = "cpu"
+# Exception: MXTPU_TEST_PLATFORM=tpu leaves the real backend in place so the
+# on-chip smoke list (tests/test_tpu_smoke.py) can actually reach the chip.
+_ON_TPU = os.environ.get("MXTPU_TEST_PLATFORM", "") == "tpu"
+if not _ON_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("MXTPU_TEST_SEED", "17")
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+if not _ON_TPU:
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
