@@ -88,15 +88,64 @@ def _resolve_lazy():
     return _lazy
 
 
+def _reraise_device_mismatch(e, fn, raws):
+    if "incompatible devices" not in str(e):
+        raise e
+    # ref: MXNet requires operands on ONE context and says so plainly
+    # (CheckAndAlloc ctx checks) — surface that instead of the raw jax
+    # placement error
+    devs = sorted({str(d) for r in raws
+                   if hasattr(r, "devices") for d in r.devices()})
+    raise MXNetError(
+        f"operator '{getattr(fn, '__name__', 'op')}' requires "
+        f"all inputs on one context, got {devs}; move inputs "
+        f"with as_in_context()/copyto()") from e
+
+
 def invoke(fn, *args, jit_compile=True, nondiff=False, **kwargs):
     """Invoke a registered op on NDArrays; returns NDArray or tuple.
 
     The async boundary of ref §3.1 is implicit: the returned NDArray wraps
     a not-yet-computed buffer (PjRt future).
+
+    The common case — jit on, profiler off, single output, cached
+    executable — runs a hand-inlined fast path: module-attribute flag
+    reads instead of is_running()/is_recording() calls, direct dict hits
+    instead of get_jitted, and inline wrap+track.  Profiled at ~2x the
+    raw jax dispatch floor before this; the engine's whole reason to
+    exist is hiding ~us dispatch (SURVEY §3.1), so every slice counts.
     """
     autograd, profiler, NDArray, _wrap = _lazy or _resolve_lazy()
 
     raws = [x._data if isinstance(x, NDArray) else x for x in args]
+
+    if jit_compile and not profiler._running:
+        key = (fn, ()) if not kwargs else (fn, _attrs_key(kwargs))
+        jitted = _jit_cache.get(key)
+        if jitted is not None:
+            try:
+                out = jitted(*raws)
+            except ValueError as e:
+                _reraise_device_mismatch(e, fn, raws)
+            if out.__class__ is not tuple and out.__class__ is not list:
+                engine.track(out)
+                nd = _wrap(out)
+                if (getattr(autograd._state, "recording", False)
+                        and not nondiff):
+                    in_nds = [a for a in args if isinstance(a, NDArray)]
+                    if any(a._in_graph or a._grad is not None
+                           for a in in_nds):
+                        autograd._record(fn, kwargs, args, raws, [nd],
+                                         out_is_tuple=False)
+                return nd
+            out_nds = [_wrap(engine.track(o)) for o in out]
+            if (getattr(autograd._state, "recording", False)
+                    and not nondiff):
+                in_nds = [a for a in args if isinstance(a, NDArray)]
+                if any(a._in_graph or a._grad is not None for a in in_nds):
+                    autograd._record(fn, kwargs, args, raws, out_nds,
+                                     out_is_tuple=True)
+            return tuple(out_nds)
 
     if profiler.is_running():
         t0 = _time.perf_counter() * 1e6
@@ -115,17 +164,7 @@ def invoke(fn, *args, jit_compile=True, nondiff=False, **kwargs):
         try:
             out = get_jitted(fn, kwargs)(*raws)
         except ValueError as e:
-            if "incompatible devices" not in str(e):
-                raise
-            # ref: MXNet requires operands on ONE context and says so
-            # plainly (CheckAndAlloc ctx checks) — surface that instead
-            # of the raw jax placement error
-            devs = sorted({str(d) for r in raws
-                           if hasattr(r, "devices") for d in r.devices()})
-            raise MXNetError(
-                f"operator '{getattr(fn, '__name__', 'op')}' requires "
-                f"all inputs on one context, got {devs}; move inputs "
-                f"with as_in_context()/copyto()") from e
+            _reraise_device_mismatch(e, fn, raws)
     else:
         out = fn(*raws, **kwargs)
 

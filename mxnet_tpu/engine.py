@@ -24,6 +24,19 @@ from .base import getenv
 # live arrays tracked for waitall(); weakrefs so we never extend lifetime
 _live = weakref.WeakSet()
 
+# Dispatch-hot-path tracking: WeakSet.add costs ~4us/op (guard logic in
+# _weakrefset.py), a large slice of the eager per-op budget.  The hot
+# path appends strong refs to a plain list instead (~0.1us) and
+# amortizes cleanup: once the list passes _COMPACT_AT entries, ready
+# buffers are dropped in place (is_ready() is a cheap PjRt C++ call).
+# A ready buffer is thus pinned for at most _COMPACT_AT dispatches
+# beyond its natural lifetime; pending buffers are pinned by the
+# runtime anyway.  In-place del (never rebinding) keeps concurrent
+# appends from other threads safe; _compact_mu serializes compactors.
+_live_fast = []
+_COMPACT_AT = 64
+_compact_mu = threading.Lock()
+
 # 'ThreadedEngine' (async, default) or 'NaiveEngine' (every op synchronous)
 _engine_type = getenv("ENGINE_TYPE", "ThreadedEngine")
 
@@ -45,16 +58,43 @@ def is_naive():
 
 def track(jarr):
     """Register a device buffer so waitall() can block on it."""
-    try:
-        _live.add(jarr)
-    except TypeError:
-        pass
-    if is_naive():
+    if _engine_type == "NaiveEngine":
         try:
             jarr.block_until_ready()
         except AttributeError:
             pass
+        return jarr
+    _live_fast.append(jarr)
+    if len(_live_fast) > _COMPACT_AT:
+        _compact_live()
     return jarr
+
+
+def _compact_live():
+    """Drop already-computed buffers from the fast tracking list."""
+    if not _compact_mu.acquire(blocking=False):
+        return  # another thread is compacting
+    try:
+        for idx in range(len(_live_fast) - 1, -1, -1):
+            try:
+                done = _live_fast[idx].is_ready()
+            except Exception:
+                done = True  # deleted/donated/non-array: nothing to await
+            if done:
+                del _live_fast[idx]
+    finally:
+        _compact_mu.release()
+
+
+def _block_on(arr):
+    try:
+        arr.block_until_ready()
+    except AttributeError:
+        pass
+    except RuntimeError as e:
+        msg = str(e).lower()
+        if "deleted" not in msg and "donated" not in msg:
+            raise
 
 
 def waitall():
@@ -66,14 +106,10 @@ def waitall():
     """
     if _native is not None:
         _native.wait_all()
+    while _live_fast:
+        _block_on(_live_fast.pop())
     for arr in list(_live):
-        try:
-            arr.block_until_ready()
-        except RuntimeError as e:
-            msg = str(e).lower()
-            if "deleted" in msg or "donated" in msg:
-                continue
-            raise
+        _block_on(arr)
 
 
 def wait_for_var(jarr):

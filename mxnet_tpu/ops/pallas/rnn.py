@@ -38,13 +38,17 @@ from jax.experimental.pallas import tpu as pltpu
 # forward
 # ---------------------------------------------------------------------------
 
-def _lstm_fwd_kernel(xp_ref, wh_ref, h0_ref, c0_ref,
+def _lstm_fwd_kernel(xp_ref, wht_ref, h0_ref, c0_ref,
                      ys_ref, hn_ref, cn_ref, gates_ref, cs_ref,
                      h_scr, c_scr):
-    # gate-axis layout: xp (1,N,4,H), wh (4,H,H), gates (1,N,4,H).
+    # gate-axis layout: xp (1,N,4,H), wht (4,H,H), gates (1,N,4,H).
     # The 4 gates live on their own (sublane-side) axis, so no op ever
     # slices or concatenates at a non-128 offset of the lane axis — the
     # kernel is Mosaic-tileable for ANY H (DeepAR's H=40 included).
+    # Mosaic's tpu.matmul is strictly 2-D (no batched contraction — the
+    # first chip session rejected the (N,H)x(4,H,H) dot_general), so the
+    # gate matmuls are a static 4-way unroll of clean (N,H)x(H,H) MXU
+    # dots; wht is pre-transposed on the host so each is h @ Wh[g].T.
     t = pl.program_id(0)
 
     @pl.when(t == 0)
@@ -54,16 +58,14 @@ def _lstm_fwd_kernel(xp_ref, wh_ref, h0_ref, c0_ref,
 
     h = h_scr[:]
     c = c_scr[:]
-    # (N,H) x (4,H,H) -> (N,4,H): contract h's H with wh's LAST axis
-    # (wh[g] maps h -> gate g pre-activation, i.e. h @ wh[g].T)
-    gp = xp_ref[0].astype(jnp.float32) + jax.lax.dot_general(
-        h, wh_ref[:],
-        dimension_numbers=(((1,), (2,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    i = jax.nn.sigmoid(gp[:, 0, :])
-    f = jax.nn.sigmoid(gp[:, 1, :])
-    g = jnp.tanh(gp[:, 2, :])
-    o = jax.nn.sigmoid(gp[:, 3, :])
+    xp = xp_ref[0].astype(jnp.float32)        # (N, 4, H)
+    gp = [xp[:, g, :] + jnp.dot(h, wht_ref[g].astype(jnp.float32),
+                                preferred_element_type=jnp.float32)
+          for g in range(4)]
+    i = jax.nn.sigmoid(gp[0])
+    f = jax.nn.sigmoid(gp[1])
+    g = jnp.tanh(gp[2])
+    o = jax.nn.sigmoid(gp[3])
     c_new = f * c + i * g
     h_new = o * jnp.tanh(c_new)
 
@@ -71,7 +73,8 @@ def _lstm_fwd_kernel(xp_ref, wh_ref, h0_ref, c0_ref,
     c_scr[:] = c_new
     ys_ref[0] = h_new.astype(ys_ref.dtype)
     cs_ref[0] = c_new.astype(cs_ref.dtype)
-    gates_ref[0] = jnp.stack([i, f, g, o], axis=1).astype(gates_ref.dtype)
+    for gi, v in enumerate((i, f, g, o)):
+        gates_ref[0, :, gi, :] = v.astype(gates_ref.dtype)
     hn_ref[:] = h_new.astype(hn_ref.dtype)
     cn_ref[:] = c_new.astype(cn_ref.dtype)
 
@@ -80,7 +83,8 @@ def _lstm_forward(x_proj, wh, h0, c0):
     T, N, G4 = x_proj.shape
     H = wh.shape[1]
     xp4 = x_proj.reshape(T, N, 4, H)
-    wh4 = wh.reshape(4, H, H)
+    # pre-transpose per-gate so the kernel's dots need no in-kernel .T
+    wh4 = wh.reshape(4, H, H).transpose(0, 2, 1)
     outs = pl.pallas_call(
         _lstm_fwd_kernel,
         grid=(T,),
@@ -139,40 +143,40 @@ def _lstm_bwd_kernel(dy_ref, gates_ref, cs_ref, cprev_ref, hprev_ref,
         dwh_scr[:] = jnp.zeros_like(dwh_scr)
 
     dh = dh_scr[:] + dy_ref[0].astype(jnp.float32)
-    gates = gates_ref[0]                      # (N, 4, H) post-activation
-    i = gates[:, 0, :]
-    f = gates[:, 1, :]
-    g = gates[:, 2, :]
-    o = gates[:, 3, :]
+    i = gates_ref[0, :, 0, :]                 # (N, H) post-activation
+    f = gates_ref[0, :, 1, :]
+    g = gates_ref[0, :, 2, :]
+    o = gates_ref[0, :, 3, :]
     c_t = cs_ref[0]
     c_prev = cprev_ref[0]
     tc = jnp.tanh(c_t)
 
     do = dh * tc
     dc = dh * o * (1.0 - tc * tc) + dc_scr[:]
-    di = dc * g
-    dg = dc * i
-    df = dc * c_prev
-    dgp = jnp.stack([
-        di * i * (1.0 - i),
-        df * f * (1.0 - f),
-        dg * (1.0 - g * g),
+    # pre-activation gate grads, order i,f,g,o — kept as four (N,H)
+    # arrays so every matmul below is a 2-D tpu.matmul (Mosaic has no
+    # batched contraction; see the forward kernel note)
+    dgp = (
+        (dc * g) * i * (1.0 - i),
+        (dc * c_prev) * f * (1.0 - f),
+        (dc * i) * (1.0 - g * g),
         do * o * (1.0 - o),
-    ], axis=1)                                # (N, 4, H) pre-act grads
+    )
 
-    # param grads: dWh[g] += dgp[:,g,:].T @ h_prev -> (4, H, H)
-    dwh_scr[:] += jax.lax.dot_general(
-        dgp, hprev_ref[0].astype(jnp.float32),
-        dimension_numbers=(((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    # dh_prev = sum_g dgp[:,g,:] @ wh[g] : contract (gate, lane) pairs
-    dh_scr[:] = jax.lax.dot_general(
-        dgp, wh_ref[:],
-        dimension_numbers=(((1, 2), (0, 1)), ((), ())),
-        preferred_element_type=jnp.float32)
+    hp = hprev_ref[0].astype(jnp.float32)
+    dh_new = None
+    for gi in range(4):
+        # param grads: dWh[g] += dgp_g.T @ h_prev -> (H, H)
+        dwh_scr[gi] += jnp.dot(dgp[gi].T, hp,
+                               preferred_element_type=jnp.float32)
+        # dh_prev = sum_g dgp_g @ wh[g]
+        contrib = jnp.dot(dgp[gi], wh_ref[gi].astype(jnp.float32),
+                          preferred_element_type=jnp.float32)
+        dh_new = contrib if dh_new is None else dh_new + contrib
+        dxp_ref[0, :, gi, :] = dgp[gi].astype(dxp_ref.dtype)
+    dh_scr[:] = dh_new
     dc_scr[:] = dc * f
 
-    dxp_ref[0] = dgp.astype(dxp_ref.dtype)
     dwh_ref[:] = dwh_scr[:].astype(dwh_ref.dtype)
     dh0_ref[:] = dh_scr[:].astype(dh0_ref.dtype)
     dc0_ref[:] = dc_scr[:].astype(dc0_ref.dtype)

@@ -20,6 +20,7 @@ _state = threading.local()
 _config = {
     "profile_all": False,
     "profile_imperative": True,
+    "profile_memory": False,  # per-op HBM/pool counter events
     "filename": "profile.json",
     "aggregate_stats": False,
     "xla_trace_dir": None,
@@ -29,13 +30,16 @@ _events = []
 _events_lock = threading.Lock()
 _running = False
 _xla_running = False
+# running peaks across the profiled window (ref: the reference's
+# profiler records memory-pool events per device — profiler.cc
+# DeviceStats); sampled from PjRt memory_stats + the native staging pool
+_mem_peak = {"device_bytes_in_use": 0, "pool_used_bytes": 0}
 
 
 def set_config(**kwargs):
     """Ref: mx.profiler.set_config(profile_all=True, filename=...)."""
     for k, v in kwargs.items():
-        if k in ("profile_symbolic", "profile_memory", "profile_api",
-                 "continuous_dump"):
+        if k in ("profile_symbolic", "profile_api", "continuous_dump"):
             continue  # accepted for parity
         _config[k] = v
 
@@ -71,9 +75,43 @@ def is_running():
     return _running
 
 
+def _memory_sample():
+    """Current device HBM + host staging-pool occupancy, in bytes.
+
+    Device side: PjRt per-device allocator stats (bytes_in_use /
+    peak_bytes_in_use — present on TPU, absent on some CPU builds).
+    Host side: the native storage pool's counters (src/storage.cc).
+    """
+    sample = {}
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats()
+        if stats:
+            for k in ("bytes_in_use", "peak_bytes_in_use"):
+                if k in stats:
+                    sample[f"device_{k}"] = int(stats[k])
+    except Exception:
+        pass
+    try:
+        from .storage import Storage
+
+        st = Storage.get().stats()
+        sample["pool_used_bytes"] = int(st.get("used_bytes", 0))
+        if "pool_bytes" in st:
+            sample["pool_reserved_bytes"] = int(st["pool_bytes"])
+    except Exception:
+        pass
+    for k in _mem_peak:
+        if sample.get(k, 0) > _mem_peak[k]:
+            _mem_peak[k] = sample[k]
+    return sample
+
+
 def record_op(name, begin_us, end_us, shapes=None):
     if not _running:
         return
+    mem = _memory_sample() if _config.get("profile_memory") else None
     with _events_lock:
         _events.append({
             "name": name, "ph": "X", "ts": begin_us,
@@ -82,6 +120,12 @@ def record_op(name, begin_us, end_us, shapes=None):
             "cat": "operator",
             "args": {"shapes": str(shapes)} if shapes else {},
         })
+        if mem:
+            # chrome counter track: stacked view of HBM + staging pool
+            _events.append({
+                "name": "memory", "ph": "C", "ts": end_us,
+                "pid": os.getpid(), "cat": "memory", "args": mem,
+            })
 
 
 class _OpScope:
@@ -120,6 +164,8 @@ def dumps(reset=False, format="json"):
     with _events_lock:
         data = {"traceEvents": list(_events),
                 "displayTimeUnit": "ms"}
+        if _config.get("profile_memory"):
+            data["memoryPeaks"] = dict(_mem_peak)
         if reset:
             _events.clear()
     return json.dumps(data)
@@ -135,6 +181,8 @@ def _aggregate_table(reset=False):
             _events.clear()
     stats = {}
     for ev in events:
+        if "dur" not in ev:  # counter (memory) events have no duration
+            continue
         s = stats.setdefault(ev["name"], [0, 0.0, float("inf"), 0.0])
         dur_ms = ev["dur"] / 1000.0
         s[0] += 1
@@ -148,6 +196,13 @@ def _aggregate_table(reset=False):
             stats.items(), key=lambda kv: -kv[1][1]):
         lines.append(f"{name:<40}{cnt:>12}{tot:>14.4f}"
                      f"{mn:>12.4f}{mx:>12.4f}{tot / cnt:>12.4f}")
+    if _config.get("profile_memory"):
+        # memory-pool section (ref: profiler.cc DeviceStats / the
+        # reference table's Memory: Device columns)
+        lines.append("")
+        lines.append("Memory Statistics (peak over profiled window):")
+        for key, val in _mem_peak.items():
+            lines.append(f"{key:<40}{val / 1e6:>14.3f} MB")
     return "\n".join(lines)
 
 
@@ -160,6 +215,8 @@ def dump(finished=True, profile_process="worker"):
 def reset():
     with _events_lock:
         _events.clear()
+    for k in _mem_peak:
+        _mem_peak[k] = 0
 
 
 def pause(profile_process="worker"):

@@ -29,6 +29,35 @@ def test_profiler_op_trace(tmp_path):
     profiler.reset()
 
 
+def test_profiler_memory_events():
+    """profile_memory=True records 'C' (counter) memory events with pool
+    occupancy and exposes running peaks (ref: profiler.cc DeviceStats
+    memory-pool events; VERDICT r4 #7)."""
+    from mxnet_tpu import profiler
+
+    profiler.reset()
+    profiler.set_config(profile_memory=True, aggregate_stats=True,
+                        sync=True)
+    try:
+        profiler.start()
+        nd.dot(nd.ones((16, 16)), nd.ones((16, 16))).wait_to_read()
+        profiler.stop()
+        data = json.loads(profiler.dumps())
+        mem_events = [e for e in data["traceEvents"]
+                      if e.get("cat") == "memory"]
+        assert mem_events, "no memory counter events recorded"
+        assert mem_events[0]["ph"] == "C"
+        assert "pool_used_bytes" in mem_events[0]["args"]
+        assert "memoryPeaks" in data
+        table = profiler.dumps(format="table")
+        assert "Memory Statistics" in table
+        assert "pool_used_bytes" in table
+    finally:
+        profiler.set_config(profile_memory=False, aggregate_stats=False,
+                            sync=False)
+        profiler.reset()
+
+
 def test_profiler_pause_resume():
     from mxnet_tpu import profiler
 
