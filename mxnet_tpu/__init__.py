@@ -3,7 +3,13 @@ capability surface (reference: ykim362/mxnet; see SURVEY.md).
 
 Import convention mirrors the reference: ``import mxnet_tpu as mx``.
 """
-from .base import MXNetError, __version__  # noqa: F401
+from .base import MXNetError, __version__, getenv as _getenv  # noqa: F401
+
+if _getenv("INT64_TENSOR_SIZE", False, bool):
+    # ref: USE_INT64_TENSOR_SIZE — see util.enable_large_tensor
+    from .util import enable_large_tensor as _elt
+
+    _elt(True)
 from .context import (Context, cpu, cpu_pinned, gpu, xla, num_gpus,  # noqa: F401
                       current_context)
 from . import engine  # noqa: F401

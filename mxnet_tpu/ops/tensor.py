@@ -189,12 +189,15 @@ def _k_argmax(data, *, axis=None, keepdims=False):
     out = jnp.argmax(data, axis=axis)
     if keepdims and axis is not None:
         out = jnp.expand_dims(out, axis)
-    return out.astype(jnp.float32)
+    # float32 output (MXNet parity); under the INT64_TENSOR_SIZE tier
+    # result_type(float) widens to f64, which holds indices past 2^24
+    # exactly (f32 cannot — the large-tensor suite caught this)
+    return out.astype(jnp.result_type(float))
 def _k_argmin(data, *, axis=None, keepdims=False):
     out = jnp.argmin(data, axis=axis)
     if keepdims and axis is not None:
         out = jnp.expand_dims(out, axis)
-    return out.astype(jnp.float32)
+    return out.astype(jnp.result_type(float))
 
 register("argmax", _k_argmax, nondiff=True)
 register("argmin", _k_argmin, nondiff=True)
@@ -491,7 +494,12 @@ register("slice_like", _k_slice_like, arg_names=("data", "shape_like"))
 
 def _k_take(a, indices, *, axis=0, mode="clip"):
     m = {"clip": "clip", "wrap": "wrap", "raise": "clip"}[mode]
-    return jnp.take(a, indices.astype(jnp.int32), axis=axis, mode=m)
+    if not jnp.issubdtype(indices.dtype, jnp.integer):
+        # float indices (MXNet semantics: truncate) — cast at the
+        # default int width so int64 survives the INT64_TENSOR_SIZE
+        # tier (a hard int32 cast truncated >2^31 indices)
+        indices = indices.astype(jnp.result_type(int))
+    return jnp.take(a, indices, axis=axis, mode=m)
 
 
 def _take_validator(arrays, attrs):

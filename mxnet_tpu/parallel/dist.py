@@ -14,10 +14,54 @@ one machine (SURVEY §4).
 from __future__ import annotations
 
 import os
+import threading
 
-from ..base import getenv
+from ..base import MXNetError, getenv
 
 _initialized = False
+
+
+def _bounded(fn, what):
+    """Run a blocking collective with the bounded failure detector.
+
+    Ref: ps-lite vans retry with timeouts and the Postoffice barrier
+    has PS_VAN_TIMEOUT; XLA's in-graph collectives instead HANG when a
+    peer dies mid-step (gRPC keeps the stream open for minutes).
+    MXTPU_BARRIER_TIMEOUT_S bounds that: the call runs on a watchdog
+    thread and a timeout raises a diagnosable MXNetError naming the
+    likely cause and the recovery path.  0 (default) = wait forever
+    (single-job semantics, same as the reference's default).
+    """
+    timeout = getenv("BARRIER_TIMEOUT_S", 0.0, float)
+    if not timeout:
+        return fn()
+    done = threading.Event()
+    box = {}
+
+    def _run():
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 - re-raised below
+            box["error"] = e
+        finally:
+            done.set()
+
+    th = threading.Thread(target=_run, daemon=True,
+                          name="mxtpu-collective-watchdog")
+    th.start()
+    if not done.wait(timeout):
+        import jax
+
+        raise MXNetError(
+            f"{what} did not complete within "
+            f"MXTPU_BARRIER_TIMEOUT_S={timeout:g}s "
+            f"(process {jax.process_index()}/{jax.process_count()}): a "
+            "peer process is likely dead or partitioned. Check the "
+            "other workers' logs, then restart the job from the last "
+            "checkpoint (Trainer states + parameters) to resume.")
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")
 
 
 def init(coordinator_address=None, num_processes=None, process_id=None):
@@ -121,8 +165,10 @@ def allreduce(value):
         repl = NamedSharding(mesh, PartitionSpec())
         fn = jax.jit(lambda a: a.sum(axis=0), out_shardings=repl)
         _allreduce_jit_cache[key] = fn
-    out = fn(garr)
-    return _wrap(track(jnp.asarray(out.addressable_data(0))))
+    out = _bounded(
+        lambda: jnp.asarray(fn(garr).addressable_data(0)),
+        f"dist_sync all-reduce of {gshape[1:]} {x.dtype}")
+    return _wrap(track(out))
 
 
 def barrier(name="kvstore"):
@@ -133,4 +179,5 @@ def barrier(name="kvstore"):
         return
     from jax.experimental import multihost_utils
 
-    multihost_utils.sync_global_devices(name)
+    _bounded(lambda: multihost_utils.sync_global_devices(name),
+             f"barrier({name!r})")

@@ -59,6 +59,13 @@ def _probe():
     feats["BF16"] = True
     feats["INT8_QUANTIZATION"] = True
     feats["DIST_KVSTORE"] = True
+    # ref: USE_INT64_TENSOR_SIZE build flag -> runtime toggle here
+    try:
+        from .util import large_tensor_enabled
+
+        feats["INT64_TENSOR_SIZE"] = large_tensor_enabled()
+    except Exception:
+        feats["INT64_TENSOR_SIZE"] = False
     # r4 surface: workload data pipelines and the trainable C ABI tier
     try:
         from . import data  # noqa: F401

@@ -36,6 +36,31 @@ use_np = use_np_shape
 use_np_array = use_np_shape
 
 
+def enable_large_tensor(enabled=True):
+    """Enable >2^31-element tensor support (int64 indices/accumulators).
+
+    Ref: the reference gates this behind the USE_INT64_TENSOR_SIZE
+    build flag (nightly test_large_array.py tier).  The TPU-native
+    analogue is runtime-switchable: jax's x64 mode, which widens index
+    arithmetic, argmax/argsort results, and explicit int64 arrays past
+    the 2^31 boundary.  Explicit dtypes are untouched (the front end
+    defaults float32 everywhere) and weak Python scalars still follow
+    array dtypes, so flipping this mid-process is safe; it is off by
+    default because int64 index math costs real VPU cycles on tensors
+    that never need it (the same trade the reference's build flag
+    makes).  Also settable at import via MXTPU_INT64_TENSOR_SIZE=1.
+    """
+    import jax
+
+    jax.config.update("jax_enable_x64", bool(enabled))
+
+
+def large_tensor_enabled():
+    import jax
+
+    return bool(jax.config.read("jax_enable_x64"))
+
+
 def get_gpu_count():
     from .context import num_gpus
 

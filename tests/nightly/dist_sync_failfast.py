@@ -1,0 +1,146 @@
+"""dist_sync failure semantics (VERDICT r4 #6; ref: ps-lite van
+timeouts + the reference's kill-and-restart elastic story).
+
+Worker script with two modes, driven by env:
+
+MXTPU_FAILTEST_MODE=die
+    All workers train with gluon.Trainer(kvstore='dist_sync'); the
+    worker whose rank == MXTPU_FAILTEST_DIE_RANK exits abruptly
+    mid-step (no shutdown handshake — the crashed-worker shape).
+    Survivors must surface a diagnosable MXNetError within the
+    MXTPU_BARRIER_TIMEOUT_S bound instead of hanging, then checkpoint
+    their state and exit cleanly, printing how long detection took.
+
+MXTPU_FAILTEST_MODE=resume
+    Every worker restarts from the checkpoint the killed run left
+    behind (params + Trainer optimizer states) and finishes the
+    remaining steps; final per-step losses must continue the oracle
+    trajectory and params must be identical across workers.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from mxnet_tpu.parallel import dist  # noqa: E402
+
+dist.init()
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import MXNetError, autograd, gluon, nd  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+rank, size = dist.rank(), dist.num_workers()
+MODE = os.environ["MXTPU_FAILTEST_MODE"]
+CKPT_DIR = os.environ["MXTPU_FAILTEST_CKPT"]
+DIE_RANK = int(os.environ.get("MXTPU_FAILTEST_DIE_RANK", "1"))
+DIE_STEP = int(os.environ.get("MXTPU_FAILTEST_DIE_STEP", "3"))
+STEPS = int(os.environ.get("MXTPU_FAILTEST_STEPS", "6"))
+
+GLOBAL_BATCH, FEAT, NCLS = 16, 12, 4
+PER = GLOBAL_BATCH // size
+rng = np.random.RandomState(0)
+X = rng.rand(GLOBAL_BATCH, FEAT).astype(np.float32)
+Y = rng.randint(0, NCLS, GLOBAL_BATCH).astype(np.float32)
+
+mx.random.seed(0)
+net = nn.HybridSequential()
+net.add(nn.Dense(16, activation="relu"), nn.Dense(NCLS))
+net.initialize(mx.init.Xavier())
+trainer = gluon.Trainer(net.collect_params(), "sgd",
+                        {"learning_rate": 0.1, "momentum": 0.9},
+                        kvstore="dist_sync")
+loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+shard = slice(rank * PER, rank * PER + PER)
+xw, yw = nd.array(X[shard]), nd.array(Y[shard])
+
+params_f = os.path.join(CKPT_DIR, "net.params")
+states_f = os.path.join(CKPT_DIR, "trainer.states")
+step_f = os.path.join(CKPT_DIR, "step.txt")
+
+start_step = 0
+if MODE == "resume":
+    # rejoin-from-checkpoint: every worker (including the replacement
+    # for the dead one) loads the surviving checkpoint
+    net.load_parameters(params_f)
+    trainer.load_states(states_f)
+    start_step = int(open(step_f).read())
+    assert start_step >= 1, "resume run found no checkpointed step"
+
+
+def checkpoint(step):
+    # rank-0-writes / everyone-barriers: atomic rename so a crash
+    # mid-write never leaves a torn checkpoint for the resume run
+    if rank == 0:
+        for fname, writer in ((params_f, net.save_parameters),
+                              (states_f, trainer.save_states)):
+            tmp = fname + ".tmp"
+            writer(tmp)
+            os.replace(tmp, fname)
+        tmp = step_f + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(step))
+        os.replace(tmp, step_f)
+
+
+losses = []
+for step in range(start_step, STEPS):
+    if MODE == "die" and rank == DIE_RANK and step == DIE_STEP:
+        # crash shape: no handshake, no cleanup. Exit code 0 keeps the
+        # launcher's rc aggregation meaningful for the survivors.
+        print(f"worker {rank}/{size}: dying abruptly at step {step}",
+              flush=True)
+        os._exit(0)
+    try:
+        with autograd.record():
+            loss = loss_fn(net(xw), yw).sum()
+        loss.backward()
+        t0 = time.monotonic()
+        trainer.step(GLOBAL_BATCH)
+        total = dist.allreduce(nd.array(
+            np.asarray([float(loss.asscalar())], np.float32)))
+    except MXNetError as e:
+        took = time.monotonic() - t0
+        bound = float(os.environ["MXTPU_BARRIER_TIMEOUT_S"]) + 5.0
+        assert took < bound, f"detection took {took:.1f}s > {bound}s"
+        assert "peer process is likely dead" in str(e), str(e)
+        assert "checkpoint" in str(e), str(e)
+        print(f"worker {rank}/{size}: peer failure detected in "
+              f"{took:.1f}s at step {step} OK", flush=True)
+        sys.exit(0)
+    losses.append(float(total.asnumpy()[0]) / GLOBAL_BATCH)
+    # checkpoint AFTER the optimizer step so a resume replays from the
+    # next step; barrier orders the rank-0 write against peers racing
+    # into the next step's collective
+    checkpoint(step + 1)
+    dist.barrier("ckpt")
+
+if MODE == "die":
+    # ranks that never hit a collective after the death (e.g. all
+    # steps completed before DIE_STEP) should not get here
+    raise AssertionError(
+        f"worker {rank}: no failure detected across {STEPS} steps")
+
+# resume mode: verify the continued trajectory against the oracle
+ref = np.asarray(np.load(os.environ["MXTPU_ORACLE_FILE"])["losses"])
+tail = ref[start_step:STEPS]
+assert np.allclose(losses, tail, atol=1e-5), (losses, tail.tolist())
+
+flat = np.concatenate([p.data().asnumpy().ravel()
+                       for p in net.collect_params().values()])
+peer_sum = dist.allreduce(nd.array(flat)).asnumpy()
+assert np.allclose(peer_sum, size * flat, atol=1e-6), \
+    float(np.abs(peer_sum - size * flat).max())
+
+print(f"worker {rank}/{size}: rejoined from step {start_step} and "
+      f"finished OK (loss {losses[0]:.4f}->{losses[-1]:.4f})",
+      flush=True)

@@ -1,0 +1,149 @@
+"""Offline Mosaic validation: AOT-compile every Pallas kernel family
+for a DESCRIBED TPU topology — no chip required (VERDICT r4 #2).
+
+jax.experimental.topologies hands out v5e device descriptions whose
+jit/lower/compile path runs the real Mosaic + XLA:TPU compilers
+locally (libtpu is in the image).  That converts "will Mosaic reject
+this kernel?" from an on-chip question (tests/test_tpu_smoke.py, needs
+the tunnel) into a CPU-box regression gate that runs in every suite.
+The first chip session proved the two tiers agree: the same lse-tiling
+and batched-matmul rejections this file would have caught were hit
+live on the v5 lite chip and fixed (see ops/pallas/ docstrings).
+
+Single-device mesh on purpose: Mosaic kernels cannot be automatically
+partitioned (multi-chip runs wrap them in shard_map; that composition
+is dryrun_multichip's job).
+"""
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import topologies
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    _TOPO = topologies.get_topology_desc(platform="tpu",
+                                         topology_name="v5e:2x2")
+    _SKIP = None
+except Exception as e:  # pragma: no cover - environment-dependent
+    _TOPO, _SKIP = None, str(e)
+
+pytestmark = pytest.mark.skipif(
+    _TOPO is None, reason=f"no AOT TPU topology support: {_SKIP}")
+
+
+@functools.lru_cache(None)
+def _sharding():
+    mesh = Mesh(np.array(_TOPO.devices[:1]), ("d",))
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def _aot_grad_compile(loss_fn, *specs):
+    """value-and-grad of loss_fn AOT-compiled for the v5e target."""
+    s = _sharding()
+    jitted = jax.jit(jax.grad(loss_fn), in_shardings=(s,) * len(specs),
+                     out_shardings=s)
+    jitted.lower(*specs).compile()
+
+
+@pytest.mark.parametrize("dt", [jnp.float32, jnp.bfloat16],
+                         ids=["f32", "bf16"])
+@pytest.mark.parametrize("d,causal,masked", [
+    (128, False, False), (128, True, False), (128, False, True),
+    (64, False, False), (64, True, True),
+])
+def test_flash_attention_aot(dt, d, causal, masked):
+    from mxnet_tpu.ops.pallas.flash_attention import _flash_sdpa
+
+    q = jax.ShapeDtypeStruct((1, 2, 256, d), dt)
+
+    if masked:
+        km = jnp.zeros((1, 256), jnp.float32)
+
+        def loss(a):
+            return _flash_sdpa(a, a, a, km, causal, 0.125) \
+                .astype(jnp.float32).sum()
+    else:
+        def loss(a):
+            return _flash_sdpa(a, a, a, None, causal, 0.125) \
+                .astype(jnp.float32).sum()
+    _aot_grad_compile(loss, q)
+
+
+@pytest.mark.parametrize("dt", [jnp.float32, jnp.bfloat16],
+                         ids=["f32", "bf16"])
+def test_conv_fused_aot(dt):
+    from mxnet_tpu.ops.pallas import batch_norm as pbn
+    from mxnet_tpu.ops.pallas import conv_fused as cf
+
+    x = jax.ShapeDtypeStruct((512, 256), dt)
+    w = jnp.zeros((256, 256), dt)
+    sc = jnp.zeros((1, 256), dt)
+    sh = jnp.zeros((1, 256), dt)
+    _aot_grad_compile(
+        lambda a: cf.matmul_bn_stats(a, w)[0].astype(jnp.float32).sum(),
+        x)
+    _aot_grad_compile(
+        lambda a: cf.bn_act_matmul(a, sc, sh, w)
+        .astype(jnp.float32).sum(), x)
+    _aot_grad_compile(
+        lambda a: cf.bn_act_matmul_stats(a, sc, sh, w)[0]
+        .astype(jnp.float32).sum(), x)
+    _aot_grad_compile(
+        lambda a: pbn.bn_stats(a)[0].astype(jnp.float32).sum(), x)
+
+
+@pytest.mark.parametrize("dt", [jnp.float32, jnp.bfloat16],
+                         ids=["f32", "bf16"])
+def test_pallas_lstm_aot(dt):
+    from mxnet_tpu.ops.pallas.rnn import lstm_layer
+
+    T, N, H = 4, 16, 128
+    xp = jax.ShapeDtypeStruct((T, N, 4 * H), dt)
+    wh = jnp.zeros((4 * H, H), dt)
+    h0 = jnp.zeros((N, H), dt)
+    c0 = jnp.zeros((N, H), dt)
+    _aot_grad_compile(
+        lambda a: lstm_layer(a, wh, h0, c0)[0]
+        .astype(jnp.float32).sum(), xp)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("family", ["resnet50", "bert_block"])
+def test_whole_graph_aot(family):
+    """The full flagship forward graphs also Mosaic/XLA-compile for the
+    v5e target (catches non-pallas lowering issues — layout, dtype,
+    dynamic shapes — before any chip time is spent): the hybridize-time
+    pure graph fn (CachedOp._build_fn) is AOT-jitted for the described
+    topology, exactly the computation the chip would run."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon.block import CachedOp
+
+    if family == "resnet50":
+        from mxnet_tpu.gluon.model_zoo import vision
+
+        net = vision.resnet50_v1(layout="NHWC")
+        x = nd.ones((4, 64, 64, 3))
+    else:
+        from mxnet_tpu.models.bert import BERTEncoderLayer
+
+        net = BERTEncoderLayer(units=256, hidden_size=1024, num_heads=4)
+        x = nd.ones((4, 32, 256))
+    mx.random.seed(0)
+    net.initialize(mx.init.Xavier())
+    net(x)  # eager shape-inference pass materializes deferred params
+
+    op = CachedOp(net)
+    fn = op._build_fn(False)
+    raws = [p.data()._data for _, p in net._ordered_params()]
+    key = jax.random.PRNGKey(0)
+
+    s = _sharding()
+    jitted = jax.jit(functools.partial(fn, _n_params=len(raws)),
+                     in_shardings=s, out_shardings=s)
+    jitted.lower(key, *raws, x._data).compile()

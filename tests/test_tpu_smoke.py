@@ -116,6 +116,83 @@ def test_cpu_oracle_consistency_on_chip():
         nd.zeros((128,), ctx=a.context)), [s], rtol=1e-3, atol=1e-3)
 
 
+def _make_resnet50():
+    import numpy as np
+
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet50_v1(layout="NHWC")
+    x = np.random.RandomState(0).rand(2, 32, 32, 3).astype(np.float32)
+    return net, (x,)
+
+
+def _make_bert_block():
+    import numpy as np
+
+    from mxnet_tpu.models.bert import BERTEncoderLayer
+
+    net = BERTEncoderLayer(units=128, hidden_size=512, num_heads=4)
+    x = np.random.RandomState(0).rand(2, 16, 128).astype(np.float32)
+    return net, (x,)
+
+
+def _make_transformer_layer():
+    import numpy as np
+
+    from mxnet_tpu.models.transformer import TransformerLayer
+
+    net = TransformerLayer(units=128, hidden_size=512, num_heads=4,
+                           dropout=0.0)
+    x = np.random.RandomState(0).rand(2, 16, 128).astype(np.float32)
+    return net, (x,)
+
+
+def _make_deepar_cell():
+    import numpy as np
+
+    from mxnet_tpu.gluon import rnn as grnn
+
+    net = grnn.LSTM(40, num_layers=2)
+    x = np.random.RandomState(0).rand(12, 2, 8).astype(np.float32)
+    return net, (x,)
+
+
+@pytest.mark.parametrize("family", ["resnet50", "bert_block",
+                                    "transformer_layer", "deepar_cell"])
+def test_whole_model_cpu_oracle_on_chip(family):
+    """Whole hybridized models, one per workload family, TPU vs the
+    XLA:CPU oracle (SURVEY §4 'the single most important test idea';
+    VERDICT r4 #8): the op-level tier above localizes a divergence,
+    THIS tier proves the composed models the benches time agree
+    end to end."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.context import cpu
+    from mxnet_tpu.test_utils import assert_almost_equal
+
+    net, xs = {"resnet50": _make_resnet50,
+               "bert_block": _make_bert_block,
+               "transformer_layer": _make_transformer_layer,
+               "deepar_cell": _make_deepar_cell}[family]()
+    mx.random.seed(7)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+
+    out_tpu = net(*[nd.array(x) for x in xs]).asnumpy()
+    net.collect_params().reset_ctx(cpu())
+    out_cpu = net(*[nd.array(x, ctx=cpu()) for x in xs])
+    if isinstance(out_cpu, (tuple, list)):
+        out_cpu = out_cpu[0]
+    out_cpu = out_cpu.asnumpy()
+    # MXU contractions round operands to bf16 at default precision;
+    # depth compounds it (50 layers of it for resnet), so the gate is
+    # the bf16-scale tolerance users actually get
+    assert_almost_equal(out_cpu, np.asarray(out_tpu), rtol=3e-2,
+                        atol=3e-2, names=("cpu-oracle", "tpu"))
+
+
 def test_probe_gates_report_on_chip():
     """The family gates themselves: on a healthy chip every probe
     should come back True (a False here IS the signal the kernels

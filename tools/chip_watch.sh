@@ -1,0 +1,69 @@
+#!/bin/bash
+# Tunnel watcher: probe the axon TPU tunnel; the moment it is healthy,
+# run the full on-chip battery in priority order (bench first — the
+# headline numbers four rounds of VERDICTs have demanded), logging
+# everything under bench_logs/.  Exits when the battery completes.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p bench_logs
+
+probe() {
+    timeout 90 python - <<'EOF' >/dev/null 2>&1
+import jax
+assert jax.devices()[0].platform == "tpu"
+import jax.numpy as jnp
+assert float(jnp.ones((8, 8)).sum()) == 64.0
+EOF
+}
+
+echo "$(date -u +%H:%M:%S) watcher start"
+while true; do
+    if probe; then
+        echo "$(date -u +%H:%M:%S) tunnel HEALTHY — battery begins"
+
+        echo "$(date -u +%H:%M:%S) [1/6] bench.py"
+        timeout 3600 python bench.py \
+            > bench_logs/bench_tpu.json 2> bench_logs/bench_tpu.err
+        echo "rc=$? $(tail -c 400 bench_logs/bench_tpu.json)"
+
+        echo "$(date -u +%H:%M:%S) [2/6] preflight"
+        timeout 2400 python tools/preflight.py --markdown \
+            > bench_logs/preflight.md 2> bench_logs/preflight.err
+        echo "rc=$?"
+
+        echo "$(date -u +%H:%M:%S) [3/6] tpu smoke -v"
+        MXTPU_TEST_PLATFORM=tpu timeout 2400 python -m pytest \
+            tests/test_tpu_smoke.py -v --tb=short \
+            > bench_logs/smoke.txt 2>&1
+        echo "rc=$? $(tail -1 bench_logs/smoke.txt)"
+
+        echo "$(date -u +%H:%M:%S) [4/6] workloads transformer+deepar"
+        timeout 2400 python tools/bench_workloads.py transformer \
+            > bench_logs/wl_transformer.json 2>&1
+        echo "rc=$?"
+        timeout 1800 python tools/bench_workloads.py deepar \
+            > bench_logs/wl_deepar.json 2>&1
+        echo "rc=$?"
+
+        echo "$(date -u +%H:%M:%S) [5/6] convfuse + quantized + io"
+        timeout 2400 python tools/bench_workloads.py convfuse \
+            > bench_logs/wl_convfuse.json 2>&1
+        echo "rc=$?"
+        timeout 1800 python tools/bench_workloads.py quantized \
+            > bench_logs/wl_quantized.json 2>&1
+        echo "rc=$?"
+        timeout 1800 python tools/bench_workloads.py io \
+            > bench_logs/wl_io.json 2>&1
+        echo "rc=$?"
+
+        echo "$(date -u +%H:%M:%S) [6/6] bandwidth"
+        timeout 900 python tools/bandwidth.py \
+            > bench_logs/bandwidth.json 2>&1
+        echo "rc=$?"
+
+        echo "$(date -u +%H:%M:%S) battery COMPLETE"
+        exit 0
+    fi
+    echo "$(date -u +%H:%M:%S) tunnel down; retry in 180s"
+    sleep 180
+done
