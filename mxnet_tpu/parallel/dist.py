@@ -34,7 +34,11 @@ def _bounded(fn, what):
     """
     timeout = getenv("BARRIER_TIMEOUT_S", 0.0, float)
     if not timeout:
-        return fn()
+        try:
+            return fn()
+        except Exception as e:
+            _raise_if_peer_death(e, what)
+            raise
     done = threading.Event()
     box = {}
 
@@ -50,18 +54,45 @@ def _bounded(fn, what):
                           name="mxtpu-collective-watchdog")
     th.start()
     if not done.wait(timeout):
-        import jax
-
-        raise MXNetError(
+        raise MXNetError(_peer_death_msg(
             f"{what} did not complete within "
-            f"MXTPU_BARRIER_TIMEOUT_S={timeout:g}s "
-            f"(process {jax.process_index()}/{jax.process_count()}): a "
-            "peer process is likely dead or partitioned. Check the "
-            "other workers' logs, then restart the job from the last "
-            "checkpoint (Trainer states + parameters) to resume.")
+            f"MXTPU_BARRIER_TIMEOUT_S={timeout:g}s"))
     if "error" in box:
-        raise box["error"]
+        err = box["error"]
+        if isinstance(err, Exception):
+            _raise_if_peer_death(err, what)
+        raise err
     return box.get("value")
+
+
+# transport-level shapes a dead peer produces (Gloo on CPU/DCN closes
+# the socket immediately; the coordination service notices missed
+# heartbeats) — converted to the same diagnosable error as a watchdog
+# timeout so callers have ONE failure surface
+_PEER_DEATH_SIGNATURES = (
+    "connection closed by peer", "connection reset", "broken pipe",
+    "heartbeat timeout", "coordination service", "gloo",
+    "socket closed", "peer closed",
+)
+
+
+def _peer_death_msg(prefix):
+    import jax
+
+    return (
+        f"{prefix} (process {jax.process_index()}/"
+        f"{jax.process_count()}): a peer process is likely dead or "
+        "partitioned. Check the other workers' logs, then restart the "
+        "job from the last checkpoint (Trainer states + parameters) "
+        "to resume.")
+
+
+def _raise_if_peer_death(e, what):
+    text = str(e).lower()
+    if any(sig in text for sig in _PEER_DEATH_SIGNATURES):
+        first = str(e).splitlines()[0][:200]
+        raise MXNetError(_peer_death_msg(
+            f"{what} failed with a transport error [{first}]")) from e
 
 
 def init(coordinator_address=None, num_processes=None, process_id=None):

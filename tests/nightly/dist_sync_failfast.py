@@ -116,7 +116,12 @@ for step in range(start_step, STEPS):
         assert "checkpoint" in str(e), str(e)
         print(f"worker {rank}/{size}: peer failure detected in "
               f"{took:.1f}s at step {step} OK", flush=True)
-        sys.exit(0)
+        sys.stdout.flush()
+        # fail-fast exit: skip the interpreter-shutdown distributed
+        # barrier — with a peer already dead it can only abort (the
+        # jax client terminates the process on shutdown-barrier
+        # failure); the restart-from-checkpoint run re-inits cleanly
+        os._exit(0)
     losses.append(float(total.asnumpy()[0]) / GLOBAL_BATCH)
     # checkpoint AFTER the optimizer step so a resume replays from the
     # next step; barrier orders the rank-0 write against peers racing
