@@ -297,6 +297,21 @@ def main():
                     help="emit docs/WORKLOADS.md table rows")
     args = ap.parse_args()
 
+    import jax
+
+    # honor JAX_PLATFORMS=cpu even under the axon sitecustomize (the
+    # plugin re-registers itself; env alone is not enough), and fall
+    # back to CPU when the tunnel is down rather than crashing
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        try:
+            jax.devices()
+        except RuntimeError:
+            print("# tunnel down -> CPU fallback (static tier only)",
+                  file=sys.stderr)
+            jax.config.update("jax_platforms", "cpu")
+
     rows, bad = [], []
     for name in (args.configs or list(BUILDERS)):
         rec = preflight(name)
