@@ -295,7 +295,11 @@ def _leaf_bert(platform):
     if platform == "cpu":
         bs, seq_len, iters = 4, 64, 2
     else:
-        bs, seq_len, iters = 32, 128, 20
+        # bs 64: preflight (docs/WORKLOADS.md) puts the bs-256 static
+        # tier at 2.4 GB of 16 GB — batch is nowhere near the memory
+        # wall, and MXU utilization rises with batch; 64 keeps a wide
+        # safety margin for compiled temps on the first chip session
+        bs, seq_len, iters = 64, 128, 20
 
     import numpy as np
 
