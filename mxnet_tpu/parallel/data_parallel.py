@@ -37,10 +37,20 @@ class DataParallelTrainer:
     def __init__(self, block, loss_fn, optimizer="sgd", optimizer_params=None,
                  mesh=None, shard_params=False, donate=True,
                  shard_opt_states=False, compute_dtype=None, remat=False,
-                 param_spec_fn=None):
+                 param_spec_fn=None, accum_steps=1):
         self.block = block
         self.loss_fn = loss_fn
         self.mesh = mesh if mesh is not None else mesh_mod.make_mesh()
+        # gradient accumulation (ref: grad_req='add' + Trainer.step on
+        # the accumulated batch): the global batch is split into
+        # `accum_steps` micro-batches scanned INSIDE the compiled step —
+        # activation memory scales with batch/accum_steps while the
+        # optimizer sees the exact full-batch mean gradient.  TPU-first
+        # form of the reference's python-loop accumulation: one XLA
+        # computation, no per-micro-batch dispatch.
+        self._accum = int(accum_steps)
+        if self._accum < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
         # multi-precision training (ref: MXNet fp16 + fp32 master weights,
         # optimizer_op multi_mp_sgd; TPU-first: bf16 feeds the MXU at full
         # rate, fp32 feeds it at ~1/4): master params + optimizer states
@@ -285,9 +295,52 @@ class DataParallelTrainer:
             # forward — full recompute, saves only the head residuals
             loss_fn_for_grad = jax.checkpoint(forward_loss)
 
-        def step(params, states, x, y, key, lr, t):
-            (loss, aux), grads = jax.value_and_grad(
+        accum = self._accum
+
+        def _grads_once(params, x, y, key):
+            return jax.value_and_grad(
                 loss_fn_for_grad, has_aux=True)(params, x, y, key)
+
+        def _grads_accum(params, x, y, key):
+            """Micro-batch scan: split the leading batch axis into
+            (accum, B/accum), accumulate f32 grads, average.  Equal
+            micro sizes make mean-of-means == full-batch mean, so the
+            result is bitwise the same contract as _grads_once."""
+            def split(a):
+                b = a.shape[0]
+                if b % accum:
+                    raise ValueError(
+                        f"batch {b} not divisible by accum_steps {accum}")
+                return a.reshape((accum, b // accum) + a.shape[1:])
+
+            xs = tuple(split(v) for v in x) if isinstance(x, tuple) \
+                else split(x)
+            ys = split(y)
+            keys = jax.random.split(key, accum)
+
+            def body(carry, inp):
+                gsum, loss_sum = carry
+                xi, yi, ki = inp
+                (loss, aux), g = _grads_once(params, xi, yi, ki)
+                gsum = jax.tree.map(
+                    lambda s, gi: s + gi.astype(jnp.float32), gsum, g)
+                return (gsum, loss_sum + loss), aux
+
+            g0 = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            (gsum, loss_sum), auxs = jax.lax.scan(
+                body, (g0, jnp.float32(0)), (xs, ys, keys))
+            grads = jax.tree.map(
+                lambda s, p: (s / accum).astype(p.dtype), gsum, params)
+            # aux (BN moving stats): the last micro-batch's update —
+            # the same value a sequential grad_req='add' loop leaves
+            aux = jax.tree.map(lambda a: a[-1], auxs)
+            return (loss_sum / accum, aux), grads
+
+        def step(params, states, x, y, key, lr, t):
+            (loss, aux), grads = (
+                _grads_accum if accum > 1 else _grads_once)(
+                    params, x, y, key)
             new_params, new_states = [], []
             for raw, g, st, tr, new_raw in zip(params, grads, states,
                                                trainable, aux):

@@ -452,3 +452,48 @@ def test_zero_opt_states_stay_dp_sharded_with_tp_params():
     (m, v), = [s for s in tr._states if s is not None]
     mspec = str(m.sharding.spec)
     assert "dp" in mspec and "tp" not in mspec, mspec
+
+
+def test_accum_steps_matches_full_batch():
+    """Gradient accumulation (ref: grad_req='add' + Trainer.step on the
+    accumulated batch): accum_steps=K scanning K micro-batches inside
+    the compiled step must reproduce the full-batch trajectory exactly
+    (equal micro sizes: mean-of-means == full mean)."""
+    rng = np.random.RandomState(3)
+    X = rng.rand(32, 12).astype(np.float32)
+    Y = rng.randint(0, 4, 32).astype(np.float32)
+
+    def run(accum):
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+        net.initialize(mx.init.Xavier())
+        tr = data_parallel.DataParallelTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.1, "momentum": 0.9},
+            accum_steps=accum)
+        losses = [float(tr.step(X, Y).asnumpy()) for _ in range(4)]
+        flat = np.concatenate([p.data().asnumpy().ravel()
+                               for p in net.collect_params().values()])
+        return losses, flat
+
+    l1, p1 = run(1)
+    l2, p2 = run(2)
+    l4, p4 = run(4)
+    assert np.allclose(l1, l2, atol=1e-5), (l1, l2)
+    assert np.allclose(l1, l4, atol=1e-5), (l1, l4)
+    assert np.allclose(p1, p2, atol=1e-5)
+    assert np.allclose(p1, p4, atol=1e-5)
+    assert l1[-1] < l1[0]
+
+
+def test_accum_steps_indivisible_batch_raises():
+    net = nn.Dense(4)
+    net.initialize(mx.init.Xavier())
+    tr = data_parallel.DataParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1}, accum_steps=3)
+    X = np.random.rand(8, 6).astype(np.float32)
+    Y = np.zeros((8,), np.float32)
+    with pytest.raises(ValueError, match="divisible"):
+        tr.step(X, Y)
