@@ -36,11 +36,16 @@ class BERTForPretrain(HybridBlock):
         self._vocab = vocab_size
 
     def hybrid_forward(self, F, inputs, token_types, mlm_targets,
-                       nsp_labels, mask_weight, valid_length=None):
+                       nsp_labels, mask_weight, valid_length,
+                       masked_positions):
         # valid_length masks attention over the [PAD] tail (real-corpus
-        # batches are padded; the BERT recipe never attends to pads)
+        # batches are padded; the BERT recipe never attends to pads).
+        # masked_positions (b, K): the MLM head decodes ONLY those
+        # positions (gluonnlp run_pretraining shape) — targets and
+        # mask_weight are (b, K) position-aligned.
         mlm_scores, nsp_scores = self.model(inputs, token_types,
-                                            valid_length)
+                                            valid_length,
+                                            masked_positions)
         mlm_log = F.log_softmax(mlm_scores)
         mlm_ll = F.pick(mlm_log, mlm_targets, axis=-1)
         mlm_loss = -F.sum(mlm_ll * mask_weight) / (F.sum(mask_weight) + 1)
@@ -50,17 +55,21 @@ class BERTForPretrain(HybridBlock):
 
 
 def synthetic_batch(rng, bs, seq_len, vocab, mask_frac=0.15):
+    K = max(1, int(round(seq_len * mask_frac)))
     tokens = rng.randint(4, vocab, (bs, seq_len))
     types = np.zeros((bs, seq_len), np.int32)
     half = seq_len // 2
     types[:, half:] = 1
-    mask = (rng.rand(bs, seq_len) < mask_frac).astype(np.float32)
-    targets = tokens.copy()
-    inputs = np.where(mask > 0, 3, tokens)  # 3 = [MASK]
+    positions = np.stack([rng.choice(seq_len, K, replace=False)
+                          for _ in range(bs)]).astype(np.int32)
+    targets = np.take_along_axis(tokens, positions, 1)
+    inputs = tokens.copy()
+    np.put_along_axis(inputs, positions, 3, 1)  # 3 = [MASK]
+    weights = np.ones((bs, K), np.float32)
     nsp = rng.randint(0, 2, (bs,))
     valid = np.full((bs,), seq_len, np.int32)
     return (inputs.astype(np.int32), types, targets.astype(np.int32),
-            nsp.astype(np.int32), mask, valid)
+            nsp.astype(np.int32), weights, valid, positions)
 
 
 def main():
@@ -141,13 +150,14 @@ def main():
     for step in range(args.steps):
         if batch_stream is not None:
             b = next(batch_stream)
-            inputs, types, targets, nsp, mask, valid = (
-                b["input_ids"], b["token_types"], b["mlm_targets"],
-                b["nsp_labels"], b["mask_weight"], b["valid_length"])
+            batch = (b["input_ids"], b["token_types"],
+                     b["mlm_targets_k"], b["nsp_labels"],
+                     b["mask_weight_k"], b["valid_length"],
+                     b["masked_positions"])
         else:
-            inputs, types, targets, nsp, mask, valid = synthetic_batch(
+            batch = synthetic_batch(
                 rng, args.batch_size, args.seq_len, args.vocab_size)
-        loss = trainer.step((inputs, types, targets, nsp, mask, valid),
+        loss = trainer.step(batch,
                             np.zeros((args.batch_size,), np.float32))
         tic_n += args.batch_size * args.seq_len
         if step % args.disp == 0 and step:

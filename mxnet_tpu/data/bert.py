@@ -133,7 +133,13 @@ class BertPretrainPipeline:
         input_ids/token_types/mlm_targets (b, s) int32, nsp_labels (b,)
         int32, mask_weight (b, s) float32, valid_length (b,) int32 (so
         attention can mask the [PAD] tail — BERTModel's valid_length
-        contract)."""
+        contract).  Additionally the position form (gluonnlp
+        run_pretraining / BERTForPretrain contract — the MLM head
+        decodes only these): masked_positions (b, max_preds) int32 and
+        position-aligned mlm_targets_k (b, max_preds) int32 /
+        mask_weight_k (b, max_preds) float32, zero-padded past each
+        row's prediction count."""
+        K = self.max_preds
         for _ in range(num_batches):
             rows = []
             while len(rows) < batch_size:
@@ -141,13 +147,26 @@ class BertPretrainPipeline:
                 if inst is not None:
                     rows.append(inst)
             ids, types, tgt, nsp, wt, valid = zip(*rows)
+            tgt = np.asarray(tgt, np.int32)
+            wt = np.asarray(wt, np.float32)
+            pos_k = np.zeros((batch_size, K), np.int32)
+            tgt_k = np.zeros((batch_size, K), np.int32)
+            wt_k = np.zeros((batch_size, K), np.float32)
+            for r in range(batch_size):
+                where = np.nonzero(wt[r] > 0)[0][:K]
+                pos_k[r, :len(where)] = where
+                tgt_k[r, :len(where)] = tgt[r, where]
+                wt_k[r, :len(where)] = 1.0
             yield {
                 "input_ids": np.asarray(ids, np.int32),
                 "token_types": np.asarray(types, np.int32),
-                "mlm_targets": np.asarray(tgt, np.int32),
+                "mlm_targets": tgt,
                 "nsp_labels": np.asarray(nsp, np.int32),
-                "mask_weight": np.asarray(wt, np.float32),
+                "mask_weight": wt,
                 "valid_length": np.asarray(valid, np.int32),
+                "masked_positions": pos_k,
+                "mlm_targets_k": tgt_k,
+                "mask_weight_k": wt_k,
             }
 
 

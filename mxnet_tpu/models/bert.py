@@ -124,18 +124,36 @@ class BERTModel(HybridBlock):
         return self.pooler(seq.slice_axis(1, 0, 1).reshape(
             seq.shape[0], self._units))
 
-    def hybrid_forward(self, F, inputs, token_types, valid_length=None):
+    def hybrid_forward(self, F, inputs, token_types, valid_length=None,
+                       masked_positions=None):
         """Full heads: (mlm_scores, nsp_scores) — the pretraining
         contract.  With use_decoder=False/use_classifier=False
         (fine-tuning backbones) returns (sequence, pooled) or just the
-        sequence, matching gluonnlp's output arity rules."""
+        sequence, matching gluonnlp's output arity rules.
+
+        `masked_positions` (b, K) int32 — gluonnlp's BERTModel
+        contract: the MLM head decodes ONLY the gathered positions,
+        giving (b, K, vocab).  At seq 128 the all-positions vocab
+        projection is ~35% of the training step's FLOPs for ~15%
+        masked tokens — the gather is both the reference recipe and
+        the throughput win.  Omitted: decode every position (b, S,
+        vocab), the fine-tune/scoring form."""
         seq = self._encode_sequence(inputs, token_types, valid_length)
         if not (self._use_decoder or self._use_classifier):
             if not self._use_pooler:
                 return seq
             return seq, self.pool(seq)
+        mlm_in = seq
+        if self._use_decoder and masked_positions is not None:
+            b, S = inputs.shape[0], inputs.shape[1]
+            K = masked_positions.shape[1]
+            flat = seq.reshape(b * S, self._units)
+            offsets = F.arange(0, b, dtype="int32").reshape(b, 1) * S
+            fidx = (masked_positions.astype("int32") + offsets) \
+                .reshape(b * K)
+            mlm_in = F.take(flat, fidx).reshape(b, K, self._units)
         mlm = self.mlm_decoder(
-            self.mlm_ln(F.LeakyReLU(self.mlm_transform(seq),
+            self.mlm_ln(F.LeakyReLU(self.mlm_transform(mlm_in),
                                     act_type="gelu"))) \
             if self._use_decoder else None
         # pool only when the NSP head consumes it (an MLM-only model

@@ -149,6 +149,35 @@ def test_bert_tiny_forward_and_grad():
     assert losses[-1] < losses[0], losses
 
 
+def test_bert_masked_positions_gather():
+    """masked_positions (gluonnlp contract): the MLM head decodes ONLY
+    the gathered positions — scores must equal the dense decode at
+    those positions, shape (b, K, vocab)."""
+    import numpy as np
+
+    from mxnet_tpu.models import bert_tiny
+
+    mx.random.seed(1)
+    net = bert_tiny(vocab_size=100)
+    net.initialize(mx.init.Normal(0.02))
+    B, T, K = 2, 12, 3
+    rng = np.random.RandomState(0)
+    tokens = nd.array(rng.randint(0, 100, (B, T)), dtype="int32")
+    types = nd.zeros((B, T), dtype="int32")
+    vlen = nd.array([12, 12])
+    pos = nd.array(np.stack([rng.choice(T, K, replace=False)
+                             for _ in range(B)]), dtype="int32")
+    dense, _ = net(tokens, types, vlen)
+    gathered, _ = net(tokens, types, vlen, pos)
+    assert gathered.shape == (B, K, 100)
+    d = dense.asnumpy()
+    g = gathered.asnumpy()
+    p = pos.asnumpy().astype(int)
+    for r in range(B):
+        for k in range(K):
+            assert np.allclose(d[r, p[r, k]], g[r, k], atol=1e-5)
+
+
 def test_bert_hybridize():
     from mxnet_tpu.models import bert_tiny
 
