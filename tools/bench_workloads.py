@@ -92,9 +92,11 @@ class _Identity:
         return out
 
 
-def bench_bert(bs=32, seq_len=128, steps=20):
+def bench_bert(bs=None, seq_len=128, steps=20):
     """BERT-base MLM+NSP training step (BASELINE config #3)."""
     jax = _setup_jax()
+    bs = bs if bs is not None else (
+        64 if jax.devices()[0].platform == "tpu" else 32)
     import numpy as np
 
     import mxnet_tpu as mx
@@ -122,9 +124,15 @@ def bench_bert(bs=32, seq_len=128, steps=20):
                    {"batch_size": bs, "seq_len": seq_len})
 
 
-def bench_transformer(bs=32, seq_len=32, steps=20, model="big"):
-    """Transformer-{base,big} WMT14-style train step (BASELINE #4)."""
+def bench_transformer(bs=None, seq_len=None, steps=20, model="big"):
+    """Transformer-{base,big} WMT14-style train step (BASELINE #4).
+
+    TPU default bs 64 x seq 64 (preflight: static tier 4.9 GB of
+    16 GB, so utilization not memory binds); CPU stays tiny."""
     jax = _setup_jax()
+    on_tpu = jax.devices()[0].platform == "tpu"
+    bs = bs if bs is not None else (64 if on_tpu else 32)
+    seq_len = seq_len if seq_len is not None else (64 if on_tpu else 32)
     import numpy as np
 
     import mxnet_tpu as mx
