@@ -107,7 +107,11 @@ def waitall():
     if _native is not None:
         _native.wait_all()
     while _live_fast:
-        _block_on(_live_fast.pop())
+        try:
+            arr = _live_fast.pop()
+        except IndexError:  # concurrent waitall drained it first
+            break
+        _block_on(arr)
     for arr in list(_live):
         _block_on(arr)
 
