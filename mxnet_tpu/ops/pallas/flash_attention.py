@@ -326,6 +326,18 @@ def _tiles_ok(q, k, block_q=128, block_k=128):
     if d % 128 != 0:
         if d % 64 != 0 or not _headdim64_allowed():
             return False
+    # VMEM bound: each (b*h) grid step holds the FULL K and V rows in
+    # VMEM (blockspec (1, sk, d)).  Past ~half of a v5e-class core's
+    # ~16 MB VMEM, Mosaic rejects at the user's jit compile — AFTER the
+    # small-shape probes passed — so gate here and fall back (XLA
+    # reference single-chip; ring/Ulysses SP is the real long-context
+    # path, SURVEY §5).  MXTPU_FLASH_MAX_KV_VMEM_MB overrides.
+    from ...base import getenv
+
+    itemsize = 2 if q.dtype in (jnp.bfloat16, jnp.float16) else 4
+    kv_mb = 2 * sk * d * itemsize / 1e6
+    if kv_mb > getenv("FLASH_MAX_KV_VMEM_MB", 8.0, float):
+        return False
     return (sq % block_q == 0 and sk % block_k == 0
             and sq >= block_q and sk >= block_k)
 
