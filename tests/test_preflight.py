@@ -1,0 +1,48 @@
+"""HBM-fit preflight machinery (VERDICT r4 #3): the CPU-runnable tier.
+
+tools/preflight.py sizes the five BASELINE configs at full scale (the
+13-minute run recorded in docs/WORKLOADS.md); this test drives the
+same machinery end to end at a small scale so regressions in the
+builders/lowering/static-tier math surface in the default suite.
+"""
+import importlib.util
+import os
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def preflight_mod():
+    spec = importlib.util.spec_from_file_location(
+        "preflight", os.path.join(_ROOT, "tools", "preflight.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.slow
+def test_preflight_lenet_small_scale(preflight_mod):
+    rec = preflight_mod.preflight("lenet", scale_kw={"bs": 8})
+    assert rec["config"] == "lenet"
+    assert rec["fits"] is True
+    assert rec["param_mb"] > 0
+    assert rec["static_mb"] > rec["param_mb"]  # grads+states on top
+    assert rec["hbm_gb"] == 16.0  # v5e assumption off-chip
+    # lowering produced a flop count for the full train step
+    assert rec.get("gflops_per_step", 0) > 0
+
+
+def test_hbm_capacity_table(preflight_mod):
+    class _Dev:
+        def __init__(self, platform, kind):
+            self.platform = platform
+            self.device_kind = kind
+
+    assert preflight_mod._hbm_capacity(_Dev("cpu", "cpu")) == 16e9
+    assert preflight_mod._hbm_capacity(
+        _Dev("tpu", "TPU v5 lite")) == 16e9
+    assert preflight_mod._hbm_capacity(_Dev("tpu", "TPU v5p")) == 95e9
+    assert preflight_mod._hbm_capacity(_Dev("tpu", "TPU v4")) == 32e9
