@@ -17,14 +17,11 @@ from __future__ import annotations
 import atexit
 import concurrent.futures
 import threading
-import weakref
 
 from .base import getenv
 
-# live arrays tracked for waitall(); weakrefs so we never extend lifetime
-_live = weakref.WeakSet()
-
-# Dispatch-hot-path tracking: WeakSet.add costs ~4us/op (guard logic in
+# Live-buffer tracking for waitall(): a WeakSet would never extend
+# lifetimes, but WeakSet.add costs ~4us/op (guard logic in
 # _weakrefset.py), a large slice of the eager per-op budget.  The hot
 # path appends strong refs to a plain list instead (~0.1us) and
 # amortizes cleanup: once the list passes _COMPACT_AT entries, ready
@@ -111,8 +108,6 @@ def waitall():
             arr = _live_fast.pop()
         except IndexError:  # concurrent waitall drained it first
             break
-        _block_on(arr)
-    for arr in list(_live):
         _block_on(arr)
 
 
