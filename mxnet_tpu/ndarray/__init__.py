@@ -37,6 +37,31 @@ class _ContribNamespace:
 
 contrib = _ContribNamespace(_gen_ops)
 
+
+class _PrefixNamespace:
+    """mx.nd.linalg.X → the op registered as `_linalg_X` (ref:
+    python/mxnet/ndarray/linalg.py strips the same prefix)."""
+
+    def __init__(self, mod, prefix, label):
+        self._mod = mod
+        self._prefix = prefix
+        self._label = label
+
+    def __getattr__(self, name):
+        # the registry exposes both `linalg_X` (primary) and the
+        # MXNet-internal `_linalg_X` alias for most but not all ops —
+        # accept either spelling
+        for pre in (self._prefix, self._prefix.lstrip("_")):
+            try:
+                return getattr(self._mod, pre + name)
+            except AttributeError:
+                continue
+        raise AttributeError(
+            f"{self._label} namespace has no operator '{name}'")
+
+
+linalg = _PrefixNamespace(_gen_ops, "_linalg_", "linalg")
+
 # module-level binary helpers accepting scalar or NDArray operands
 # (ref: python/mxnet/ndarray/ndarray.py maximum/minimum/power/hypot)
 maximum = _gen_ops.broadcast_maximum

@@ -3,10 +3,12 @@ from .symbol import (Symbol, Executor, var, Variable, load, fromjson,  # noqa: F
                      Group, AttrScope)
 from . import symbol as _symbol_mod
 from . import export  # noqa: F401
-from ..ndarray import _ContribNamespace, _RandomNamespace
+from ..ndarray import (_ContribNamespace, _PrefixNamespace,
+                       _RandomNamespace)
 
 contrib = _ContribNamespace(_symbol_mod)
 random = _RandomNamespace(_symbol_mod)
+linalg = _PrefixNamespace(_symbol_mod, "_linalg_", "linalg")
 
 
 def __getattr__(name):

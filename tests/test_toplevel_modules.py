@@ -150,3 +150,22 @@ def test_util_helpers(tmp_path):
 def test_rtc_raises_pointed_error():
     with pytest.raises(mx.MXNetError, match="Pallas"):
         mx.rtc.CudaModule("__global__ void k() {}")
+
+
+def test_nd_sym_linalg_namespace():
+    """mx.nd.linalg.X / mx.sym.linalg.X (ref: python/mxnet/ndarray/
+    linalg.py) resolve registry ops under either alias spelling."""
+    import numpy as np
+
+    out = mx.nd.linalg.gemm2(mx.nd.ones((2, 3)), mx.nd.ones((3, 4)))
+    assert out.shape == (2, 4)
+    assert float(out.asnumpy()[0, 0]) == 3.0
+    chol = mx.nd.linalg.potrf(mx.nd.array([[4.0, 0.0], [0.0, 9.0]]))
+    assert np.allclose(chol.asnumpy().diagonal(), [2.0, 3.0])
+    s = mx.sym.linalg.gemm2(mx.sym.var("a"), mx.sym.var("b"))
+    assert s is not None
+    try:
+        mx.nd.linalg.no_such_op
+        raise AssertionError("expected AttributeError")
+    except AttributeError as e:
+        assert "linalg namespace" in str(e)
