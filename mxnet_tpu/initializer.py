@@ -237,6 +237,35 @@ class LSTMBias(Initializer):
         arr[n:2 * n] = self.forget_bias
 
 
+@register()
+class Mixed(Initializer):
+    """Per-parameter-pattern dispatch (ref: mx.init.Mixed): each name
+    is initialized by the FIRST regex in `patterns` that matches —
+    order patterns specific-first, with '.*' as the catch-all."""
+
+    def __init__(self, patterns, initializers):
+        import re
+
+        super().__init__(patterns=patterns)
+        if len(patterns) != len(initializers):
+            raise ValueError(
+                "patterns and initializers must pair up, got "
+                f"{len(patterns)} vs {len(initializers)}")
+        self._map = [(re.compile(p), init)
+                     for p, init in zip(patterns, initializers)]
+
+    def init_array(self, name, arr):
+        # dispatch on the FULL name (no bias/gamma convention layer:
+        # the matched initializer owns the decision, as the ref does)
+        for pat, init in self._map:
+            if pat.match(name):
+                init(name, arr)
+                return
+        raise ValueError(
+            f"parameter {name!r} matched none of the Mixed patterns; "
+            "add a '.*' catch-all as the last pattern")
+
+
 def create(name, **kwargs):
     if isinstance(name, Initializer):
         return name

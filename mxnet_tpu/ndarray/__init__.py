@@ -76,6 +76,19 @@ random_uniform = random.uniform
 random_randint = random.randint
 
 
+def one_hot(indices, depth=None, on_value=1.0, off_value=0.0,
+            dtype="float32"):
+    """mx.nd.one_hot(indices, depth, ...) — depth is positional in the
+    reference signature (indexing_op.cc OneHotParam), but the generated
+    wrapper treats extra positionals as array inputs; this shim keeps
+    the reference calling convention."""
+    if depth is None:
+        raise TypeError("one_hot requires depth")
+    return _gen_ops.one_hot(indices, depth=int(depth),
+                            on_value=on_value, off_value=off_value,
+                            dtype=dtype)
+
+
 def __getattr__(name):
     # fall through to generated ops for aliases added later
     return getattr(_gen_ops, name)

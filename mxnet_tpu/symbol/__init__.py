@@ -11,5 +11,12 @@ random = _RandomNamespace(_symbol_mod)
 linalg = _PrefixNamespace(_symbol_mod, "_linalg_", "linalg")
 
 
+def one_hot(indices, depth=None, **kwargs):
+    """Positional-depth shim matching mx.nd.one_hot (see ndarray)."""
+    if depth is None:
+        raise TypeError("one_hot requires depth")
+    return _symbol_mod.one_hot(indices, depth=int(depth), **kwargs)
+
+
 def __getattr__(name):
     return getattr(_symbol_mod, name)

@@ -169,3 +169,32 @@ def test_nd_sym_linalg_namespace():
         raise AssertionError("expected AttributeError")
     except AttributeError as e:
         assert "linalg namespace" in str(e)
+
+
+def test_one_hot_positional_depth():
+    """mx.nd.one_hot(indices, depth) — depth positional, the reference
+    signature (indexing_op.cc OneHotParam)."""
+    import numpy as np
+
+    oh = mx.nd.one_hot(mx.nd.array([1, 2]), 4)
+    assert oh.shape == (2, 4)
+    assert np.allclose(oh.asnumpy()[0], [0, 1, 0, 0])
+    oh2 = mx.nd.one_hot(mx.nd.array([0]), depth=3, on_value=5.0)
+    assert oh2.asnumpy()[0, 0] == 5.0
+    assert mx.sym.one_hot(mx.sym.var("i"), 4) is not None
+
+
+def test_mixed_initializer():
+    """mx.init.Mixed: first-matching-pattern dispatch; each matched
+    sub-initializer still applies its own name conventions (bias→0),
+    exactly as the reference's Mixed does."""
+    import numpy as np
+
+    mixed = mx.init.Mixed([".*weight", ".*"],
+                          [mx.init.One(), mx.init.Zero()])
+    net = mx.gluon.nn.Dense(3, in_units=2)
+    net.initialize(mixed)
+    assert (net.weight.data().asnumpy() == 1).all()
+    assert (net.bias.data().asnumpy() == 0).all()
+    with pytest.raises(ValueError, match="pair up"):
+        mx.init.Mixed(["x"], [])
