@@ -219,17 +219,75 @@ def _deposit(nd, cts, done):
     done[id(nd)] = True
 
 
+def _pure_replay(tape, heads, variables, head_grads):
+    """A pure jnp function of the variables' raw buffers that replays
+    the recorded tape and returns the head-grad-weighted sum of heads —
+    jax.grad of THIS is the higher-order-capable gradient (the tape
+    nodes' fns are pure, so the replay is differentiable to any
+    order)."""
+    import functools
+
+    import jax.numpy as jnp
+
+    def fn(*var_raws):
+        env = {id(v): r for v, r in zip(variables, var_raws)}
+        for node in tape:
+            if node.fn is None:
+                raise MXNetError(
+                    "create_graph=True cannot differentiate through an "
+                    "autograd.Function node (its backward is an opaque "
+                    "host callback); express the op with registered "
+                    "ops or CustomOp instead")
+            args = [env.get(id(nd_in), raw) if nd_in is not None else raw
+                    for nd_in, raw in zip(node.in_nds, node.in_raws)]
+            f = functools.partial(node.fn, **dict(node.kwargs)) \
+                if node.kwargs else node.fn
+            out = f(*args)
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            for o_nd, o_raw in zip(node.out_nds, outs):
+                env[id(o_nd)] = o_raw
+        total = jnp.float32(0)
+        for i, h in enumerate(heads):
+            hr = env.get(id(h), h._data)
+            if head_grads is None or head_grads[i] is None:
+                seed = jnp.ones(hr.shape, jnp.float32)
+            else:
+                hg = head_grads[i]
+                seed = jnp.asarray(getattr(hg, "_data", hg), jnp.float32)
+            total = total + (hr.astype(jnp.float32) * seed).sum()
+        return total
+
+    return fn
+
+
 def grad(heads, variables, head_grads=None, retain_graph=None,
          create_graph=False, train_mode=True):
     """Ref: autograd.grad — return grads of heads w.r.t. variables without
-    touching .grad buffers."""
+    touching .grad buffers.
+
+    create_graph=True returns gradients that are THEMSELVES on the
+    tape (TPU-native: jax.grad of a pure replay of the recorded ops,
+    recorded as one differentiable tape node), so ``.backward()`` or a
+    further ``grad(..., create_graph=True)`` over them yields higher
+    derivatives to any order — beyond the reference, whose eager
+    higher-order support covered only a subset of ops.  Each call
+    traces+compiles a fresh replay executable, so keep it out of hot
+    loops (the first-order path below is the cached fast path)."""
     from .ndarray.ndarray import NDArray
 
-    if create_graph:
-        raise MXNetError("create_graph=True (higher-order eager grad) is not "
-                         "supported; use hybridize + symbolic grad instead")
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
     if isinstance(variables, NDArray):
         variables = [variables]
+
+    if create_graph:
+        tape = list(_st().tape)
+        fn = _pure_replay(tape, heads, variables, head_grads)
+        gfn = jax.grad(fn, argnums=tuple(range(len(variables))))
+        outs = _imperative.invoke(gfn, *variables)
+        return list(outs) if isinstance(outs, tuple) else [outs]
     saved = [(v._grad, v._grad_req) for v in variables]
     for v in variables:
         v._grad = _zeros_ndarray_like(v)

@@ -171,3 +171,38 @@ def test_dropout_train_vs_predict():
     assert 0.3 < frac_zero < 0.7
     y2 = nd.Dropout(x, p=0.5)  # not training: identity
     assert np.allclose(y2.asnumpy(), 1.0)
+
+
+def test_grad_create_graph_higher_order():
+    """create_graph=True (ref: autograd.grad) — gradients land on the
+    tape as differentiable nodes, so grad-of-grad and .backward() over
+    a gradient give true higher derivatives (x^4: 4x^3, 12x^2, 24x)."""
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x * x
+        g1 = autograd.grad(y, [x], create_graph=True)[0]
+        g2 = autograd.grad(g1, [x], create_graph=True)[0]
+    g2.backward()
+    assert abs(float(g1.asscalar()) - 32.0) < 1e-4
+    assert abs(float(g2.asscalar()) - 48.0) < 1e-4
+    assert abs(float(x.grad.asscalar()) - 48.0) < 1e-4
+
+
+def test_grad_create_graph_multivar():
+    """Hessian-vector-style: d/dx and d/dy of (x*y + x^2) then a
+    second order cross term d2/dxdy = 1."""
+    import numpy as np
+
+    x = nd.array([3.0])
+    y = nd.array([5.0])
+    x.attach_grad()
+    y.attach_grad()
+    with autograd.record():
+        z = x * y + x * x
+        gx, gy = autograd.grad(z, [x, y], create_graph=True)
+        # gx = y + 2x = 11 ; gy = x = 3
+        cross = autograd.grad(gx, [y], create_graph=False)[0]
+    assert abs(float(gx.asscalar()) - 11.0) < 1e-4
+    assert abs(float(gy.asscalar()) - 3.0) < 1e-4
+    assert abs(float(cross.asscalar()) - 1.0) < 1e-4
