@@ -91,6 +91,120 @@ def _km_spec(h, sk):
                         memory_space=pltpu.VMEM)
 
 
+# ---------------------------------------------------------------------------
+# streamed variant: K/V swept by a third grid dimension instead of
+# resident in VMEM — the long-KV path past the _tiles_ok VMEM bound.
+# Pallas TPU iterates the LAST grid dim innermost and sequentially and
+# scratch persists across grid steps, so the online-softmax state
+# (m, l, acc) carries across k-blocks; outputs are flushed on the
+# final k-block (same scheme as jax's reference TPU flash kernels).
+# ---------------------------------------------------------------------------
+
+
+def _flash_fwd_stream_kernel(*refs, causal, scale, has_mask, num_kb):
+    if has_mask:
+        (q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+        km_ref = None
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal: a k-block strictly above the diagonal contributes nothing
+    live = (kb * block_k <= qi * block_q + block_q - 1) if causal \
+        else (kb >= 0)
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0] * scale
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if km_ref is not None:
+            s = s + km_ref[0, 0, :][None, :]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_prev = m_scr[:]
+        l_prev = l_scr[:]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+
+    @pl.when(kb == num_kb - 1)
+    def _flush():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:] + jnp.log(l)
+
+
+def _flash_forward_stream(q, k, v, *, causal, scale, kmask=None,
+                          block_q=128, block_k=128):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bh = b * h
+    q3 = q.reshape(bh, sq, d)
+    k3 = k.reshape(bh, sk, d)
+    v3 = v.reshape(bh, sk, d)
+    num_kb = sk // block_k
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    args = [q3, k3, v3]
+    if kmask is not None:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, block_k), lambda i, j, kk: (i // h, 0, kk),
+            memory_space=pltpu.VMEM))
+        args.append(kmask.astype(jnp.float32).reshape(b, 1, sk))
+
+    out, lse = pl.pallas_call(
+        functools.partial(_flash_fwd_stream_kernel, causal=causal,
+                          scale=scale, has_mask=kmask is not None,
+                          num_kb=num_kb),
+        grid=(bh, sq // block_q, num_kb),
+        in_specs=in_specs,
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+        ),
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, kk: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+    )(*args)
+    return out.reshape(b, h, sq, d), lse
+
+
 def _flash_forward(q, k, v, *, causal, scale, kmask=None,
                    block_q=128, block_k=128):
     b, h, sq, d = q.shape
@@ -133,6 +247,181 @@ def _flash_forward(q, k, v, *, causal, scale, kmask=None,
         ),
     )(*args)
     return out.reshape(b, h, sq, d), lse
+
+
+def _flash_dq_stream_kernel(*refs, causal, scale, has_mask, num_kb):
+    if has_mask:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, km_ref,
+         dq_ref, dq_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_scr) = refs
+        km_ref = None
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    live = (kb * block_k <= qi * block_q + block_q - 1) if causal \
+        else (kb >= 0)
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = scale * jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if km_ref is not None:
+            s = s + km_ref[0, 0, :][None, :]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_scr[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(kb == num_kb - 1)
+    def _flush():
+        dq_ref[0] = (scale * dq_scr[:]).astype(dq_ref.dtype)
+
+
+def _flash_dkv_stream_kernel(*refs, causal, scale, has_mask, num_qb):
+    if has_mask:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, km_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        km_ref = None
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+    ki = pl.program_id(1)
+    qb = pl.program_id(2)
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    # causal: q-blocks entirely before this k-block see none of it
+    live = (qb * block_q + block_q - 1 >= ki * block_k) if causal \
+        else (qb >= 0)
+
+    @pl.when(live)
+    def _step():
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = scale * jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if km_ref is not None:
+            s = s + km_ref[0, 0, :][None, :]
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dv_scr[:] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_scr[:] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+
+    @pl.when(qb == num_qb - 1)
+    def _flush():
+        dk_ref[0] = (scale * dk_scr[:]).astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_backward_stream(q, k, v, o, lse, do, *, causal, scale,
+                           kmask=None, block_q=128, block_k=128):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bh = b * h
+    q3, k3, v3 = (t.reshape(bh, -1, d) for t in (q, k, v))
+    o3 = o.reshape(bh, sq, d)
+    do3 = do.reshape(bh, sq, d)
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    num_kb = sk // block_k
+    num_qb = sq // block_q
+    has_mask = kmask is not None
+    km3 = (kmask.astype(jnp.float32).reshape(b, 1, sk)
+           if has_mask else None)
+
+    def _km_blk(i, j, kk):
+        return (i // h, 0, kk)
+
+    q_blk = pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0),
+                         memory_space=pltpu.VMEM)
+    k_blk = pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0),
+                         memory_space=pltpu.VMEM)
+    r_blk = pl.BlockSpec((1, block_q, 1), lambda i, j, kk: (i, j, 0),
+                         memory_space=pltpu.VMEM)
+
+    dq_specs = [q_blk, k_blk, k_blk, q_blk, r_blk, r_blk]
+    dq_args = [q3, k3, v3, do3, lse, delta]
+    if has_mask:
+        dq_specs.append(pl.BlockSpec((1, 1, block_k), _km_blk,
+                                     memory_space=pltpu.VMEM))
+        dq_args.append(km3)
+    dq = pl.pallas_call(
+        functools.partial(_flash_dq_stream_kernel, causal=causal,
+                          scale=scale, has_mask=has_mask,
+                          num_kb=num_kb),
+        grid=(bh, num_qb, num_kb),
+        in_specs=dq_specs,
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        out_specs=q_blk,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+    )(*dq_args)
+
+    # dkv grid: (bh, k_blocks, q_blocks) — q swept innermost
+    qk_blk = pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, kk, 0),
+                          memory_space=pltpu.VMEM)
+    kk_blk = pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, j, 0),
+                          memory_space=pltpu.VMEM)
+    rr_blk = pl.BlockSpec((1, block_q, 1), lambda i, j, kk: (i, kk, 0),
+                          memory_space=pltpu.VMEM)
+    dkv_specs = [qk_blk, kk_blk, kk_blk, qk_blk, rr_blk, rr_blk]
+    dkv_args = [q3, k3, v3, do3, lse, delta]
+    if has_mask:
+        dkv_specs.append(pl.BlockSpec(
+            (1, 1, block_k), lambda i, j, kk: (i // h, 0, j),
+            memory_space=pltpu.VMEM))
+        dkv_args.append(km3)
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_dkv_stream_kernel, causal=causal,
+                          scale=scale, has_mask=has_mask,
+                          num_qb=num_qb),
+        grid=(bh, num_kb, num_qb),
+        in_specs=dkv_specs,
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ),
+        out_specs=(kk_blk, kk_blk),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+    )(*dkv_args)
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
 
 
 def _flash_dq_kernel(*refs, block_k, causal, scale, seq_k, has_mask):
@@ -326,20 +615,24 @@ def _tiles_ok(q, k, block_q=128, block_k=128):
     if d % 128 != 0:
         if d % 64 != 0 or not _headdim64_allowed():
             return False
-    # VMEM bound: each (b*h) grid step holds the FULL K and V rows in
-    # VMEM (blockspec (1, sk, d)).  Past ~half of a v5e-class core's
-    # ~16 MB VMEM, Mosaic rejects at the user's jit compile — AFTER the
-    # small-shape probes passed — so gate here and fall back (XLA
-    # reference single-chip; ring/Ulysses SP is the real long-context
-    # path, SURVEY §5).  MXTPU_FLASH_MAX_KV_VMEM_MB overrides.
-    from ...base import getenv
-
-    itemsize = 2 if q.dtype in (jnp.bfloat16, jnp.float16) else 4
-    kv_mb = 2 * sk * d * itemsize / 1e6
-    if kv_mb > getenv("FLASH_MAX_KV_VMEM_MB", 8.0, float):
-        return False
     return (sq % block_q == 0 and sk % block_k == 0
             and sq >= block_q and sk >= block_k)
+
+
+def _kv_resident(q, k):
+    """Whether full K/V rows fit comfortably in VMEM (the fast
+    resident kernels, blockspec (1, sk, d)).  Past ~half of a
+    v5e-class core's ~16 MB VMEM the STREAMED kernels take over: K/V
+    swept by a third grid dimension, online-softmax state in scratch —
+    unbounded sequence length at a small extra DMA cost.
+    MXTPU_FLASH_MAX_KV_VMEM_MB moves the crossover."""
+    from ...base import getenv
+
+    d = q.shape[3]
+    sk = k.shape[2]
+    itemsize = 2 if q.dtype in (jnp.bfloat16, jnp.float16) else 4
+    kv_mb = 2 * sk * d * itemsize / 1e6
+    return kv_mb <= getenv("FLASH_MAX_KV_VMEM_MB", 8.0, float)
 
 
 def _headdim64_allowed():
@@ -380,24 +673,32 @@ def _d64_compile_probe():
             .astype(jnp.float32).sum())).lower(q).compile()
 
 
+def _fwd_dispatch(q, k):
+    return _flash_forward if _kv_resident(q, k) else \
+        _flash_forward_stream
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
 def _flash_sdpa(q, k, v, km, causal, scale):
     # km: additive (b, sk) key-padding mask or None (None is an empty
     # pytree to custom_vjp, so one definition covers both paths)
-    out, _ = _flash_forward(q, k, v, causal=causal, scale=scale, kmask=km)
+    fwd = _fwd_dispatch(q, k)
+    out, _ = fwd(q, k, v, causal=causal, scale=scale, kmask=km)
     return out
 
 
 def _flash_sdpa_fwd(q, k, v, km, causal, scale):
-    out, lse = _flash_forward(q, k, v, causal=causal, scale=scale,
-                              kmask=km)
+    fwd = _fwd_dispatch(q, k)
+    out, lse = fwd(q, k, v, causal=causal, scale=scale, kmask=km)
     return out, (q, k, v, km, out, lse)
 
 
 def _flash_sdpa_bwd(causal, scale, res, g):
     q, k, v, km, o, lse = res
-    dq, dk, dv = _flash_backward(q, k, v, o, lse, g, causal=causal,
-                                 scale=scale, kmask=km)
+    bwd = _flash_backward if _kv_resident(q, k) else \
+        _flash_backward_stream
+    dq, dk, dv = bwd(q, k, v, o, lse, g, causal=causal,
+                     scale=scale, kmask=km)
     # mask is non-differentiable
     dkm = None if km is None else jnp.zeros_like(km)
     return dq, dk, dv, dkm
@@ -431,6 +732,11 @@ def flash_attention(q, k, v, mask=None, scale=None, causal=False):
     from ..attention import sdpa_reference
 
     if not _tiles_ok(q, k):
+        return sdpa_reference(q, k, v, mask, scale=scale, causal=causal)
+    if causal and q.shape[2] != k.shape[2]:
+        # the kernels use the start-aligned q_pos >= k_pos convention;
+        # the reference's causal mask for sq != sk is END-aligned
+        # (tril offset sk-sq) — keep the oracle's semantics
         return sdpa_reference(q, k, v, mask, scale=scale, causal=causal)
     s = float(scale) if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     km = _as_key_padding_mask(mask, q, k)

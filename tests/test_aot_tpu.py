@@ -74,6 +74,23 @@ def test_flash_attention_aot(dt, d, causal, masked):
     _aot_grad_compile(loss, q)
 
 
+@pytest.mark.parametrize("dt,causal", [
+    (jnp.bfloat16, False), (jnp.bfloat16, True), (jnp.float32, True)],
+    ids=["bf16", "bf16-causal", "f32-causal"])
+def test_flash_streamed_long_context_aot(dt, causal):
+    """The STREAMED kernels (K/V swept by a grid dim) Mosaic-compile at
+    seq 16384 — past the resident path's VMEM bound; single-chip
+    long-context attention with no ceiling."""
+    from mxnet_tpu.ops.pallas.flash_attention import _flash_sdpa
+
+    q = jax.ShapeDtypeStruct((1, 1, 16384, 128), dt)
+
+    def loss(a):
+        return _flash_sdpa(a, a, a, None, causal, 0.125) \
+            .astype(jnp.float32).sum()
+    _aot_grad_compile(loss, q)
+
+
 @pytest.mark.parametrize("dt", [jnp.float32, jnp.bfloat16],
                          ids=["f32", "bf16"])
 def test_conv_fused_aot(dt):

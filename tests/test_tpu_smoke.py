@@ -43,6 +43,23 @@ def test_flash_attention_compiles(dt, d, causal, masked):
     jax.jit(jax.grad(loss)).lower(q).compile()
 
 
+@pytest.mark.parametrize("dt,causal", [
+    (jnp.bfloat16, False), (jnp.bfloat16, True), (jnp.float32, True)],
+    ids=["bf16", "bf16-causal", "f32-causal"])
+def test_flash_streamed_compiles(dt, causal):
+    """Streamed long-KV flash attention (seq 16k, past the resident
+    VMEM bound) value-and-grad on the real chip."""
+    from mxnet_tpu.ops.pallas.flash_attention import _flash_sdpa
+
+    q = jnp.zeros((1, 1, 16384, 128), dt)
+
+    def loss(a):
+        return _flash_sdpa(a, a, a, None, causal, 0.125) \
+            .astype(jnp.float32).sum()
+
+    jax.jit(jax.grad(loss)).lower(q).compile()
+
+
 @pytest.mark.parametrize("dt", [jnp.float32, jnp.bfloat16],
                          ids=["f32", "bf16"])
 def test_conv_fused_kernels_compile(dt):
