@@ -62,6 +62,64 @@ class _PrefixNamespace:
 
 linalg = _PrefixNamespace(_gen_ops, "_linalg_", "linalg")
 
+
+class _ImageNamespace:
+    """mx.nd.image.X (ref: python/mxnet/ndarray/image.py — the
+    image_random.cc op family): thin functional forms over the same
+    primitives the gluon vision transforms use."""
+
+    @staticmethod
+    def to_tensor(src):
+        from .ndarray import _wrap
+
+        x = src._data.astype("float32") / 255.0
+        if x.ndim == 3:
+            return _wrap(x.transpose(2, 0, 1))
+        return _wrap(x.transpose(0, 3, 1, 2))
+
+    @staticmethod
+    def normalize(src, mean=0.0, std=1.0):
+        import jax.numpy as jnp
+
+        from .ndarray import _wrap
+
+        mean = jnp.asarray(mean, src.dtype)
+        std = jnp.asarray(std, src.dtype)
+        if mean.ndim == 1:  # per-channel; src is CHW or NCHW
+            shape = (1,) * (src._data.ndim - 3) + (-1, 1, 1)
+            mean = mean.reshape(shape)
+            std = std.reshape(shape)
+        return _wrap((src._data - mean) / std)
+
+    @staticmethod
+    def resize(src, size, keep_ratio=False, interp=1):
+        from ..image.image import imresize, resize_short
+
+        if isinstance(size, int):
+            if keep_ratio:
+                return resize_short(src, size, interp)
+            size = (size, size)
+        return imresize(src, size[0], size[1], interp)
+
+    @staticmethod
+    def crop(src, x, y, width, height):
+        from ..image.image import fixed_crop
+
+        return fixed_crop(src, x, y, width, height)
+
+    @staticmethod
+    def random_flip_left_right(src):
+        from .. import random as _random
+
+        from .ndarray import _wrap
+        import jax.numpy as jnp
+
+        flip = float(_random.uniform(0, 1, shape=(1,)).asnumpy()[0]) < 0.5
+        return _wrap(jnp.flip(src._data, axis=-2)) if flip else src
+
+
+image = _ImageNamespace()
+
 # module-level binary helpers accepting scalar or NDArray operands
 # (ref: python/mxnet/ndarray/ndarray.py maximum/minimum/power/hypot)
 maximum = _gen_ops.broadcast_maximum

@@ -198,3 +198,24 @@ def test_mixed_initializer():
     assert (net.bias.data().asnumpy() == 0).all()
     with pytest.raises(ValueError, match="pair up"):
         mx.init.Mixed(["x"], [])
+
+
+def test_nd_image_namespace():
+    """mx.nd.image.* (ref: python/mxnet/ndarray/image.py): functional
+    forms of the vision transforms."""
+    img = mx.nd.array((np.random.rand(32, 24, 3) * 255)
+                      .astype(np.uint8))
+    t = mx.nd.image.to_tensor(img)
+    assert t.shape == (3, 32, 24)
+    assert float(t.asnumpy().max()) <= 1.0
+    n = mx.nd.image.normalize(
+        t, mean=np.array([0.5] * 3, np.float32),
+        std=np.array([0.2] * 3, np.float32))
+    assert n.shape == (3, 32, 24)
+    r = mx.nd.image.resize(img, (16, 16))
+    assert r.shape[:2] == (16, 16)
+    nb = mx.nd.image.normalize(
+        mx.nd.array(np.random.rand(2, 3, 8, 8).astype(np.float32)),
+        mean=np.array([0.5] * 3, np.float32),
+        std=np.array([0.2] * 3, np.float32))
+    assert nb.shape == (2, 3, 8, 8)
