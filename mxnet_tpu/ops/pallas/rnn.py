@@ -281,3 +281,209 @@ def _is_zero(x):
 
 
 lstm_layer.defvjp(_lstm_fwd_rule, _lstm_bwd_rule)
+
+
+# ---------------------------------------------------------------------------
+# GRU recurrence (same cuDNN-style split as the LSTM above: XLA does the
+# time-batched input GEMM, the kernel does the sequential part).
+# Cell (ops/rnn.py _step_fn('gru'), the cuDNN linear-before-reset form):
+#   r = sigmoid(xp_r + h Wh_r^T + bh_r)
+#   z = sigmoid(xp_z + h Wh_z^T + bh_z)
+#   n = tanh(xp_n + r * (h Wh_n^T + bh_n))
+#   h' = (1-z) n + z h
+# Saves (r, z, n) and the n-gate recurrent linear term hn_lin for the
+# backward (the reserve-space trick); bh rides INSIDE the kernel — its
+# n-slot cannot be folded into x_proj because r multiplies it.
+# ---------------------------------------------------------------------------
+
+
+def _gru_fwd_kernel(xp_ref, wht_ref, bh_ref, h0_ref,
+                    ys_ref, hn_ref, gates_ref, hnlin_ref, h_scr):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[:] = h0_ref[:].astype(jnp.float32)
+
+    h = h_scr[:]
+    xp = xp_ref[0].astype(jnp.float32)        # (N, 3, H)
+    gh = [jnp.dot(h, wht_ref[g].astype(jnp.float32),
+                  preferred_element_type=jnp.float32)
+          + bh_ref[g, 0, :].astype(jnp.float32)[None, :]
+          for g in range(3)]
+    r = jax.nn.sigmoid(xp[:, 0, :] + gh[0])
+    z = jax.nn.sigmoid(xp[:, 1, :] + gh[1])
+    n = jnp.tanh(xp[:, 2, :] + r * gh[2])
+    h_new = (1.0 - z) * n + z * h
+
+    h_scr[:] = h_new
+    ys_ref[0] = h_new.astype(ys_ref.dtype)
+    for gi, v in enumerate((r, z, n)):
+        gates_ref[0, :, gi, :] = v
+    hnlin_ref[0] = gh[2]
+    hn_ref[:] = h_new.astype(hn_ref.dtype)
+
+
+def _gru_forward(x_proj, wh, bh, h0):
+    T, N, G3 = x_proj.shape
+    H = wh.shape[1]
+    xp3 = x_proj.reshape(T, N, 3, H)
+    wh3 = wh.reshape(3, H, H).transpose(0, 2, 1)
+    bh3 = bh.reshape(3, 1, H)
+    return pl.pallas_call(
+        _gru_fwd_kernel,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, N, 3, H), lambda t: (t, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, H, H), lambda t: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, 1, H), lambda t: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((N, H), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=(
+            jax.ShapeDtypeStruct((T, N, H), x_proj.dtype),    # ys
+            jax.ShapeDtypeStruct((N, H), x_proj.dtype),       # h_n
+            jax.ShapeDtypeStruct((T, N, 3, H), jnp.float32),  # r,z,n
+            jax.ShapeDtypeStruct((T, N, H), jnp.float32),     # hn_lin
+        ),
+        out_specs=(
+            pl.BlockSpec((1, N, H), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((N, H), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, N, 3, H), lambda t: (t, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, N, H), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[pltpu.VMEM((N, H), jnp.float32)],
+    )(xp3, wh3, bh3, h0)
+
+
+def _gru_bwd_kernel(dy_ref, gates_ref, hnlin_ref, hprev_ref, wh_ref,
+                    dhn_ref,
+                    dxp_ref, dwh_ref, dbh_ref, dh0_ref,
+                    dh_scr, dwh_scr, dbh_scr):
+    idx = pl.program_id(0)
+
+    @pl.when(idx == 0)
+    def _():
+        dh_scr[:] = dhn_ref[:].astype(jnp.float32)
+        dwh_scr[:] = jnp.zeros_like(dwh_scr)
+        dbh_scr[:] = jnp.zeros_like(dbh_scr)
+
+    dh = dh_scr[:] + dy_ref[0].astype(jnp.float32)
+    r = gates_ref[0, :, 0, :]
+    z = gates_ref[0, :, 1, :]
+    n = gates_ref[0, :, 2, :]
+    hn_lin = hnlin_ref[0]
+    hp = hprev_ref[0].astype(jnp.float32)
+
+    dn = dh * (1.0 - z)
+    dz = dh * (hp - n)
+    dgn = dn * (1.0 - n * n)          # n-gate pre-activation grad
+    dr = dgn * hn_lin
+    dhnlin = dgn * r                  # grad into (h Wh_n^T + bh_n)
+    dgr = dr * r * (1.0 - r)
+    dgz = dz * z * (1.0 - z)
+
+    dh_new = dh * z
+    # per-gate recurrent VJPs: dh_prev += dgate @ Wh_g ; dWh_g += dgate.T @ h_prev
+    for gi, dg in ((0, dgr), (1, dgz), (2, dhnlin)):
+        dwh_scr[gi] += jnp.dot(dg.T, hp,
+                               preferred_element_type=jnp.float32)
+        dbh_scr[gi, 0, :] += jnp.sum(dg, axis=0)
+        dh_new = dh_new + jnp.dot(dg, wh_ref[gi].astype(jnp.float32),
+                                  preferred_element_type=jnp.float32)
+        # x-projection grads: r and z slots take their pre-act grads;
+        # the n slot takes dgn (xp_n enters the cell un-multiplied)
+        dxp_ref[0, :, gi, :] = (dg if gi != 2 else dgn) \
+            .astype(dxp_ref.dtype)
+    dh_scr[:] = dh_new
+
+    dwh_ref[:] = dwh_scr[:].astype(dwh_ref.dtype)
+    dbh_ref[:] = dbh_scr[:].astype(dbh_ref.dtype)
+    dh0_ref[:] = dh_scr[:].astype(dh0_ref.dtype)
+
+
+def _gru_backward(wh, h0, ys, gates, hn_lin, dys, dhn):
+    T, N = gates.shape[0], gates.shape[1]
+    H = wh.shape[1]
+    wh3 = wh.reshape(3, H, H)
+    f32 = jnp.float32
+    h_prev = jnp.concatenate([h0[None].astype(f32), ys[:-1].astype(f32)],
+                             0)
+    rev3 = lambda t: (T - 1 - t, 0, 0)     # noqa: E731
+    rev4 = lambda t: (T - 1 - t, 0, 0, 0)  # noqa: E731
+    return pl.pallas_call(
+        _gru_bwd_kernel,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, N, H), rev3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, N, 3, H), rev4, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, N, H), rev3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, N, H), rev3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, H, H), lambda t: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((N, H), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=(
+            jax.ShapeDtypeStruct((T, N, 3, H), jnp.float32),  # dx_proj
+            jax.ShapeDtypeStruct((3, H, H), jnp.float32),     # dwh
+            jax.ShapeDtypeStruct((3, 1, H), jnp.float32),     # dbh
+            jax.ShapeDtypeStruct((N, H), jnp.float32),        # dh0
+        ),
+        out_specs=(
+            pl.BlockSpec((1, N, 3, H), rev4, memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, H, H), lambda t: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, 1, H), lambda t: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((N, H), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((N, H), jnp.float32),
+            pltpu.VMEM((3, H, H), jnp.float32),
+            pltpu.VMEM((3, 1, H), jnp.float32),
+        ],
+    )(dys, gates, hn_lin, h_prev, wh3, dhn)
+
+
+@jax.custom_vjp
+def gru_layer(x_proj, wh, bh, h0):
+    """One GRU layer/direction over time.
+
+    x_proj: (T, N, 3H) input projection ``x @ Wi.T + bi``; wh: (3H, H);
+    bh: (3H,) recurrent bias (NOT foldable into x_proj — the reset
+    gate multiplies its n-slot); h0: (N, H). Gate order r, z, n.
+    Returns (ys (T,N,H), h_n)."""
+    ys, hn, _, _ = _gru_forward(x_proj, wh, bh, h0)
+    return ys, hn
+
+
+def _gru_fwd_rule(x_proj, wh, bh, h0):
+    ys, hn, gates, hn_lin = _gru_forward(x_proj, wh, bh, h0)
+    return (ys, hn), (wh, h0, ys, gates, hn_lin)
+
+
+def _gru_bwd_rule(res, cotangents):
+    wh, h0, ys, gates, hn_lin = res
+    dys, dhn = cotangents
+    dys = jnp.zeros_like(ys) if _is_zero(dys) else dys
+    dhn = jnp.zeros_like(h0) if _is_zero(dhn) else dhn
+    dxp, dwh, dbh, dh0 = _gru_backward(
+        wh, h0, ys, gates, hn_lin, dys.astype(jnp.float32), dhn)
+    T, N = dxp.shape[0], dxp.shape[1]
+    H = wh.shape[1]
+    return (dxp.reshape(T, N, 3 * H).astype(ys.dtype),
+            dwh.reshape(3 * H, H).astype(wh.dtype),
+            dbh.reshape(3 * H).astype(wh.dtype),
+            dh0.astype(h0.dtype))
+
+
+gru_layer.defvjp(_gru_fwd_rule, _gru_bwd_rule)

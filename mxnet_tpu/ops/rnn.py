@@ -155,6 +155,58 @@ def _pallas_lstm_fits(N, H, G=4):
     return 2 * est < 12 * 1024 * 1024
 
 
+def _use_pallas_gru():
+    """Pallas GRU recurrence on TPU (same gating scheme as the LSTM)."""
+    from ..base import getenv
+
+    impl = getenv("RNN_IMPL", "auto").lower()
+    if impl == "scan":
+        return False
+    if impl == "pallas":
+        return True
+    try:
+        on_tpu = jax.default_backend() == "tpu"
+    except Exception:
+        return False
+    if not on_tpu:
+        return False
+    from .pallas.probe import probe_ok
+
+    return probe_ok("gru", _gru_compile_probe)
+
+
+def _gru_compile_probe():
+    """Compile tiny value-and-grad GRU recurrences, f32 and bf16."""
+    from .pallas.rnn import gru_layer
+
+    T, N, H = 2, 8, 128
+    for dt in (jnp.float32, jnp.bfloat16):
+        xp = jnp.zeros((T, N, 3 * H), dt)
+        wh = jnp.zeros((3 * H, H), dt)
+        bh = jnp.zeros((3 * H,), dt)
+        h0 = jnp.zeros((N, H), dt)
+
+        def _loss(a, b, c, d):
+            return gru_layer(a, b, c, d)[0].astype(jnp.float32).sum()
+
+        jax.jit(jax.grad(_loss)).lower(xp, wh, bh, h0).compile()
+
+
+def _pallas_gru_dir(xs, init, wi, wh, bi, bh, reverse):
+    """Same cuDNN-style split as the LSTM; bh stays a kernel input (the
+    reset gate multiplies its n-slot, so it cannot fold into x_proj)."""
+    from .pallas.rnn import gru_layer
+
+    if reverse:
+        xs = jnp.flip(xs, axis=0)
+    x_proj = xs @ wi.T + bi
+    (h0,) = init
+    ys, hn = gru_layer(x_proj, wh, bh, h0)
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    return (hn,), ys
+
+
 def _pallas_lstm_dir(xs, init, wi, wh, bi, bh, reverse):
     """cuDNN-style split: time-batched input GEMM in XLA (MXU-tiled),
     sequential recurrence in the Pallas kernel (ops/pallas/rnn.py)."""
@@ -184,6 +236,7 @@ def _k_rnn(data, parameters, state, state_cell=None, key=None, *,
     is_lstm = mode == "lstm"
 
     pallas_lstm = is_lstm and _use_pallas_lstm()
+    pallas_gru = mode == "gru" and _use_pallas_gru()
     x = data
     h_states, c_states = [], []
     for layer in range(num_layers):
@@ -197,6 +250,9 @@ def _k_rnn(data, parameters, state, state_cell=None, key=None, *,
                 # kernel takes Wh as (4H, H); its step does dgp @ Wh
                 carry, ys = _pallas_lstm_dir(x, init, wi, wh, bi, bh,
                                              reverse=(dd == 1))
+            elif pallas_gru and _pallas_lstm_fits(N, H, G=3):
+                carry, ys = _pallas_gru_dir(x, init, wi, wh, bi, bh,
+                                            reverse=(dd == 1))
             else:
                 carry, ys = _scan_dir(step, x, init, wi, wh, bi, bh,
                                       reverse=(dd == 1))

@@ -129,6 +129,21 @@ def test_pallas_lstm_aot(dt):
         .astype(jnp.float32).sum(), xp)
 
 
+@pytest.mark.parametrize("dt", [jnp.float32, jnp.bfloat16],
+                         ids=["f32", "bf16"])
+def test_pallas_gru_aot(dt):
+    from mxnet_tpu.ops.pallas.rnn import gru_layer
+
+    T, N, H = 4, 16, 128
+    xp = jax.ShapeDtypeStruct((T, N, 3 * H), dt)
+    wh = jnp.zeros((3 * H, H), dt)
+    bh = jnp.zeros((3 * H,), dt)
+    h0 = jnp.zeros((N, H), dt)
+    _aot_grad_compile(
+        lambda a: gru_layer(a, wh, bh, h0)[0]
+        .astype(jnp.float32).sum(), xp)
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("family", ["resnet50", "bert_block"])
 def test_whole_graph_aot(family):

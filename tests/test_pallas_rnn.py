@@ -104,3 +104,98 @@ def test_rnn_op_pallas_impl_matches_scan(interpret_pallas, monkeypatch):
     gp = jax.grad(loss)(params, True)
     gs = jax.grad(loss)(params, False)
     np.testing.assert_allclose(gp, gs, rtol=2e-4, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# GRU (r5): same oracle pattern against ops/rnn.py _step_fn('gru')
+
+
+def _scan_gru(x_proj, wh, bh, h0):
+    def body(h, xp_t):
+        gh = h @ wh.T + bh
+        ir, iz, inn = jnp.split(xp_t, 3, axis=-1)
+        hr, hz, hn_l = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(ir + hr)
+        z = jax.nn.sigmoid(iz + hz)
+        n = jnp.tanh(inn + r * hn_l)
+        h = (1 - z) * n + z * h
+        return h, h
+
+    hn, ys = jax.lax.scan(body, h0, x_proj)
+    return ys, hn
+
+
+@pytest.mark.parametrize("T,N,H", [(5, 4, 8), (9, 2, 16), (7, 3, 40)])
+def test_gru_forward_parity(interpret_pallas, T, N, H):
+    from mxnet_tpu.ops.pallas.rnn import gru_layer
+
+    rng = np.random.RandomState(2)
+    xp = jnp.asarray(rng.randn(T, N, 3 * H), jnp.float32) * 0.5
+    wh = jnp.asarray(rng.randn(3 * H, H), jnp.float32) * 0.3
+    bh = jnp.asarray(rng.randn(3 * H), jnp.float32) * 0.1
+    h0 = jnp.asarray(rng.randn(N, H), jnp.float32) * 0.1
+
+    ys, hn = gru_layer(xp, wh, bh, h0)
+    ys_ref, hn_ref = _scan_gru(xp, wh, bh, h0)
+    np.testing.assert_allclose(ys, ys_ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(hn, hn_ref, rtol=1e-5, atol=1e-5)
+
+
+def test_gru_backward_parity(interpret_pallas):
+    from mxnet_tpu.ops.pallas.rnn import gru_layer
+
+    T, N, H = 6, 3, 8
+    rng = np.random.RandomState(3)
+    xp = jnp.asarray(rng.randn(T, N, 3 * H), jnp.float32) * 0.5
+    wh = jnp.asarray(rng.randn(3 * H, H), jnp.float32) * 0.3
+    bh = jnp.asarray(rng.randn(3 * H), jnp.float32) * 0.1
+    h0 = jnp.asarray(rng.randn(N, H), jnp.float32) * 0.1
+    wy = jnp.asarray(rng.randn(H,), jnp.float32)
+
+    def loss_pallas(xp, wh, bh, h0):
+        ys, hn = gru_layer(xp, wh, bh, h0)
+        return jnp.sum(ys @ wy) + jnp.sum(hn * hn)
+
+    def loss_ref(xp, wh, bh, h0):
+        ys, hn = _scan_gru(xp, wh, bh, h0)
+        return jnp.sum(ys @ wy) + jnp.sum(hn * hn)
+
+    gp = jax.grad(loss_pallas, argnums=(0, 1, 2, 3))(xp, wh, bh, h0)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(xp, wh, bh, h0)
+    for a, b, name in zip(gp, gr, ("dxp", "dwh", "dbh", "dh0")):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5,
+                                   err_msg=name)
+
+
+def test_gru_full_op_parity_forced_pallas(interpret_pallas, monkeypatch):
+    """The registered RNN op with mode='gru' through the forced-Pallas
+    path must equal the scan path (multi-layer + bidirectional)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    monkeypatch.setenv("MXTPU_RNN_IMPL", "pallas")
+    rng = np.random.RandomState(4)
+    T, N, I, H, L = 5, 3, 8, 8, 2
+    x = rng.randn(T, N, I).astype(np.float32)
+    d = 2
+    sizes = []
+    for layer in range(L):
+        inp = I if layer == 0 else H * d
+        for _ in range(d):
+            sizes.append(3 * H * inp)
+            sizes.append(3 * H * H)
+            sizes.append(3 * H)
+            sizes.append(3 * H)
+    params = rng.randn(sum(sizes)).astype(np.float32) * 0.2
+    h0 = np.zeros((L * d, N, H), np.float32)
+
+    out_p = nd.RNN(nd.array(x), nd.array(params), nd.array(h0),
+                   state_size=H, num_layers=L, mode="gru",
+                   bidirectional=True, state_outputs=True)
+    monkeypatch.setenv("MXTPU_RNN_IMPL", "scan")
+    out_s = nd.RNN(nd.array(x), nd.array(params), nd.array(h0),
+                   state_size=H, num_layers=L, mode="gru",
+                   bidirectional=True, state_outputs=True)
+    for a, b in zip(out_p, out_s):
+        np.testing.assert_allclose(a.asnumpy(), b.asnumpy(),
+                                   rtol=1e-5, atol=1e-5)

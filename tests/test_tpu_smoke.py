@@ -94,6 +94,20 @@ def test_pallas_lstm_compiles(dt):
                      .astype(jnp.float32).sum())).lower(xp).compile()
 
 
+@pytest.mark.parametrize("dt", [jnp.float32, jnp.bfloat16],
+                         ids=["f32", "bf16"])
+def test_pallas_gru_compiles(dt):
+    from mxnet_tpu.ops.pallas.rnn import gru_layer
+
+    T, N, H = 4, 16, 128
+    xp = jnp.zeros((T, N, 3 * H), dt)
+    wh = jnp.zeros((3 * H, H), dt)
+    bh = jnp.zeros((3 * H,), dt)
+    h0 = jnp.zeros((N, H), dt)
+    jax.jit(jax.grad(lambda a: gru_layer(a, wh, bh, h0)[0]
+                     .astype(jnp.float32).sum())).lower(xp).compile()
+
+
 def test_cpu_oracle_consistency_on_chip():
     """The reference's single most important test idea (SURVEY §4:
     check_consistency CPU-vs-GPU) on real hardware: the same ops on
