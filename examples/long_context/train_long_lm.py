@@ -1,0 +1,158 @@
+"""Long-context causal LM on one chip: streamed flash attention.
+
+The long-context product surface (SURVEY §5 long-context scaling; the
+reference's story stops at BucketingModule):
+
+- single chip: `F.scaled_dot_product_attention(causal=True)` routes to
+  the Pallas flash kernels — the RESIDENT kernels while K/V fit VMEM,
+  the STREAMED kernels (K/V swept by a grid dimension) beyond, so
+  `--seq 16384` and past compiles and trains where a materialized
+  (S,S) score matrix would blow HBM;
+- multi chip: the same model family scales by sequence parallelism —
+  see examples/pipeline_lm (PipelineLMTrainer's 'sp' axis, Ulysses
+  all-to-all) and parallel/ring_attention.py.
+
+Synthetic copy task: the second half of every sequence repeats the
+first half, and loss is masked to the second half only — so the ONLY
+way to reduce loss is attention across a seq/2 distance. Falling loss
+IS the long-context proof.
+
+  python examples/long_context/train_long_lm.py --cpu --seq 256 \
+      --steps 30
+  python examples/long_context/train_long_lm.py --seq 16384   # chip
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from _common import add_cpu_flag, apply_backend  # noqa: E402
+
+
+def build_model(vocab, units, heads, layers, seq):
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import HybridBlock, nn
+
+    class Block(HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.ln1 = nn.LayerNorm(in_channels=units)
+            self.qkv = nn.Dense(3 * units, flatten=False, use_bias=False)
+            self.proj = nn.Dense(units, flatten=False, use_bias=False)
+            self.ln2 = nn.LayerNorm(in_channels=units)
+            self.ff1 = nn.Dense(4 * units, flatten=False,
+                                activation="relu")
+            self.ff2 = nn.Dense(units, flatten=False)
+
+        def hybrid_forward(self, F, x):
+            b, s, _ = x.shape
+            h = heads
+            hd = units // h
+            qkv = self.qkv(self.ln1(x)).reshape(b, s, 3, h, hd)
+            q = qkv.slice_axis(2, 0, 1).reshape(b, s, h, hd) \
+                .transpose((0, 2, 1, 3))
+            k = qkv.slice_axis(2, 1, 2).reshape(b, s, h, hd) \
+                .transpose((0, 2, 1, 3))
+            v = qkv.slice_axis(2, 2, 3).reshape(b, s, h, hd) \
+                .transpose((0, 2, 1, 3))
+            att = F.scaled_dot_product_attention(q, k, v, causal=True)
+            att = att.transpose((0, 2, 1, 3)).reshape(b, s, units)
+            x = x + self.proj(att)
+            return x + self.ff2(self.ff1(self.ln2(x)))
+
+    class LongLM(HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.embed = nn.Embedding(vocab, units)
+            self.pos = nn.Embedding(seq, units)
+            self.blocks = nn.HybridSequential()
+            for _ in range(layers):
+                self.blocks.add(Block())
+            self.ln_f = nn.LayerNorm(in_channels=units)
+            self.head = nn.Dense(vocab, flatten=False)
+
+        def hybrid_forward(self, F, tokens, targets, loss_mask):
+            s = tokens.shape[1]
+            positions = F.arange(0, s, dtype="int32")
+            x = self.embed(tokens) + self.pos(positions)
+            x = self.blocks(x)
+            logits = self.head(self.ln_f(x))
+            lp = F.log_softmax(logits)
+            ll = F.pick(lp, targets, axis=-1)
+            return -F.sum(ll * loss_mask) / (F.sum(loss_mask) + 1e-6)
+
+    return LongLM()
+
+
+def copy_batch(rng, bs, seq, vocab):
+    """Second half repeats the first; loss only on the second half."""
+    import numpy as np
+
+    half = seq // 2
+    first = rng.randint(1, vocab, (bs, half))
+    tokens = np.concatenate([first, first], axis=1).astype(np.int32)
+    # next-token targets; the model must look back `half` positions
+    targets = np.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
+    mask = np.zeros((bs, seq), np.float32)
+    mask[:, half - 1:-1] = 1.0  # predictions whose target sits in half 2
+    return tokens, targets.astype(np.int32), mask
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq", type=int, default=256)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--vocab", type=int, default=64)
+    p.add_argument("--units", type=int, default=128)
+    p.add_argument("--heads", type=int, default=2)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--lr", type=float, default=3e-3)
+    add_cpu_flag(p)
+    args = p.parse_args()
+    apply_backend(args)
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import data_parallel
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    net = build_model(args.vocab, args.units, args.heads, args.layers,
+                      args.seq)
+    net.initialize(mx.init.Xavier())
+
+    class _Identity:
+        def __call__(self, out, _):
+            return out
+
+    trainer = data_parallel.DataParallelTrainer(
+        net, _Identity(), "adam", {"learning_rate": args.lr})
+
+    tokens, targets, mask = copy_batch(rng, args.batch_size, args.seq,
+                                       args.vocab)
+    y = np.zeros((args.batch_size,), np.float32)
+
+    first = None
+    tic = time.time()
+    for step in range(args.steps):
+        loss = trainer.step((tokens, targets, mask), y)
+        if step == 0:
+            loss.wait_to_read()
+            print(f"compile+step0 {time.time() - tic:.1f}s")
+        if step % 10 == 0 or step == args.steps - 1:
+            v = float(loss.asscalar())
+            first = v if first is None else first
+            print(f"step {step} copy-task loss {v:.4f}", flush=True)
+    print(f"done: {first:.4f} -> {v:.4f} at seq {args.seq} "
+          f"(attention distance {args.seq // 2})")
+
+
+if __name__ == "__main__":
+    main()
