@@ -54,7 +54,33 @@ class LogValidationMetricsCallback:
 
 
 def do_checkpoint(prefix, period=1):
-    """Epoch-end checkpoint callback (ref: mx.callback.do_checkpoint)."""
+    """Epoch-end checkpoint callback (ref: mx.callback.do_checkpoint).
+
+    `prefix` is either the legacy path prefix (compat shim: writes
+    ``prefix-symbol.json`` + ``prefix-NNNN.params`` exactly like the
+    reference, now atomically) or a ``checkpoint.CheckpointManager`` —
+    then every period-th epoch commits through the manager's atomic
+    step-tagged layout (symbol JSON in the manifest's ``extra``) with
+    retention and ``latest()``/``restore()`` resume.
+    """
+    from .checkpoint import CheckpointManager
+
+    if isinstance(prefix, CheckpointManager):
+        manager = prefix
+
+        def _manager_callback(iter_no, sym, arg, aux):
+            if (iter_no + 1) % period == 0:
+                payload = {f"arg:{k}": v for k, v in arg.items()}
+                payload.update({f"aux:{k}": v for k, v in aux.items()})
+                # sync: epoch-end cadence (legacy semantics), and the
+                # last epoch's callback may be the process's final act —
+                # an async failure there would never surface
+                manager.save(
+                    iter_no + 1, params=payload, epoch=iter_no + 1,
+                    extra={"symbol": sym.tojson()} if sym is not None
+                    else None, sync=True)
+
+        return _manager_callback
 
     def _callback(iter_no, sym, arg, aux):
         if (iter_no + 1) % period == 0:

@@ -1,0 +1,7 @@
+"""mxnet_tpu.checkpoint — atomic, async, resumable training checkpoints.
+
+See docs/checkpointing.md for the save/resume workflow, the sharded
+multi-process layout, retention, and the SIGTERM preemption hook.
+"""
+from .atomic import atomic_file, fsync_dir, fsync_file, write_json  # noqa: F401
+from .manager import MANIFEST, CheckpointManager, latest  # noqa: F401

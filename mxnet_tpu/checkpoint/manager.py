@@ -1,0 +1,564 @@
+"""Async fault-tolerant checkpointing — the subsystem behind
+``parallel/dist.py``'s restart advice ("restart the job from the last
+checkpoint").
+
+A checkpoint is a step-tagged directory::
+
+    <dir>/ckpt-00000042/
+        MANIFEST.json            format_version, step, epoch, files, extra
+        params-shard0.params     utils.serialization container (per process)
+        trainer-shard0.states    versioned Trainer states pickle
+        rng-shard0.json          mx.random.get_state() snapshot
+
+Commit protocol: every process writes its shard files into the shared
+``ckpt-<step>.tmp`` directory and fsyncs them; after a ``parallel/dist``
+barrier, process 0 writes the fsync'd manifest and renames the temp dir
+onto the final name (the atomic commit point), then fsyncs the parent.
+``latest()`` requires both the final name AND the manifest, so an
+interrupted save — killed at ANY point — is never resumable state; its
+``*.tmp`` leftovers are garbage-collected by the next successful commit.
+
+Saves are asynchronous: ``save()`` snapshots device-buffer *references*
+synchronously (XLA arrays are immutable — a later optimizer step rebinds
+``NDArray._data``, it never overwrites the snapshot), pushes the
+device→host readback onto the engine's ``d2h`` stream and the
+serialization + commit onto ``host_pool()``, so training continues while
+the previous checkpoint drains.  Errors surface at the
+``wait_until_finished()`` barrier, which also runs before the next save.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import os
+import pickle
+import re
+import shutil
+import signal
+import threading
+
+from .. import engine, profiler
+from .. import random as _random
+from ..base import MXNetError
+from . import atomic
+
+MANIFEST = "MANIFEST.json"
+
+
+def _rank():
+    from ..parallel import dist
+
+    try:
+        return dist.rank()
+    except Exception:  # jax backend not initialized yet: single process
+        return 0
+
+
+def _barrier(name):
+    from ..parallel import dist
+
+    try:
+        multi = dist.is_multiprocess()
+    except Exception:
+        multi = False
+    if multi:
+        dist.barrier(name)
+
+
+def _num_processes():
+    from ..parallel import dist
+
+    try:
+        return dist.num_workers()
+    except Exception:
+        return 1
+
+
+# -- snapshot trees ---------------------------------------------------------
+# Two phases so the expensive part never runs on the training thread:
+# _capture (sync, cheap) swaps NDArray leaves for their underlying
+# device buffers; _fetch (on the d2h stream) turns device buffers into
+# host numpy arrays.
+
+
+def _capture(obj):
+    from ..ndarray.ndarray import NDArray
+
+    if isinstance(obj, NDArray):
+        return obj._data
+    if isinstance(obj, dict):
+        return {k: _capture(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_capture(v) for v in obj)
+    return obj
+
+
+def _fetch(obj):
+    import jax
+    import numpy as np
+
+    if isinstance(obj, jax.Array):
+        return np.asarray(obj)
+    if isinstance(obj, dict):
+        return {k: _fetch(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_fetch(v) for v in obj)
+    return obj
+
+
+def _param_dict(params):
+    """Normalize a params target into name -> NDArray/Parameter/array."""
+    if params is None:
+        return None
+    if hasattr(params, "_collect_params_with_prefix"):  # gluon Block
+        return {k: v.data()
+                for k, v in params._collect_params_with_prefix().items()
+                if v._data is not None}
+    if isinstance(params, dict):
+        out = {}
+        for k, v in params.items():
+            out[k] = v.data() if hasattr(v, "_finish_deferred_init") else v
+        return out
+    raise MXNetError(
+        f"cannot checkpoint params of type {type(params).__name__}: "
+        "expected a gluon Block or a name->NDArray dict")
+
+
+class CheckpointManager:
+    """Atomic, async, resumable checkpoints (see module docstring).
+
+    Usage::
+
+        mgr = checkpoint.CheckpointManager("/ckpts", keep_n=3)
+        meta = mgr.restore(params=net, trainer=trainer) \
+            if mgr.latest() is not None else None   # auto-resume
+        for step in range(start, n_steps):
+            ...train...
+            if step % 100 == 0:
+                mgr.save(step, params=net, trainer=trainer)
+        mgr.wait_until_finished()
+    """
+
+    FORMAT_VERSION = 1
+
+    def __init__(self, directory, keep_n=5, prefix="ckpt", ctx=None):
+        self.directory = os.path.abspath(os.fspath(directory))
+        self.keep_n = int(keep_n) if keep_n else 0
+        self.prefix = prefix
+        self._step_re = re.compile(rf"^{re.escape(prefix)}-(\d+)$")
+        self._tmp_re = re.compile(rf"^{re.escape(prefix)}-(\d+)\.tmp$")
+        os.makedirs(self.directory, exist_ok=True)
+        if _rank() == 0:  # peers share the dir: exactly one healer
+            self._recover()
+        # peers must not scan (latest/restore) until the heal is done,
+        # else a kill inside a re-save's two-rename window lets rank 0
+        # resume the healed step N while others resume N-1 — silent
+        # cross-rank divergence
+        _barrier("checkpoint-init")
+        self._stream = engine.d2h_stream(ctx)
+        self._pending = None  # (step, future) of the in-flight save
+        self._hook_signum = None
+        self._prev_handler = None
+        self._state_fn = None
+
+    # -- discovery ----------------------------------------------------------
+
+    def steps(self):
+        """Committed checkpoint steps, ascending.  A directory without a
+        manifest (interrupted between mkdir and commit on a filesystem
+        with non-atomic dir rename) is NOT committed."""
+        out = []
+        for name in os.listdir(self.directory):
+            m = self._step_re.match(name)
+            if m and os.path.isfile(
+                    os.path.join(self.directory, name, MANIFEST)):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest(self):
+        """Newest committed step, or None when no checkpoint exists."""
+        s = self.steps()
+        return s[-1] if s else None
+
+    def _dir_for(self, step):
+        return os.path.join(self.directory, f"{self.prefix}-{step:08d}")
+
+    # -- save ---------------------------------------------------------------
+
+    def save(self, step, params=None, trainer=None, epoch=None, extra=None,
+             sync=False):
+        """Checkpoint `step` asynchronously; returns the commit future.
+
+        params : gluon Block or name->NDArray dict (optional)
+        trainer : gluon.Trainer (optional) — optimizer states + counters
+        extra : JSON-serializable user metadata stored in the manifest
+        sync : block until committed (always implied under NaiveEngine)
+
+        Blocks first on any still-draining previous save (the error
+        surfacing point) — at most one checkpoint is in flight.
+        """
+        # A SIGTERM landing between the wait_until_finished below and
+        # the _pending registration would re-enter save() from the
+        # preemption handler and start a second commit racing the
+        # half-scheduled one (shared tmp dir, .old juggling, _gc) —
+        # defer delivery across the critical section and hand the
+        # signal to the real handler once _pending is consistent.
+        deferred = []
+        prev_sig = None
+        if (self._hook_signum is not None
+                and threading.current_thread() is threading.main_thread()):
+            prev_sig = signal.getsignal(self._hook_signum)
+            signal.signal(self._hook_signum,
+                          lambda s, f: deferred.append(s))
+        try:
+            self.wait_until_finished()
+            step = int(step)
+            with profiler.op_scope("checkpoint.save.capture",
+                                   cat="checkpoint"):
+                state = {
+                    "params": _capture(_param_dict(params)),
+                    "trainer": (None if trainer is None
+                                else _capture(trainer.states_dict())),
+                    "rng": _random.get_state(),
+                }
+            meta = {"format_version": self.FORMAT_VERSION, "step": step,
+                    "epoch": epoch, "extra": extra,
+                    "num_processes": _num_processes()}
+            fetch_fut = self._stream.push(self._readback, state)
+            # chain the commit off the readback instead of parking a
+            # host_pool worker on fetch_fut.result() for the whole d2h
+            # drain (with CPU_WORKER_NTHREADS=1 that would stall the IO
+            # prefetcher behind every checkpoint)
+            fut = concurrent.futures.Future()
+
+            def _commit_when_read(ff):
+                def _run():
+                    try:
+                        fut.set_result(self._write_commit(ff, step, meta))
+                    except BaseException as e:  # noqa: BLE001 via future
+                        fut.set_exception(e)
+
+                engine.push_host(_run)
+
+            fetch_fut.add_done_callback(_commit_when_read)
+            self._pending = (step, fut)
+            # Multi-process: the commit path runs dist barriers (device
+            # collectives) — issuing those from a background thread
+            # while the main thread keeps enqueueing training
+            # collectives can interleave differently across processes
+            # and deadlock, so saves block until committed there; async
+            # overlap is a single-process (per-host-checkpoint)
+            # optimization for now.
+            if sync or engine.is_naive() or _num_processes() > 1:
+                self.wait_until_finished()
+        finally:
+            if prev_sig is not None:
+                signal.signal(self._hook_signum, prev_sig)
+                if deferred and callable(prev_sig):
+                    prev_sig(deferred[0], None)
+        return fut
+
+    def wait_until_finished(self):
+        """Barrier for the in-flight save; re-raises its error if the
+        async readback/serialization/commit failed.
+
+        ``_pending`` stays set until the result is in: a SIGTERM final
+        save arriving while the main thread is parked here re-enters
+        via the handler, still sees the in-flight save, and waits for
+        it — instead of starting a concurrent commit whose _gc could
+        delete the draining save's temp dir mid-write."""
+        pending = self._pending
+        if pending is None:
+            return
+        try:
+            pending[1].result()
+        finally:
+            if self._pending is pending:
+                self._pending = None
+
+    def _readback(self, state):
+        with profiler.op_scope("checkpoint.save.readback", cat="checkpoint"):
+            return _fetch(state)
+
+    def _write_commit(self, fetch_fut, step, meta):
+        with profiler.op_scope("checkpoint.save.commit", cat="checkpoint"):
+            state = fetch_fut.result()
+            rank = _rank()
+            tmp = self._dir_for(step) + ".tmp"
+            final = self._dir_for(step)
+            # a crashed earlier save at this step may have left stale
+            # shard files in tmp — committing them would smuggle a dead
+            # run's state into the manifest, so rank 0 clears first and
+            # a barrier orders the clear before any peer writes
+            if rank == 0 and os.path.isdir(tmp):
+                shutil.rmtree(tmp)
+            _barrier("checkpoint-clear")
+            os.makedirs(tmp, exist_ok=True)
+            if state["params"] is not None:
+                from ..utils import serialization
+
+                p = os.path.join(tmp, f"params-shard{rank}.params")
+                serialization.save_ndarrays(p, state["params"])
+                atomic.fsync_file(p)
+            if state["trainer"] is not None:
+                p = os.path.join(tmp, f"trainer-shard{rank}.states")
+                with open(p, "wb") as f:
+                    pickle.dump(state["trainer"], f)
+                atomic.fsync_file(p)
+            atomic.write_json(os.path.join(tmp, f"rng-shard{rank}.json"),
+                              state["rng"])
+            atomic.fsync_dir(tmp)
+            _barrier("checkpoint-save")
+            if rank == 0:
+                meta["files"] = sorted(os.listdir(tmp))
+                atomic.write_json(os.path.join(tmp, MANIFEST), meta)
+                old = None
+                if os.path.isdir(final):
+                    # re-save of the same step: never rmtree the
+                    # committed copy before the new one lands — park it
+                    # aside so a kill in this window loses nothing
+                    # (_recover renames it back if the commit never
+                    # happened)
+                    old = final + ".old"
+                    if os.path.isdir(old):
+                        shutil.rmtree(old)
+                    os.rename(final, old)
+                os.rename(tmp, final)  # the commit point
+                atomic.fsync_dir(self.directory)
+                if old is not None:
+                    shutil.rmtree(old, ignore_errors=True)
+            _barrier("checkpoint-commit")
+            if rank == 0:
+                self._gc(step)
+            return final
+
+    def _recover(self):
+        """Heal a kill inside a re-save's two-rename commit window: a
+        parked ``*.old`` whose final name is gone is the still-committed
+        copy — rename it back; one whose final exists is garbage."""
+        for name in os.listdir(self.directory):
+            if not (name.endswith(".old")
+                    and self._step_re.match(name[:-len(".old")])):
+                continue
+            src = os.path.join(self.directory, name)
+            base = src[:-len(".old")]
+            try:
+                if os.path.isdir(base):
+                    shutil.rmtree(src, ignore_errors=True)
+                else:
+                    os.rename(src, base)
+            except OSError:
+                pass  # a concurrent healer won the rename: fine
+
+    def _gc(self, current_step):
+        """Retention: drop committed checkpoints beyond keep_n and temp
+        leftovers of older interrupted saves."""
+        if self.keep_n:
+            for s in self.steps()[:-self.keep_n]:
+                shutil.rmtree(self._dir_for(s), ignore_errors=True)
+        for name in os.listdir(self.directory):
+            m = self._tmp_re.match(name)
+            if m and int(m.group(1)) < current_step:
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
+
+    # -- restore ------------------------------------------------------------
+
+    def restore(self, step=None, params=None, trainer=None,
+                restore_rng=True):
+        """Load checkpoint `step` (default: ``latest()``) in place.
+
+        params/trainer mirror ``save()`` targets; parameters load into
+        the Block/dict, optimizer states + update counters into the
+        Trainer, and the global RNG is rewound so the resumed run draws
+        the same stream the killed run would have.  Returns the manifest
+        metadata ``{"step", "epoch", "extra", "params"}`` — "params" is
+        the loaded name->NDArray dict only when no target was given.
+        """
+        self.wait_until_finished()
+        if step is None:
+            step = self.latest()
+        if step is None:
+            raise MXNetError(
+                f"no committed checkpoint under {self.directory}: nothing "
+                "to resume (an interrupted save's *.tmp directory does "
+                "not count)")
+        d = self._dir_for(int(step))
+        mpath = os.path.join(d, MANIFEST)
+        if not os.path.isfile(mpath):
+            raise MXNetError(
+                f"checkpoint step {step} under {self.directory} is "
+                "missing or uncommitted")
+        import json
+
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except ValueError as e:
+            raise MXNetError(
+                f"{mpath}: corrupt checkpoint manifest ({e}); this "
+                "should be impossible for a committed checkpoint — "
+                "restore an earlier step") from None
+        ver = manifest.get("format_version", 0)
+        if ver > self.FORMAT_VERSION:
+            raise MXNetError(
+                f"{mpath}: checkpoint format v{ver} was written by a "
+                f"newer mxnet_tpu (this build reads <= "
+                f"v{self.FORMAT_VERSION}); upgrade to restore it")
+        saved_procs = manifest.get("num_processes", 1)
+        if saved_procs != _num_processes():
+            raise MXNetError(
+                f"{mpath}: checkpoint was saved by {saved_procs} "
+                f"process(es) but this job runs {_num_processes()}; "
+                "per-rank shards do not re-partition across world "
+                "sizes — restore with the original topology")
+        rank = _rank()
+        with profiler.op_scope("checkpoint.restore", cat="checkpoint"):
+            loaded = self._restore_params(d, rank, params)
+            self._restore_trainer(d, rank, trainer)
+            if restore_rng:
+                rpath = os.path.join(d, f"rng-shard{rank}.json")
+                if os.path.isfile(rpath):
+                    with open(rpath) as f:
+                        _random.set_state(json.load(f))
+        return {"step": int(manifest["step"]),
+                "epoch": manifest.get("epoch"),
+                "extra": manifest.get("extra"),
+                "params": loaded}
+
+    def _restore_params(self, d, rank, params):
+        from ..utils import serialization
+
+        pfile = os.path.join(d, f"params-shard{rank}.params")
+        if not os.path.isfile(pfile):
+            if params is not None:
+                raise MXNetError(
+                    f"{d}: no parameter shard for process {rank} "
+                    f"(params-shard{rank}.params) — this step was "
+                    "saved without params=; pass step= an entry of "
+                    "steps() that has them")
+            return None
+        if params is not None and hasattr(params,
+                                          "_collect_params_with_prefix"):
+            # Block target: restore through the same validated dict
+            # path (Block.load_parameters would silently adopt
+            # mismatched shapes and can stop half-applied)
+            params = params._collect_params_with_prefix()
+        loaded = serialization.load_ndarrays(pfile)
+        if params is None:
+            return loaded
+        # dict target: validate EVERYTHING first, then apply — a caller
+        # catching a mismatch error must never be left half-restored
+        extra = set(loaded) - set(params)
+        if extra:
+            raise MXNetError(
+                f"{pfile}: checkpoint has parameters with no "
+                f"counterpart in the restore target: {sorted(extra)}")
+        missing = set(params) - set(loaded)
+        if missing:
+            raise MXNetError(
+                f"{pfile}: restore target has parameters missing from "
+                f"the checkpoint: {sorted(missing)}")
+        for name, arr in loaded.items():
+            tgt = params[name]
+            # Parameter.set_data would silently ADOPT a wrong shape
+            # (it re-assigns .shape), so pre-check it too; deferred
+            # dims (0, or a still-None shape) accept anything
+            shape = getattr(tgt, "shape", None)
+            if shape is not None and (
+                    len(shape) != len(arr.shape)
+                    or any(s and s != a
+                           for s, a in zip(shape, arr.shape))):
+                raise MXNetError(
+                    f"{pfile}: shape mismatch for {name!r}: checkpoint "
+                    f"{tuple(arr.shape)} vs target {tuple(shape)}")
+        for name, arr in loaded.items():
+            tgt = params[name]
+            if hasattr(tgt, "set_data"):  # Parameter
+                tgt.set_data(arr)
+            else:  # NDArray
+                tgt._data = arr._data
+        return None
+
+    def _restore_trainer(self, d, rank, trainer):
+        tfile = os.path.join(d, f"trainer-shard{rank}.states")
+        if trainer is None:
+            return
+        if not os.path.isfile(tfile):
+            raise MXNetError(
+                f"{d}: checkpoint has no trainer states for process "
+                f"{rank} (was it saved without trainer=?)")
+        with open(tfile, "rb") as f:
+            blob = pickle.load(f)
+        trainer.load_states_dict(blob, source=tfile)
+
+    # -- preemption ---------------------------------------------------------
+
+    def install_sigterm_hook(self, state_fn, signum=signal.SIGTERM):
+        """Final synchronous save on SIGTERM (preemption notice).
+
+        ``state_fn()`` returns the kwargs for ``save()`` — include
+        everything a resume needs, typically ``{"step": n, "params":
+        net, "trainer": trainer}`` (a params-less final save would
+        become ``latest()`` yet not be resumable into a net) — or None
+        to skip.  After the save the previous
+        handler is chained (or the default disposition re-raised), so
+        the process still terminates.  Main-process/main-thread only,
+        like any Python signal handler.
+        """
+
+        if self._hook_signum is not None:
+            # re-install = swap the state provider; never re-chain (the
+            # handler would chain to ITSELF and recurse on delivery)
+            if signum != self._hook_signum:
+                self.uninstall_sigterm_hook()
+            else:
+                self._state_fn = state_fn
+                return
+
+        self._state_fn = state_fn
+
+        def _handler(sig, frame):
+            try:
+                kwargs = self._state_fn()
+                if kwargs is not None:
+                    kwargs.setdefault("sync", True)
+                    self.save(**kwargs)
+            finally:
+                prev = self._prev_handler
+                if callable(prev):
+                    prev(sig, frame)
+                elif prev is None or prev == signal.SIG_DFL:
+                    # None = installed from C: we cannot chain to it,
+                    # but swallowing a termination request is worse —
+                    # re-raise the default disposition so the process
+                    # still dies (the supervisor would otherwise
+                    # escalate to SIGKILL mid-something-worse)
+                    signal.signal(sig, signal.SIG_DFL)
+                    os.kill(os.getpid(), sig)
+
+        self._prev_handler = signal.signal(signum, _handler)
+        self._hook_signum = signum
+
+    def uninstall_sigterm_hook(self):
+        if self._hook_signum is None:
+            return
+        signal.signal(self._hook_signum,
+                      self._prev_handler if self._prev_handler is not None
+                      else signal.SIG_DFL)
+        self._hook_signum = None
+        self._prev_handler = None
+        self._state_fn = None
+
+
+def latest(directory, prefix="ckpt"):
+    """Newest committed step under `directory`, or None — a pure
+    read-only scan (unlike constructing a CheckpointManager, which
+    heals interrupted re-saves), safe for monitors polling a live
+    training job's checkpoint dir."""
+    if not os.path.isdir(directory):
+        return None
+    rx = re.compile(rf"^{re.escape(prefix)}-(\d+)$")
+    steps = [int(m.group(1)) for name in os.listdir(directory)
+             if (m := rx.match(name))
+             and os.path.isfile(os.path.join(directory, name, MANIFEST))]
+    return max(steps) if steps else None

@@ -277,3 +277,10 @@ def stream_manager():
     if _stream_manager is None:
         _stream_manager = StreamManager()
     return _stream_manager
+
+
+def d2h_stream(ctx=None):
+    """The device→host readback lane for `ctx` — the stream checkpoint
+    saves and eval readbacks share so they stay FIFO among themselves
+    while overlapping compute and H2D staging."""
+    return stream_manager().get(ctx, "d2h")

@@ -163,36 +163,87 @@ class Trainer:
 
     # -- state io (ref: trainer.save_states/load_states) --------------------
 
-    def save_states(self, fname):
+    # Pickle-blob layout version.  v1 wraps the round-0 bare dict in
+    # {"version": 1, ...}; load_states rejects unversioned or newer
+    # blobs with an actionable error instead of a KeyError.
+    STATES_FORMAT_VERSION = 1
+
+    def states_dict(self):
+        """Versioned optimizer-state snapshot with device-resident
+        (NDArray) leaves — no host copy happens here, so the checkpoint
+        subsystem can capture buffer references synchronously and
+        schedule the readback on the engine's d2h lane.  The
+        update_on_kvstore path snapshots the server-side updater as an
+        opaque blob instead."""
         self._init_kvstore()
         if self._update_on_kvstore and self._kvstore is not None:
-            self._kvstore.save_optimizer_states(fname)
-            return
-        import pickle
-
-        from ..optimizer import _states_to_np
-
-        blob = {i: {str(c): _states_to_np(s) for c, s in (st or {}).items()}
+            if self._kvstore._updater is None:
+                raise MXNetError(
+                    "cannot snapshot optimizer states: this kvstore "
+                    "updates server-side with no local updater (async "
+                    "PS); checkpoint from rank 0 via "
+                    "kvstore.save_optimizer_states instead")
+            # the updater blob holds only the moment arrays — carry the
+            # shared optimizer's step counters too, else a resumed Adam
+            # re-applies its t=1 bias-correction warmup
+            return {"version": self.STATES_FORMAT_VERSION,
+                    "kvstore": self._kvstore._updater.get_states(),
+                    "num_update": self._optimizer.num_update,
+                    "index_update_count":
+                        dict(self._optimizer._index_update_count)}
+        blob = {i: {str(c): s for c, s in (st or {}).items()}
                 for i, st in enumerate(self._states)}
-        with open(fname, "wb") as f:
-            pickle.dump({"states": blob,
-                         "num_update": self._optimizer.num_update,
-                         "index_update_count":
-                             self._optimizer._index_update_count}, f)
+        return {"version": self.STATES_FORMAT_VERSION, "states": blob,
+                "num_update": self._optimizer.num_update,
+                "index_update_count":
+                    dict(self._optimizer._index_update_count)}
 
-    def load_states(self, fname):
+    def load_states_dict(self, blob, source="<states blob>"):
+        """Inverse of ``states_dict`` (leaves may be NDArray or numpy)."""
         self._init_kvstore()
-        if self._update_on_kvstore and self._kvstore is not None:
-            self._kvstore.load_optimizer_states(fname)
+        if isinstance(blob, dict) and "version" not in blob and set(
+                blob) == {"states", "num_update", "index_update_count"}:
+            # the round-0 layout is exactly v1 minus the version key —
+            # loading it is lossless, so don't strand old checkpoints
+            blob = dict(blob, version=self.STATES_FORMAT_VERSION)
+        if not isinstance(blob, dict) or "version" not in blob:
+            raise MXNetError(
+                f"{source}: unversioned Trainer states blob with an "
+                "unrecognized layout — not written by any "
+                "save_states; if it predates state versioning, load "
+                "the parameters alone and let the optimizer restart.")
+        if blob["version"] != self.STATES_FORMAT_VERSION:
+            raise MXNetError(
+                f"{source}: Trainer states format v{blob['version']} "
+                f"does not match this build's "
+                f"v{self.STATES_FORMAT_VERSION}; save and load with "
+                "matching mxnet_tpu versions.")
+        if "kvstore" in blob:
+            if (not (self._update_on_kvstore and self._kvstore is not None)
+                    or self._kvstore._updater is None):
+                raise MXNetError(
+                    f"{source}: states were saved from a kvstore-side "
+                    "updater but this Trainer has none (local updates, "
+                    "or an async PS that updates server-side); recreate "
+                    "it with a matching update_on_kvstore setup")
+            self._kvstore._updater.set_states(blob["kvstore"])
+            if "num_update" in blob:  # updater wraps this same object
+                self._optimizer.num_update = blob["num_update"]
+                self._optimizer._index_update_count = dict(
+                    blob["index_update_count"])
             return
-        import pickle
-
+        if self._update_on_kvstore and self._kvstore is not None:
+            raise MXNetError(
+                f"{source}: states were saved from a local-update "
+                "Trainer but this Trainer updates on the kvstore — "
+                "loading would silently leave the kvstore updater's "
+                "optimizer at step 0; recreate the Trainer with "
+                "update_on_kvstore=False to resume these states")
         from ..optimizer import _states_from_np
 
-        with open(fname, "rb") as f:
-            blob = pickle.load(f)
         self._optimizer.num_update = blob["num_update"]
-        self._optimizer._index_update_count = blob["index_update_count"]
+        self._optimizer._index_update_count = dict(
+            blob["index_update_count"])
         for i, p in enumerate(self._params):
             saved = blob["states"].get(i, {})
             if not saved:
@@ -202,3 +253,37 @@ class Trainer:
             for j, ctx in enumerate(p.list_ctx()):
                 v = vals[j] if j < len(vals) else vals[0]
                 self._states[i][ctx] = _states_from_np(v)
+
+    def save_states(self, fname):
+        self._init_kvstore()
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.save_optimizer_states(fname)
+            return
+        import pickle
+
+        from ..optimizer import _states_to_np
+
+        from ..checkpoint import atomic_file
+
+        payload = self.states_dict()
+        payload["states"] = {
+            i: {c: _states_to_np(s) for c, s in st.items()}
+            for i, st in payload["states"].items()}
+        # atomic commit: a kill mid-dump must not truncate the previous
+        # good states file under the published name
+        with atomic_file(fname) as tmp:
+            with open(tmp, "wb") as f:
+                pickle.dump(payload, f)
+
+    def load_states(self, fname):
+        self._init_kvstore()
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.load_optimizer_states(fname)
+            return
+        import pickle
+
+        with open(fname, "rb") as f:
+            blob = pickle.load(f)
+        self.load_states_dict(blob, source=fname)
+
+

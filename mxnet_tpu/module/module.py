@@ -443,11 +443,19 @@ class Module(BaseModule):
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
-    """Ref: mx.model.save_checkpoint format: -symbol.json + -NNNN.params."""
-    symbol.save(f"{prefix}-symbol.json")
+    """Ref: mx.model.save_checkpoint format: -symbol.json + -NNNN.params.
+
+    Both files commit via the checkpoint subsystem's atomic writer
+    (temp + fsync + rename), so a kill mid-save can never leave a
+    truncated file under the published name."""
+    from ..checkpoint import atomic_file
+
+    with atomic_file(f"{prefix}-symbol.json") as tmp:
+        symbol.save(tmp)
     payload = {f"arg:{k}": v for k, v in arg_params.items()}
     payload.update({f"aux:{k}": v for k, v in aux_params.items()})
-    _nd.save(f"{prefix}-{epoch:04d}.params", payload)
+    with atomic_file(f"{prefix}-{epoch:04d}.params") as tmp:
+        _nd.save(tmp, payload)
 
 
 def load_checkpoint(prefix, epoch):

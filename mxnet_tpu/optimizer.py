@@ -868,6 +868,8 @@ def _states_from_np(state):
         return None
     if isinstance(state, tuple):
         return tuple(_states_from_np(s) for s in state)
+    if isinstance(state, NDArray):
+        return state  # already device-resident (states_dict round trip)
     return _nd.array(state)
 
 

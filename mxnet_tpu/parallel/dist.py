@@ -83,8 +83,10 @@ def _peer_death_msg(prefix):
         f"{prefix} (process {jax.process_index()}/"
         f"{jax.process_count()}): a peer process is likely dead or "
         "partitioned. Check the other workers' logs, then restart the "
-        "job from the last checkpoint (Trainer states + parameters) "
-        "to resume.")
+        "job and resume from the last committed checkpoint: "
+        "mxnet_tpu.checkpoint.CheckpointManager(ckpt_dir)"
+        ".restore(params=net, trainer=trainer) picks the newest "
+        "complete snapshot (see docs/checkpointing.md).")
 
 
 def _raise_if_peer_death(e, what):

@@ -104,12 +104,48 @@ def spmd_jit(sharded_fn, mesh, in_specs, out_specs, **kwargs):
                      tuple(sorted(kwargs.items())))
 
 
+def shard_map():
+    """jax's shard_map across version drift: top-level in modern jax,
+    jax.experimental.shard_map before that."""
+    try:
+        from jax import shard_map as sm
+        return sm
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as sm
+
+        # the legacy check_rep analyzer predates pcast/vma annotations
+        # and rejects the cond/fori carries this code marks via pcast
+        # (an identity on these versions) — disable it; the collectives
+        # themselves are unchanged
+        return functools.partial(sm, check_rep=False)
+
+
+def pcast(x, axis_name, to):
+    """jax.lax.pcast across version drift: an annotation for the
+    varying-manual-axes type system in modern jax; identity on versions
+    without it (which also don't enforce vma, so skipping is sound)."""
+    import jax
+
+    fn = getattr(jax.lax, "pcast", None)
+    return x if fn is None else fn(x, axis_name, to=to)
+
+
+def vma(x):
+    """x's varying-manual-axes set; empty where jax lacks the vma type
+    system (there `pcast` is an identity, consistently)."""
+    import jax
+
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return frozenset()
+    return getattr(typeof(x), "vma", frozenset())
+
+
 @functools.lru_cache(maxsize=64)
 def _spmd_jit(sharded_fn, mesh, in_specs, out_specs, kwargs_items):
     import jax
-    from jax import shard_map
 
-    return jax.jit(shard_map(
+    return jax.jit(shard_map()(
         functools.partial(sharded_fn, **dict(kwargs_items)),
         mesh=mesh, in_specs=in_specs, out_specs=out_specs))
 

@@ -37,7 +37,9 @@ def _pipeline_sharded(params, xs_local, *, stage_fn, axis_name, n_micro,
     T = n_micro + P - 1
     # carries vary across the 'pp' axis (per-device state) — mark them
     # so shard_map's vma check accepts the fori_loop carry
-    acts, outs = jax.lax.pcast(
+    from . import mesh as _mesh_mod
+
+    acts, outs = _mesh_mod.pcast(
         (jnp.zeros_like(xs_local[0]), jnp.zeros_like(xs_local)),
         axis_name, to="varying")
 
@@ -74,10 +76,11 @@ def pipeline_apply(stage_fn, stacked_params, x, mesh, axis="pp",
     x: (B, ...) with B divisible by n_micro (n_micro >= 1; default P).
     Returns (B, ...) outputs (the composition of all stages).
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec
 
     from . import mesh as mesh_mod
+
+    shard_map = mesh_mod.shard_map()
 
     P = mesh.shape[axis]
     n_micro = P if n_micro is None else int(n_micro)

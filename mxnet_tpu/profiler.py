@@ -108,7 +108,7 @@ def _memory_sample():
     return sample
 
 
-def record_op(name, begin_us, end_us, shapes=None):
+def record_op(name, begin_us, end_us, shapes=None, cat="operator"):
     if not _running:
         return
     mem = _memory_sample() if _config.get("profile_memory") else None
@@ -117,7 +117,7 @@ def record_op(name, begin_us, end_us, shapes=None):
             "name": name, "ph": "X", "ts": begin_us,
             "dur": max(end_us - begin_us, 0.01),
             "pid": os.getpid(), "tid": threading.get_ident() % 100000,
-            "cat": "operator",
+            "cat": cat,
             "args": {"shapes": str(shapes)} if shapes else {},
         })
         if mem:
@@ -129,21 +129,25 @@ def record_op(name, begin_us, end_us, shapes=None):
 
 
 class _OpScope:
-    __slots__ = ("name", "t0")
+    __slots__ = ("name", "cat", "t0")
 
-    def __init__(self, name):
+    def __init__(self, name, cat="operator"):
         self.name = name
+        self.cat = cat
 
     def __enter__(self):
         self.t0 = time.perf_counter() * 1e6
         return self
 
     def __exit__(self, *a):
-        record_op(self.name, self.t0, time.perf_counter() * 1e6)
+        record_op(self.name, self.t0, time.perf_counter() * 1e6,
+                  cat=self.cat)
 
 
-def op_scope(name):
-    return _OpScope(name)
+def op_scope(name, cat="operator"):
+    """Trace bracket; `cat` groups rows in chrome://tracing (checkpoint
+    save/restore phases are tagged cat="checkpoint")."""
+    return _OpScope(name, cat)
 
 
 def dumps(reset=False, format="json"):

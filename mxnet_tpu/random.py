@@ -47,6 +47,35 @@ def seed(seed_state=None, ctx="all"):
         _np_rng.seed(int(seed_state) & 0x7FFFFFFF)
 
 
+def get_state():
+    """JSON-serializable snapshot of the global RNG — the threefry base
+    key + draw counter and the numpy initializer stream.  Restoring it
+    via ``set_state`` makes a resumed run draw the exact sequence the
+    interrupted run would have (used by checkpoint.CheckpointManager)."""
+    with _lock:
+        base = None if _base_key is None else np.asarray(_base_key).tolist()
+        mt = _np_rng.get_state()
+        return {"jax_base_key": base, "jax_counter": int(_counter),
+                "numpy": [mt[0], np.asarray(mt[1]).tolist(),
+                          int(mt[2]), int(mt[3]), float(mt[4])]}
+
+
+def set_state(state):
+    """Inverse of ``get_state``."""
+    global _base_key, _counter
+    import jax.numpy as jnp
+
+    with _lock:
+        base = state.get("jax_base_key")
+        _base_key = (None if base is None
+                     else jnp.asarray(np.asarray(base, dtype=np.uint32)))
+        _counter = int(state.get("jax_counter", 0))
+        mt = state.get("numpy")
+        if mt is not None:
+            _np_rng.set_state((mt[0], np.asarray(mt[1], dtype=np.uint32),
+                               int(mt[2]), int(mt[3]), float(mt[4])))
+
+
 # trace-local key stack: inside a hybrid graph capture, randomness must
 # derive from the graph's key INPUT (else the compiled executable would
 # bake the mask as a constant).  See gluon/block.py CachedOp.

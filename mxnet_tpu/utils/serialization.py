@@ -21,6 +21,21 @@ import numpy as np
 from ..base import MXNetError
 
 _MAGIC = b"MXTPU1\n"
+# Container format version, embedded in the JSON manifest.  Bump on any
+# layout change; the loader rejects newer-versioned files with an
+# actionable error instead of misparsing them.
+FORMAT_VERSION = 1
+
+
+def _read_exact(f, n, fname, what):
+    buf = f.read(n)
+    if len(buf) != n:
+        raise MXNetError(
+            f"{fname}: corrupt or truncated NDArray file — wanted "
+            f"{n} bytes for {what}, got {len(buf)} (was the writer "
+            "killed mid-save? use checkpoint.atomic_file / "
+            "CheckpointManager, which commit via temp-file + rename)")
+    return buf
 
 
 def _to_numpy(arr):
@@ -47,7 +62,7 @@ def save_ndarrays(fname, data):
         else:
             raise MXNetError(f"cannot save {type(data)}")
 
-    manifest = {"names": names,
+    manifest = {"version": FORMAT_VERSION, "names": names,
                 "tensors": [{"shape": list(a.shape), "dtype": str(a.dtype)}
                             for a in arrays]}
     mbytes = json.dumps(manifest).encode()
@@ -66,13 +81,27 @@ def load_ndarrays(fname):
         magic = f.read(len(_MAGIC))
         if magic != _MAGIC:
             raise MXNetError(f"{fname}: not an NDArray file (bad magic)")
-        (mlen,) = struct.unpack("<Q", f.read(8))
-        manifest = json.loads(f.read(mlen).decode())
+        (mlen,) = struct.unpack(
+            "<Q", _read_exact(f, 8, fname, "the manifest length"))
+        try:
+            manifest = json.loads(
+                _read_exact(f, mlen, fname, "the manifest").decode())
+        except ValueError as e:
+            raise MXNetError(
+                f"{fname}: corrupt NDArray file (unparseable manifest: "
+                f"{e})") from None
+        version = manifest.get("version", 1)
+        if version > FORMAT_VERSION:
+            raise MXNetError(
+                f"{fname}: NDArray container format v{version} was "
+                f"written by a newer mxnet_tpu (this build reads <= "
+                f"v{FORMAT_VERSION}); upgrade to load it")
         arrays = []
-        for t in manifest["tensors"]:
+        for i, t in enumerate(manifest["tensors"]):
             dt = np.dtype(t["dtype"])
             n = int(np.prod(t["shape"])) if t["shape"] else 1
-            buf = f.read(n * dt.itemsize)
+            buf = _read_exact(f, n * dt.itemsize, fname,
+                              f"tensor {i} of {len(manifest['tensors'])}")
             arrays.append(
                 array(np.frombuffer(buf, dtype=dt).reshape(t["shape"]),
                       dtype=dt))

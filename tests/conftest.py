@@ -20,13 +20,25 @@ if "host_platform_device_count" not in flags:
 _ON_TPU = os.environ.get("MXTPU_TEST_PLATFORM", "") == "tpu"
 if not _ON_TPU:
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # Off-TPU, libtpu's AOT topology path (tests/test_aot_tpu.py)
+    # queries the GCP instance metadata server for every TPU env var;
+    # when that endpoint 403s, each variable retries for minutes and
+    # collection appears to hang.  Skipping the metadata query keeps
+    # get_topology_desc purely local (~4s) with no behavior change.
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "true")
 os.environ.setdefault("MXTPU_TEST_SEED", "17")
 
 import jax  # noqa: E402
 
 if not _ON_TPU:
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        # older jax: no such option — the XLA_FLAGS
+        # --xla_force_host_platform_device_count=8 set above (before the
+        # jax import) already provides the 8-device virtual CPU mesh
+        pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -38,7 +38,9 @@ def test_c_frontend_drives_the_framework(tmp_path):
     r = subprocess.run(
         ["gcc", os.path.join(REPO, "tests", "capi_driver.c"),
          "-o", exe, "-L" + os.path.join(REPO, "lib"), "-lmxtpu_capi",
-         "-Wl,-rpath," + os.path.join(REPO, "lib")],
+         # the driver pthread_joins its own threads; toolchains that
+         # don't link libpthread implicitly need it spelled out
+         "-lpthread", "-Wl,-rpath," + os.path.join(REPO, "lib")],
         capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, r.stderr[-2000:]
 

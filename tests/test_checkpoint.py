@@ -1,0 +1,342 @@
+"""mxnet_tpu.checkpoint — atomic, async, resumable checkpoints.
+
+Covers the subsystem's contract: a training loop can be killed mid-run
+and resumed via restore(latest()) with bit-identical parameters,
+optimizer states, RNG stream, and step counter, in both ThreadedEngine
+and NaiveEngine modes; an interrupted (uncommitted) save is never
+selected by latest(); an async save on the d2h lane does not block
+concurrently pushed compute.
+"""
+import os
+import pickle
+import signal
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, checkpoint, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+@pytest.fixture
+def engine_mode():
+    """Restore the engine type a test switches."""
+    prev = mx.engine.engine_type()
+    yield mx.engine.set_engine_type
+    mx.engine.set_engine_type(prev)
+
+
+def _train(net, trainer, steps, x):
+    out = []
+    for _ in range(steps):
+        with autograd.record():
+            loss = net(x).sum()
+        loss.backward()
+        trainer.step(1)
+        out.append(float(loss.asnumpy()))
+    return out
+
+
+def _fresh(seed):
+    mx.random.seed(seed)
+    net = nn.Dense(4, in_units=3)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    return net, trainer
+
+
+@pytest.mark.parametrize("mode", ["ThreadedEngine", "NaiveEngine"])
+def test_save_kill_restore_roundtrip(tmp_path, engine_mode, mode):
+    """Acceptance: save → "kill" (fresh process stand-ins) → restore is
+    bit-identical for params, optimizer states, RNG, and step."""
+    engine_mode(mode)
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    net, trainer = _fresh(7)
+    _train(net, trainer, 3, x)
+    mgr = checkpoint.CheckpointManager(str(tmp_path), keep_n=3)
+    mgr.save(3, params=net, trainer=trainer, epoch=1, extra={"lr": 0.1})
+    mgr.wait_until_finished()
+
+    # the uninterrupted run continues: more steps + an RNG draw
+    w_saved = net.weight.data().asnumpy().copy()
+    cont_losses = _train(net, trainer, 2, x)
+    cont_draw = mx.random.uniform(shape=(3,)).asnumpy()
+
+    # "killed" run resumes in a fresh trainer with different init
+    net2, trainer2 = _fresh(999)
+    meta = mgr.restore(params=net2, trainer=trainer2)
+    assert meta["step"] == 3 and meta["epoch"] == 1
+    assert meta["extra"] == {"lr": 0.1}
+    assert np.array_equal(net2.weight.data().asnumpy(), w_saved)
+    assert trainer2._optimizer.num_update == 3
+    ctx = net2.weight.list_ctx()[0]
+    st = trainer2._states[0][ctx].asnumpy()
+    # momentum buffer restored bit-identically → identical trajectory
+    resumed_losses = _train(net2, trainer2, 2, x)
+    np.testing.assert_array_equal(resumed_losses, cont_losses)
+    resumed_draw = mx.random.uniform(shape=(3,)).asnumpy()
+    np.testing.assert_array_equal(resumed_draw, cont_draw)
+    assert st.shape == net2.weight.shape
+
+
+def test_uncommitted_save_never_latest(tmp_path):
+    mgr = checkpoint.CheckpointManager(str(tmp_path), keep_n=5)
+    mgr.save(4, params={"w": nd.ones((2, 2))}, sync=True)
+    # interrupted saves: a temp dir and a renamed dir missing its manifest
+    os.makedirs(str(tmp_path / "ckpt-00000009.tmp"))
+    os.makedirs(str(tmp_path / "ckpt-00000010"))
+    assert mgr.latest() == 4
+    assert mgr.steps() == [4]
+    with pytest.raises(mx.MXNetError, match="missing or uncommitted"):
+        mgr.restore(step=10)
+    assert checkpoint.latest(str(tmp_path)) == 4
+    assert checkpoint.latest(str(tmp_path / "nope")) is None
+
+
+def test_resave_same_step_never_loses_committed_copy(tmp_path):
+    """Re-saving an existing step parks the committed copy aside until
+    the new commit lands (no rmtree-before-rename window), and a kill
+    inside the two-rename window is healed by _recover."""
+    mgr = checkpoint.CheckpointManager(str(tmp_path), keep_n=3)
+    mgr.save(5, params={"w": nd.ones((2,))}, sync=True)
+    mgr.save(5, params={"w": nd.ones((2,)) * 2}, sync=True)  # re-save
+    tgt = {"w": nd.zeros((2,))}
+    mgr.restore(step=5, params=tgt)
+    assert np.allclose(tgt["w"].asnumpy(), 2.0)
+    assert not os.path.exists(str(tmp_path / "ckpt-00000005.old"))
+    # simulate the crash window: final renamed aside, commit never done
+    os.rename(str(tmp_path / "ckpt-00000005"),
+              str(tmp_path / "ckpt-00000005.old"))
+    assert checkpoint.CheckpointManager(str(tmp_path)).latest() == 5
+
+
+def test_restore_without_any_checkpoint_raises(tmp_path):
+    mgr = checkpoint.CheckpointManager(str(tmp_path))
+    with pytest.raises(mx.MXNetError, match="no committed checkpoint"):
+        mgr.restore()
+
+
+def test_keep_n_retention_and_tmp_gc(tmp_path):
+    mgr = checkpoint.CheckpointManager(str(tmp_path), keep_n=2)
+    stale = tmp_path / "ckpt-00000001.tmp"  # a crashed save's leftovers
+    os.makedirs(str(stale))
+    for s in range(1, 6):
+        mgr.save(s, params={"w": nd.ones((2,)) * s}, sync=True)
+    assert mgr.steps() == [4, 5]
+    assert not stale.exists(), "stale temp dir must be garbage-collected"
+    tgt = {"w": nd.zeros((2,))}
+    mgr.restore(params=tgt)
+    assert np.allclose(tgt["w"].asnumpy(), 5.0)
+
+
+def test_async_save_does_not_block_compute(tmp_path, engine_mode):
+    """Satellite: a CheckpointManager.save parked on the d2h stream must
+    not stall a concurrently pushed compute op (the whole point of the
+    d2h lane).  A gate blocks the d2h lane; compute completes and the
+    save future is still pending until the gate opens."""
+    engine_mode("ThreadedEngine")
+    mgr = checkpoint.CheckpointManager(str(tmp_path), keep_n=2)
+    gate = threading.Event()
+    mgr._stream.push(gate.wait)  # head-of-line blocker on the d2h lane
+    try:
+        fut = mgr.save(1, params={"w": nd.ones((16, 16))})
+        assert not fut.done()
+        # compute proceeds while the checkpoint drains behind the gate
+        val = float((nd.ones((32, 32)) * 3).sum().asnumpy())
+        assert val == 32 * 32 * 3
+        assert not fut.done(), "save must still be parked on the d2h lane"
+    finally:
+        gate.set()
+    mgr.wait_until_finished()
+    assert mgr.latest() == 1
+
+
+def test_async_save_error_surfaces_at_barrier(tmp_path):
+    """Errors from the async write surface at wait_until_finished (or
+    the next save), never silently; a failed save never commits."""
+    class Boom:
+        def __array__(self, *a, **k):
+            raise RuntimeError("boom: disk-side serialization failure")
+
+    mgr = checkpoint.CheckpointManager(str(tmp_path), keep_n=2)
+    mgr.save(1, params={"w": Boom()})  # fails during the async write
+    with pytest.raises(RuntimeError, match="boom"):
+        mgr.wait_until_finished()
+    assert mgr.latest() is None
+    # the barrier drained the failure: the next save succeeds
+    mgr.save(2, params={"w": nd.ones((2,))}, sync=True)
+    assert mgr.latest() == 2
+
+
+def test_sigterm_hook_final_save_and_chain(tmp_path):
+    """Preemption: SIGTERM triggers a final synchronous save, then the
+    previous handler still runs."""
+    chained = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: chained.append(s))
+    try:
+        mgr = checkpoint.CheckpointManager(str(tmp_path), keep_n=2)
+        mgr.install_sigterm_hook(
+            lambda: {"step": 3, "params": {"w": nd.ones((2,))}})
+        # re-install replaces the state provider WITHOUT re-chaining
+        # (a handler chained to itself would recurse on delivery)
+        mgr.install_sigterm_hook(
+            lambda: {"step": 11, "params": {"w": nd.ones((2,))}})
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert mgr.latest() == 11, "final save must be committed"
+        assert chained == [signal.SIGTERM]
+        mgr.uninstall_sigterm_hook()
+        # uninstalled: the old handler is back
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert chained == [signal.SIGTERM, signal.SIGTERM]
+        assert mgr.latest() == 11
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_trainer_states_blob_is_versioned(tmp_path):
+    x = nd.ones((2, 3))
+    net, trainer = _fresh(3)
+    _train(net, trainer, 1, x)
+    f = str(tmp_path / "t.states")
+    trainer.save_states(f)
+    with open(f, "rb") as fh:
+        blob = pickle.load(fh)
+    assert blob["version"] == gluon.Trainer.STATES_FORMAT_VERSION
+    # the write commits atomically: no temp droppings, and re-saving
+    # replaces the published name in one rename
+    trainer.save_states(f)
+    assert [n for n in os.listdir(str(tmp_path)) if ".tmp" in n] == []
+    trainer.load_states(f)
+
+
+def test_trainer_states_version_mismatch_rejected(tmp_path):
+    x = nd.ones((2, 3))
+    net, trainer = _fresh(3)
+    _train(net, trainer, 1, x)
+    legacy = str(tmp_path / "legacy.states")
+    with open(legacy, "wb") as f:  # round-0 layout: bare dict, no version
+        pickle.dump({"states": {}, "num_update": 7,
+                     "index_update_count": {}}, f)
+    trainer.load_states(legacy)  # identical to v1 minus the key: loads
+    assert trainer._optimizer.num_update == 7
+    bogus = str(tmp_path / "bogus.states")
+    with open(bogus, "wb") as f:  # unversioned AND unrecognized layout
+        pickle.dump({"weights": []}, f)
+    with pytest.raises(mx.MXNetError, match="unversioned"):
+        trainer.load_states(bogus)
+    newer = str(tmp_path / "newer.states")
+    with open(newer, "wb") as f:
+        pickle.dump({"version": 99, "states": {}}, f)
+    with pytest.raises(mx.MXNetError, match="v99"):
+        trainer.load_states(newer)
+
+
+def test_rng_state_roundtrip():
+    mx.random.seed(42)
+    mx.random.uniform(shape=(2,))  # advance the counter
+    snap = mx.random.get_state()
+    a = mx.random.uniform(shape=(4,)).asnumpy()
+    a_np = mx.random.np_rng().rand(3)
+    mx.random.seed(1)  # trash the stream
+    mx.random.set_state(snap)
+    b = mx.random.uniform(shape=(4,)).asnumpy()
+    b_np = mx.random.np_rng().rand(3)
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(a_np, b_np)
+
+
+def test_do_checkpoint_routes_through_manager(tmp_path):
+    """do_checkpoint accepts a CheckpointManager: epoch-end saves commit
+    through the atomic layout; the legacy prefix shim keeps writing the
+    reference's -symbol.json/-NNNN.params files."""
+    from mxnet_tpu import symbol as sym_mod
+
+    s = sym_mod.Variable("data") * 2
+    arg = {"w": nd.ones((2, 2))}
+    aux = {"m": nd.zeros((2,))}
+
+    mgr = checkpoint.CheckpointManager(str(tmp_path / "mgr"), keep_n=3)
+    cb = mx.callback.do_checkpoint(mgr, period=2)
+    cb(0, s, arg, aux)          # epoch 1: not a period boundary
+    mgr.wait_until_finished()
+    assert mgr.latest() is None
+    cb(1, s, arg, aux)          # epoch 2: commits
+    mgr.wait_until_finished()
+    assert mgr.latest() == 2
+    meta = mgr.restore()
+    assert set(meta["params"]) == {"arg:w", "aux:m"}
+    assert "symbol" in meta["extra"]
+
+    prefix = str(tmp_path / "legacy" / "model")
+    os.makedirs(str(tmp_path / "legacy"))
+    cb2 = mx.callback.do_checkpoint(prefix, period=1)
+    cb2(0, s, arg, aux)
+    assert os.path.exists(f"{prefix}-symbol.json")
+    assert os.path.exists(f"{prefix}-0001.params")
+    back = nd.load(f"{prefix}-0001.params")
+    assert set(back) == {"arg:w", "aux:m"}
+
+
+def test_module_save_checkpoint_atomic(tmp_path):
+    """module.save_checkpoint commits via the atomic writer: loadable
+    output, no temp droppings under the published names."""
+    from mxnet_tpu.module.module import load_checkpoint, save_checkpoint
+    from mxnet_tpu import symbol as sym_mod
+
+    s = sym_mod.Variable("data") * 2
+    prefix = str(tmp_path / "m")
+    save_checkpoint(prefix, 3, s, {"w": nd.ones((2, 2))},
+                    {"m": nd.zeros((2,))})
+    leftovers = [n for n in os.listdir(str(tmp_path)) if ".tmp" in n]
+    assert leftovers == []
+    sym2, arg2, aux2 = load_checkpoint(prefix, 3)
+    assert np.allclose(arg2["w"].asnumpy(), 1.0)
+    assert np.allclose(aux2["m"].asnumpy(), 0.0)
+
+
+def test_serialization_version_embedded_and_future_rejected(tmp_path):
+    import json
+    import struct
+
+    from mxnet_tpu.utils import serialization
+
+    f = str(tmp_path / "x.params")
+    serialization.save_ndarrays(f, {"a": nd.ones((2,))})
+    with open(f, "rb") as fh:
+        fh.read(len(serialization._MAGIC))
+        (mlen,) = struct.unpack("<Q", fh.read(8))
+        manifest = json.loads(fh.read(mlen).decode())
+    assert manifest["version"] == serialization.FORMAT_VERSION
+
+    # a file from a future format version is rejected, not misparsed
+    fut = str(tmp_path / "future.params")
+    m = json.dumps({"version": 99, "names": None, "tensors": []}).encode()
+    with open(fut, "wb") as fh:
+        fh.write(serialization._MAGIC)
+        fh.write(struct.pack("<Q", len(m)))
+        fh.write(m)
+    with pytest.raises(mx.MXNetError, match="v99"):
+        serialization.load_ndarrays(fut)
+
+
+def test_checkpoint_save_restore_profiled(tmp_path):
+    """Save/restore are bracketed as profiler op scopes (cat=checkpoint)."""
+    import json
+
+    from mxnet_tpu import profiler
+
+    profiler.reset()
+    profiler.start()
+    try:
+        mgr = checkpoint.CheckpointManager(str(tmp_path), keep_n=2)
+        mgr.save(1, params={"w": nd.ones((2,))}, sync=True)
+        mgr.restore()
+    finally:
+        profiler.stop()
+    events = json.loads(profiler.dumps(reset=True))["traceEvents"]
+    names = {e["name"] for e in events if e.get("cat") == "checkpoint"}
+    assert {"checkpoint.save.capture", "checkpoint.save.readback",
+            "checkpoint.save.commit", "checkpoint.restore"} <= names

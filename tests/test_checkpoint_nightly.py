@@ -1,0 +1,132 @@
+"""Nightly checkpoint stress (slow-marked; deselected from tier-1).
+
+Repeated save/restore churn under both engine modes, plus a real
+kill -9 mid-training-loop with resume from latest() — the end-to-end
+version of the fault-tolerance contract.
+"""
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, checkpoint, gluon, nd
+from mxnet_tpu.gluon import nn
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.mark.parametrize("mode", ["ThreadedEngine", "NaiveEngine"])
+def test_checkpoint_stress_repeated_save_restore(tmp_path, mode):
+    """20 rounds of train/save/restore churn: every restore is
+    bit-identical and retention holds the directory at keep_n."""
+    prev = mx.engine.engine_type()
+    mx.engine.set_engine_type(mode)
+    try:
+        mx.random.seed(5)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+        net.initialize(mx.init.Xavier())
+        trainer = gluon.Trainer(net.collect_params(), "adam",
+                                {"learning_rate": 0.01})
+        x = nd.array(np.random.RandomState(0).rand(8, 8)
+                     .astype(np.float32))
+        mgr = checkpoint.CheckpointManager(str(tmp_path), keep_n=3)
+        for step in range(1, 21):
+            with autograd.record():
+                loss = net(x).sum()
+            loss.backward()
+            trainer.step(1)
+            mgr.save(step, params=net, trainer=trainer)
+            if step % 5 == 0:
+                mgr.wait_until_finished()
+                w = {k: v.data().asnumpy().copy()
+                     for k, v in
+                     net._collect_params_with_prefix().items()}
+                net2 = nn.HybridSequential()
+                net2.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+                net2.initialize()
+                net2(x)  # materialize deferred shapes
+                trainer2 = gluon.Trainer(net2.collect_params(), "adam",
+                                         {"learning_rate": 0.01})
+                meta = mgr.restore(params=net2, trainer=trainer2)
+                assert meta["step"] == step
+                for k, v in net2._collect_params_with_prefix().items():
+                    np.testing.assert_array_equal(v.data().asnumpy(),
+                                                  w[k])
+                assert trainer2._optimizer.num_update == step
+        mgr.wait_until_finished()
+        assert len(mgr.steps()) == 3
+    finally:
+        mx.engine.set_engine_type(prev)
+
+
+_CHILD = r"""
+import sys
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, checkpoint, gluon, nd
+from mxnet_tpu.gluon import nn
+
+ckpt_dir = sys.argv[1]
+mx.random.seed(3)
+net = nn.Dense(8, in_units=8)
+net.initialize(mx.init.Xavier())
+trainer = gluon.Trainer(net.collect_params(), "sgd",
+                        {"learning_rate": 0.05, "momentum": 0.9})
+x = nd.array(np.random.RandomState(1).rand(4, 8).astype(np.float32))
+mgr = checkpoint.CheckpointManager(ckpt_dir, keep_n=3)
+step = 0
+print("READY", flush=True)
+while True:  # train until killed
+    step += 1
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(1)
+    mgr.save(step, params=net, trainer=trainer)
+"""
+
+
+def test_kill9_mid_run_then_resume(tmp_path):
+    """SIGKILL a training loop that checkpoints every step; the parent
+    resumes from latest() — which is always a complete snapshot."""
+    ckpt_dir = str(tmp_path / "ckpts")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen([sys.executable, "-c", _CHILD, ckpt_dir],
+                            stdout=subprocess.PIPE, env=env,
+                            cwd=os.path.dirname(os.path.dirname(
+                                os.path.abspath(__file__))))
+    try:
+        assert proc.stdout.readline().strip() == b"READY"
+        deadline = time.time() + 60
+        while checkpoint.latest(ckpt_dir) is None:
+            assert time.time() < deadline, "child made no checkpoint"
+            time.sleep(0.1)
+        time.sleep(0.5)  # let a save be mid-flight with high odds
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+
+    step = checkpoint.latest(ckpt_dir)
+    assert step is not None
+    mgr = checkpoint.CheckpointManager(ckpt_dir, keep_n=3)
+    net = nn.Dense(8, in_units=8)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9})
+    meta = mgr.restore(params=net, trainer=trainer)
+    assert meta["step"] == step
+    assert trainer._optimizer.num_update == step
+    assert np.all(np.isfinite(net.weight.data().asnumpy()))
+    # resumed training keeps working
+    x = nd.ones((4, 8))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(1)
+    assert trainer._optimizer.num_update == step + 1

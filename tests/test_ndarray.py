@@ -172,6 +172,29 @@ def test_save_load_list_dict(tmp_path):
     assert set(d) == {"arg:w", "aux:m"}
 
 
+def test_load_truncated_params_is_loud(tmp_path):
+    """Regression: a short read (writer killed mid-save) must raise a
+    clear corrupt/truncated MXNetError, not a raw struct/EOF error."""
+    import pytest
+
+    import mxnet_tpu as mx
+
+    f = str(tmp_path / "t.params")
+    nd.save(f, {"w": nd.ones((64, 64)), "b": nd.ones((64,))})
+    whole = open(f, "rb").read()
+    for cut in (len(whole) - 7,   # inside the last tensor
+                40,               # inside the manifest
+                10):              # inside the manifest-length header
+        with open(f, "wb") as fh:
+            fh.write(whole[:cut])
+        with pytest.raises(mx.MXNetError, match="corrupt or truncated"):
+            nd.load(f)
+    with open(f, "wb") as fh:     # wrong container entirely
+        fh.write(b"garbage-not-a-params-file")
+    with pytest.raises(mx.MXNetError, match="bad magic"):
+        nd.load(f)
+
+
 def test_concat_stack_split():
     a, b = nd.ones((2, 3)), nd.zeros((2, 3))
     c = nd.concat(a, b, dim=0)
