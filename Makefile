@@ -44,9 +44,15 @@ clean:
 test: all
 	python -m pytest tests/ -x -q
 
+# serving-tier gate: ModelServer on a tiny model, 100 requests,
+# stats invariants (served == submitted - rejected, closed compile
+# surface) — see tools/serve_smoke.py / docs/serving.md
+serve-smoke:
+	env PYTHONPATH=. python tools/serve_smoke.py
+
 # the ROADMAP tier-1 gate, verbatim ($$ = make-escaped shell $)
 verify: SHELL := /bin/bash
-verify:
+verify: serve-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
-.PHONY: all clean test verify
+.PHONY: all clean test verify serve-smoke

@@ -58,6 +58,7 @@ _LAZY = {
     "kvstore_server": ".kvstore_server",
     "model": ".model",
     "name": ".name",
+    "serve": ".serve",
     "executor": ".executor",
     "libinfo": ".libinfo",
     "log": ".log",

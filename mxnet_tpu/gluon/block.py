@@ -255,6 +255,29 @@ def is_tracing():
     return getattr(_tracing, "active", False)
 
 
+# Compiled-graph cache telemetry: a CachedOp call with an unseen input
+# signature (shapes/dtypes/train-flag) is a new XLA compile; a seen one
+# reuses the executable jax.jit already holds.  The serving tier's whole
+# bucket design rests on "zero compiles after warmup", so the split is
+# counted here — per CachedOp (ModelServer.stats()) and globally
+# (profiler dumps / tests).
+_graph_stats_lock = threading.Lock()
+_graph_stats = {"compiles": 0, "reuses": 0}
+
+
+def cached_graph_stats():
+    """Global compiled-graph cache counters across every CachedOp:
+    ``{"compiles": new-signature calls, "reuses": cache-hit calls}``."""
+    with _graph_stats_lock:
+        return dict(_graph_stats)
+
+
+def reset_cached_graph_stats():
+    with _graph_stats_lock:
+        _graph_stats["compiles"] = 0
+        _graph_stats["reuses"] = 0
+
+
 class CachedOp:
     """Compiles a HybridBlock's forward to one XLA computation.
 
@@ -266,6 +289,8 @@ class CachedOp:
         self.block = block
         self._fns = {}   # train_flag -> pure graph fn
         self._meta = {}  # train_flag -> (n_outs, aux_param_names, multi)
+        self._seen_sigs = set()  # (train, input shapes/dtypes) compiled
+        self.stats = {"compiles": 0, "reuses": 0}
 
     def release(self):
         """Evict this op's compiled executables from the global caches."""
@@ -275,6 +300,9 @@ class CachedOp:
             _imperative.evict(fn)
         self._fns.clear()
         self._meta.clear()  # stale meta must not outlive its graph fn
+        # evicted executables recompile on the next call — the counters
+        # must see those as fresh compiles, not reuses
+        self._seen_sigs.clear()
 
     def __del__(self):
         try:
@@ -344,9 +372,33 @@ class CachedOp:
                 param_nds.append(p.data(ctx))
             except MXNetError:
                 param_nds.append(p.data())
+        # jax.jit specializes per committed device and per static value,
+        # so the device and any non-NDArray inputs are part of what makes
+        # a compile fresh — omitting them would count real compiles (e.g.
+        # same shapes on a second ctx) as reuses
+        sig = (train, str(ctx),
+               tuple((i.shape, str(i.dtype)) if isinstance(i, NDArray)
+                     else repr(i) for i in inputs))
+        with _graph_stats_lock:
+            fresh_compile = sig not in self._seen_sigs
+            if fresh_compile:
+                self._seen_sigs.add(sig)
+                self.stats["compiles"] += 1
+                _graph_stats["compiles"] += 1
+            else:
+                self.stats["reuses"] += 1
+                _graph_stats["reuses"] += 1
         key_nd = _wrap(_random.next_key())
-        res = invoke(fn, key_nd, *param_nds, *inputs,
-                     _n_params=len(param_nds))
+        if fresh_compile:
+            from .. import profiler
+
+            with profiler.op_scope(f"cached_op.compile.{self.block.name}",
+                                   cat="cached_op"):
+                res = invoke(fn, key_nd, *param_nds, *inputs,
+                             _n_params=len(param_nds))
+        else:
+            res = invoke(fn, key_nd, *param_nds, *inputs,
+                         _n_params=len(param_nds))
         if not isinstance(res, tuple):
             res = (res,)
         n_outs, aux_names, treedef = self._meta[train]

@@ -1,5 +1,16 @@
-"""Evaluation metrics (ref: python/mxnet/metric.py)."""
+"""Evaluation metrics (ref: python/mxnet/metric.py).
+
+Thread safety: the serving tier (mxnet_tpu.serve) updates accuracy
+metrics from worker threads, so every metric instance carries an RLock
+and all state-touching entry points (``update``/``get``/``reset``,
+including subclass overrides — wrapped automatically via
+``__init_subclass__``) run under it.  Without this, the read-modify-
+write on ``sum_metric``/``num_inst`` drops updates under concurrency.
+"""
 from __future__ import annotations
+
+import functools
+import threading
 
 import numpy as np
 
@@ -7,6 +18,23 @@ from .base import Registry, MXNetError
 
 _registry = Registry("metric")
 register = _registry.register
+
+
+def _locked(method):
+    """Run a metric method under the instance lock (idempotent)."""
+    if getattr(method, "_metric_locked", False):
+        return method
+
+    @functools.wraps(method)
+    def wrapper(self, *args, **kwargs):
+        lock = getattr(self, "_lock", None)
+        if lock is None:  # during __init__, before the lock exists
+            return method(self, *args, **kwargs)
+        with lock:
+            return method(self, *args, **kwargs)
+
+    wrapper._metric_locked = True
+    return wrapper
 
 
 def _as_np(x):
@@ -22,14 +50,32 @@ def _to_list(x):
 
 
 class EvalMetric:
-    """Base metric (ref: mx.metric.EvalMetric)."""
+    """Base metric (ref: mx.metric.EvalMetric).  Safe for concurrent
+    ``update``/``get`` callers (see module docstring)."""
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        for name in ("update", "get", "reset"):
+            fn = cls.__dict__.get(name)
+            if callable(fn):
+                setattr(cls, name, _locked(fn))
 
     def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self._lock = threading.RLock()  # RLock: get() may call super().get()
         self.name = name
         self.output_names = output_names
         self.label_names = label_names
         self._kwargs = kwargs
         self.reset()
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state.pop("_lock", None)  # locks don't pickle
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.RLock()
 
     def reset(self):
         self.num_inst = 0
@@ -51,6 +97,13 @@ class EvalMetric:
 
     def __str__(self):
         return f"EvalMetric: {dict(self.get_name_value())}"
+
+
+# __init_subclass__ only sees subclasses — lock the base entry points
+# too, since most metrics inherit get()/reset() unchanged
+for _name in ("update", "get", "reset"):
+    setattr(EvalMetric, _name, _locked(EvalMetric.__dict__[_name]))
+del _name
 
 
 @register("acc")

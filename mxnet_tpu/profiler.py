@@ -150,6 +150,23 @@ def op_scope(name, cat="operator"):
     return _OpScope(name, cat)
 
 
+def _graph_cache_counters(reset=False):
+    """Compiled-graph cache compile/reuse split (gluon CachedOp) — only
+    when the gluon tier is actually loaded; importing it from here would
+    drag the whole frontend in for a profiler dump."""
+    import sys
+
+    block = sys.modules.get(__package__ + ".gluon.block")
+    if block is None:
+        return None
+    stats = block.cached_graph_stats()
+    if reset:
+        # a reset dump must scope EVERY section to the window, not mix
+        # per-window events with forever-cumulative compile counts
+        block.reset_cached_graph_stats()
+    return stats
+
+
 def dumps(reset=False, format="json"):
     """Return the trace (ref: mx.profiler.dumps).
 
@@ -172,6 +189,9 @@ def dumps(reset=False, format="json"):
             data["memoryPeaks"] = dict(_mem_peak)
         if reset:
             _events.clear()
+    graph = _graph_cache_counters(reset)
+    if graph is not None:
+        data["cachedGraph"] = graph
     return json.dumps(data)
 
 
@@ -207,6 +227,14 @@ def _aggregate_table(reset=False):
         lines.append("Memory Statistics (peak over profiled window):")
         for key, val in _mem_peak.items():
             lines.append(f"{key:<40}{val / 1e6:>14.3f} MB")
+    graph = _graph_cache_counters()
+    if graph is not None:
+        lines.append("")
+        lines.append("Compiled-Graph Cache (CachedOp):")
+        lines.append(f"{'graph compiles (new signature)':<40}"
+                     f"{graph['compiles']:>12}")
+        lines.append(f"{'graph reuses (cache hit)':<40}"
+                     f"{graph['reuses']:>12}")
     return "\n".join(lines)
 
 

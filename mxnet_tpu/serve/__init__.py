@@ -1,0 +1,20 @@
+"""mxnet_tpu.serve — dynamic-batching inference serving.
+
+See docs/serving.md for bucket selection, warmup, deadline and
+backpressure semantics, and the hot-reload workflow::
+
+    from mxnet_tpu import serve
+
+    spec = serve.BucketSpec(batch_sizes=(1, 4, 8),
+                            example_shape=(None, 64),
+                            lengths=(16, 32, 64))
+    with serve.ModelServer(net, spec, checkpoint="/ckpts") as srv:
+        fut = srv.submit(request_array, deadline_ms=50)
+        result = fut.result()
+        print(srv.stats())
+"""
+from .batcher import (Batcher, DeadlineExceededError,  # noqa: F401
+                      ServerClosedError, ServerOverloadedError)
+from .buckets import BucketOverflowError, BucketSpec  # noqa: F401
+from .server import ModelServer  # noqa: F401
+from .stats import LatencyWindow, ServerStats  # noqa: F401

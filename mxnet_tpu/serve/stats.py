@@ -1,0 +1,112 @@
+"""Serving observability: counters + latency percentiles.
+
+One :class:`ServerStats` instance rides inside each ``ModelServer``;
+every mutation happens under one lock so a snapshot is internally
+consistent (the ``served == submitted - rejected - pending`` invariant
+``make serve-smoke`` asserts would otherwise race).
+
+Latencies land in a bounded ring (newest ``capacity`` samples) — serving
+percentiles care about the recent window, and an unbounded list would
+grow forever under production traffic.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+
+class LatencyWindow:
+    """Fixed-capacity ring of latency samples with percentile readout."""
+
+    def __init__(self, capacity=4096):
+        self._buf = np.zeros(int(capacity), dtype=np.float64)
+        self._capacity = int(capacity)
+        self._n = 0  # total ever recorded
+
+    def record(self, value):
+        self._buf[self._n % self._capacity] = value
+        self._n += 1
+
+    def snapshot(self):
+        n = min(self._n, self._capacity)
+        if n == 0:
+            return {"count": 0, "p50_ms": None, "p95_ms": None,
+                    "p99_ms": None, "mean_ms": None, "max_ms": None}
+        window = self._buf[:n]
+        p50, p95, p99 = np.percentile(window, (50, 95, 99))
+        return {
+            "count": self._n,
+            "p50_ms": round(float(p50), 3),
+            "p95_ms": round(float(p95), 3),
+            "p99_ms": round(float(p99), 3),
+            "mean_ms": round(float(window.mean()), 3),
+            "max_ms": round(float(window.max()), 3),
+        }
+
+
+class ServerStats:
+    """All ModelServer counters behind one lock."""
+
+    def __init__(self, latency_capacity=4096):
+        self._lock = threading.Lock()
+        self.latency = LatencyWindow(latency_capacity)
+        self._c = {
+            "submitted": 0,
+            "served": 0,
+            "rejected_overload": 0,
+            "expired_deadline": 0,
+            "failed": 0,
+            "cancelled": 0,
+            "batches": 0,
+            "warmup_batches": 0,
+            "reloads": 0,
+        }
+        # batch-fill ratio = real requests / padded batch rows, the
+        # throughput-per-compile-surface figure of merit
+        self._fill_real = 0
+        self._fill_rows = 0
+        # padded elements / real elements along the variable axis
+        self._pad_real = 0
+        self._pad_padded = 0
+        self._bucket_hits = {}
+
+    # -- mutation -----------------------------------------------------------
+
+    def incr(self, name, n=1):
+        with self._lock:
+            self._c[name] += n
+
+    def record_batch(self, bucket_key, n_real, n_rows, real_elems,
+                     padded_elems):
+        with self._lock:
+            self._c["batches"] += 1
+            self._fill_real += n_real
+            self._fill_rows += n_rows
+            self._pad_real += real_elems
+            self._pad_padded += padded_elems
+            self._bucket_hits[bucket_key] = \
+                self._bucket_hits.get(bucket_key, 0) + 1
+
+    def record_latency(self, ms):
+        with self._lock:
+            self.latency.record(ms)
+
+    # -- readout ------------------------------------------------------------
+
+    def snapshot(self, queue_depth=0, in_flight=0, extra=None):
+        with self._lock:
+            snap = dict(self._c)
+            snap["queue_depth"] = int(queue_depth)
+            snap["in_flight"] = int(in_flight)
+            snap["batch_fill_ratio"] = (
+                round(self._fill_real / self._fill_rows, 4)
+                if self._fill_rows else None)
+            snap["padding_overhead"] = (
+                round(self._pad_padded / self._pad_real - 1.0, 4)
+                if self._pad_real else None)
+            snap["bucket_hits"] = dict(self._bucket_hits)
+            snap["latency"] = self.latency.snapshot()
+        if extra:
+            snap.update(extra)
+        return snap

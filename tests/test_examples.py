@@ -74,6 +74,9 @@ def test_train_ssd_toy():
      ["--steps", "4", "--batch-size", "8", "--seq-len", "16",
       "--d-model", "32", "--d-ff", "64", "--vocab", "64"],
      "final loss"),
+    ("serving", "serve_model.py",
+     ["--requests", "40", "--clients", "2", "--feat", "8"],
+     "post-warmup compiles: 0"),
 ])
 def test_sequence_examples(subdir, script, args, marker):
     out = _run_example(subdir, script, args)

@@ -1,0 +1,95 @@
+"""`make serve-smoke`: serving-tier CI gate.
+
+Starts a ModelServer on a tiny model, pushes 100 mixed-length requests
+through a deliberately small queue (so backpressure actually fires),
+drains, and asserts the stats invariants from docs/serving.md:
+
+    submitted == attempts - rejected_overload
+    served + expired + failed + cancelled == submitted
+    queue_depth == in_flight == 0            (after drain)
+    graph.post_warmup_compiles == 0          (closed compile surface)
+
+Exit code 0 = every invariant holds. Runs on the CPU backend so it is
+chip-independent.
+"""
+import json
+import sys
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import serve
+    from mxnet_tpu.gluon import nn
+
+    feat, attempts = 8, 100
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, flatten=False, in_units=feat, activation="relu"),
+            nn.Dense(4, flatten=False, in_units=16))
+    net.initialize(mx.init.Xavier())
+
+    lengths = (4, 8, 16)
+    spec = serve.BucketSpec(batch_sizes=(1, 2, 4),
+                            example_shape=(None, feat), lengths=lengths)
+    srv = serve.ModelServer(net, spec, max_queue=64, linger_ms=1.0)
+    srv.start()
+
+    rng = np.random.RandomState(0)
+    futs, rejected = [], 0
+    for _ in range(attempts):
+        x = rng.rand(int(rng.choice(lengths)), feat).astype(np.float32)
+        try:
+            futs.append(srv.submit(x))
+        except serve.ServerOverloadedError:
+            rejected += 1
+    for f in futs:
+        f.result(timeout=300)
+    srv.drain()
+    s = srv.stats()
+    print(json.dumps(s, default=str))
+
+    failures = []
+
+    def check(name, cond):
+        if not cond:
+            failures.append(name)
+
+    check("submitted == attempts - rejected",
+          s["submitted"] == attempts - rejected)
+    check("rejected counter matches caller-side rejects",
+          s["rejected_overload"] == rejected)
+    check("served accounts for every admitted request",
+          s["served"] + s["expired_deadline"] + s["failed"]
+          + s["cancelled"] == s["submitted"])
+    check("drain left zero queued work", s["queue_depth"] == 0)
+    check("drain left zero in-flight work", s["in_flight"] == 0)
+    check("zero post-warmup compiles",
+          s["graph"]["post_warmup_compiles"] == 0)
+    check("warmup covered the whole bucket grid",
+          s["warmup_batches"] == len(spec.bucket_shapes()))
+    check("every batch landed in a known bucket",
+          set(s["bucket_hits"]) <= {spec.key(b, l)
+                                    for b in spec.batch_sizes
+                                    for l in spec.lengths})
+    check("latency percentiles recorded",
+          s["latency"]["count"] == s["served"]
+          and s["latency"]["p99_ms"] is not None)
+
+    if failures:
+        print("serve-smoke FAILED: " + "; ".join(failures),
+              file=sys.stderr)
+        return 1
+    print(f"serve-smoke OK: {s['served']} served, {rejected} rejected "
+          f"by backpressure, fill={s['batch_fill_ratio']}, "
+          f"p99={s['latency']['p99_ms']}ms")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
