@@ -50,9 +50,15 @@ test: all
 serve-smoke:
 	env PYTHONPATH=. python tools/serve_smoke.py
 
+# step-fusion gate: 50 fused Trainer.step()s under a decaying LR
+# schedule with zero post-warmup compiles + fused/sequential bit
+# parity — see tools/step_fusion_smoke.py / docs/performance.md
+step-fusion-smoke:
+	env PYTHONPATH=. python tools/step_fusion_smoke.py
+
 # the ROADMAP tier-1 gate, verbatim ($$ = make-escaped shell $)
 verify: SHELL := /bin/bash
-verify: serve-smoke
+verify: serve-smoke step-fusion-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
-.PHONY: all clean test verify serve-smoke
+.PHONY: all clean test verify serve-smoke step-fusion-smoke

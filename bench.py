@@ -451,8 +451,93 @@ def _leaf_serve(platform):
     }))
 
 
+def _leaf_trainer_step(platform):
+    """Fused-step A/B (gluon.Trainer): step latency + per-step dispatch
+    count for the fused multi-tensor path vs aggregate_num=1 (today's
+    sequential behavior) on a ~100-parameter model, plus the
+    no-recompile check across a decaying LR schedule."""
+    jax = _leaf_setup(platform)
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import _imperative, autograd, gluon, lr_scheduler, nd
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon import trainer as trainer_mod
+
+    n_layers, units, iters, windows = 50, 16, 30, 3
+
+    # the A/B must control its own aggregation size: the env knob beats
+    # the aggregate_num ctor arg by documented precedence, so an
+    # exported MXNET_OPTIMIZER_AGGREGATION_SIZE would silently turn
+    # both arms into the same configuration (leaves run in their own
+    # subprocess, so popping is side-effect free)
+    for _var in ("MXNET_OPTIMIZER_AGGREGATION_SIZE",
+                 "MXTPU_OPTIMIZER_AGGREGATION_SIZE"):
+        os.environ.pop(_var, None)
+
+    def measure(aggregate_num):
+        mx.random.seed(0)
+        np.random.seed(0)
+        net = nn.HybridSequential()
+        for _ in range(n_layers):
+            net.add(nn.Dense(units, in_units=units))
+        net.initialize(mx.init.Xavier())
+        sched = lr_scheduler.FactorScheduler(step=5, factor=0.97,
+                                             base_lr=0.1)
+        kwargs = {"learning_rate": 0.1, "momentum": 0.9,
+                  "lr_scheduler": sched}
+        if aggregate_num is not None:
+            kwargs["aggregate_num"] = aggregate_num
+        trainer = gluon.Trainer(net.collect_params(), "sgd", kwargs)
+        x = nd.array(np.random.rand(8, units).astype(np.float32))
+        with autograd.record():
+            loss = net(x).sum()
+        loss.backward()
+        for _ in range(5):
+            trainer.step(1)
+        nd.waitall()
+        trainer_mod.reset_trainer_step_stats()
+        c0 = _imperative.compiled_executable_count()
+        best = None
+        for _ in range(windows):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                trainer.step(1)
+            nd.waitall()
+            dt = (time.perf_counter() - t0) / iters
+            best = dt if best is None or dt < best else best
+        compiles = _imperative.compiled_executable_count() - c0
+        return best, trainer_mod.trainer_step_stats(), compiles
+
+    n_params = 2 * n_layers
+    fused_s, fused_stats, fused_compiles = measure(None)
+    seq_s, seq_stats, _ = measure(1)
+
+    dev = jax.devices()[0]
+    print(json.dumps({
+        "metric": "trainer_step_latency",
+        "value": round(fused_s * 1e3, 3),
+        "unit": "ms/step",
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "n_params": n_params,
+        "sequential_ms_per_step": round(seq_s * 1e3, 3),
+        "speedup_vs_sequential": round(seq_s / fused_s, 4),
+        "dispatches_per_step_fused": fused_stats["dispatches_per_step"],
+        "dispatches_per_step_sequential":
+            seq_stats["dispatches_per_step"],
+        "dispatch_reduction": round(
+            seq_stats["dispatches_per_step"]
+            / max(fused_stats["dispatches_per_step"], 1e-9), 2),
+        "params_fused_per_step": round(
+            fused_stats["params_fused"] / max(fused_stats["steps"], 1), 1),
+        "post_warmup_compiles": fused_compiles,
+    }))
+
+
 _LEAVES = {"resnet": _leaf_resnet, "bert": _leaf_bert,
-           "serve": _leaf_serve}
+           "serve": _leaf_serve, "trainer_step": _leaf_trainer_step}
 
 
 # ---------------------------------------------------------------------------
@@ -578,9 +663,9 @@ def main():
     # tpu-dead latch must not have already demoted the primary metric
     # to CPU on a healthy chip
     records = {}
-    # serve last: its record is a satellite of the two north-star
-    # workloads and must never delay or demote them
-    for model in ("bert", "resnet", "serve"):
+    # serve/trainer_step last: their records are satellites of the two
+    # north-star workloads and must never delay or demote them
+    for model in ("bert", "resnet", "serve", "trainer_step"):
         rec, tpu_ok = _measure(model, tpu_ok, note)
         if rec is not None:
             records[model] = rec

@@ -41,16 +41,24 @@ def _attrs_key(kwargs):
             f"op attributes must be hashable, got {kwargs!r}") from e
 
 
-def get_jitted(fn, kwargs):
+def get_jitted(fn, kwargs, donate_argnums=None):
     # hot path: attr-less ops (all elementwise arithmetic) skip the
     # sort entirely
     key = (fn, ()) if not kwargs else (fn, _attrs_key(kwargs))
+    if donate_argnums is not None:
+        # fused multi-tensor updates donate their weight/state buffers
+        # (XLA aliases in place of allocating a second copy of the
+        # model); a distinct 3-tuple key keeps them out of the 2-tuple
+        # eager fast path while still counting toward
+        # compiled_executable_count()
+        key = key + (tuple(donate_argnums),)
     jitted = _jit_cache.get(key)
     if jitted is None:
-        if kwargs:
-            jitted = jax.jit(functools.partial(fn, **dict(kwargs)))
+        closed = functools.partial(fn, **dict(kwargs)) if kwargs else fn
+        if donate_argnums is not None:
+            jitted = jax.jit(closed, donate_argnums=tuple(donate_argnums))
         else:
-            jitted = jax.jit(fn)
+            jitted = jax.jit(closed)
         _jit_cache[key] = jitted
     return jitted
 

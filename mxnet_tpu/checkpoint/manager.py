@@ -212,18 +212,29 @@ class CheckpointManager:
         try:
             self.wait_until_finished()
             step = int(step)
-            with profiler.op_scope("checkpoint.save.capture",
-                                   cat="checkpoint"):
-                state = {
-                    "params": _capture(_param_dict(params)),
-                    "trainer": (None if trainer is None
-                                else _capture(trainer.states_dict())),
-                    "rng": _random.get_state(),
-                }
-            meta = {"format_version": self.FORMAT_VERSION, "step": step,
-                    "epoch": epoch, "extra": extra,
-                    "num_processes": _num_processes()}
-            fetch_fut = self._stream.push(self._readback, state)
+            # the captured device-buffer references must survive until
+            # the d2h readback drains: hold off buffer DONATION (the
+            # fused optimizer step would otherwise delete them on the
+            # very next Trainer.step) from capture to fetch-complete
+            engine.acquire_donation_hold()
+            try:
+                with profiler.op_scope("checkpoint.save.capture",
+                                       cat="checkpoint"):
+                    state = {
+                        "params": _capture(_param_dict(params)),
+                        "trainer": (None if trainer is None
+                                    else _capture(trainer.states_dict())),
+                        "rng": _random.get_state(),
+                    }
+                meta = {"format_version": self.FORMAT_VERSION,
+                        "step": step, "epoch": epoch, "extra": extra,
+                        "num_processes": _num_processes()}
+                fetch_fut = self._stream.push(self._readback, state)
+            except BaseException:
+                engine.release_donation_hold()
+                raise
+            fetch_fut.add_done_callback(
+                lambda _f: engine.release_donation_hold())
             # chain the commit off the readback instead of parking a
             # host_pool worker on fetch_fut.result() for the whole d2h
             # drain (with CPU_WORKER_NTHREADS=1 that would stall the IO

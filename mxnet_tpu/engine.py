@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import atexit
 import concurrent.futures
+import contextlib
 import threading
 
 from .base import getenv
@@ -284,3 +285,107 @@ def d2h_stream(ctx=None):
     saves and eval readbacks share so they stay FIFO among themselves
     while overlapping compute and H2D staging."""
     return stream_manager().get(ctx, "d2h")
+
+
+# ---------------------------------------------------------------------------
+# Flat-buffer staging (the fused trainer-step tier; ref: the reference's
+# aggregate multi_sgd updates + the bucketed gradient fusion the
+# redistribution paper motivates): packing N small same-dtype tensors
+# into ONE flat buffer turns N tiny XLA dispatches / collectives into a
+# single large one.  The pack/unpack kernels are jitted through the
+# standard executable cache (_imperative.get_jitted) so they share the
+# no-recompile accounting every other op gets.
+
+
+def _k_flatten(ts):
+    """ONE dispatch: many buffers -> one flat buffer (same dtype)."""
+    import jax.numpy as jnp
+
+    if len(ts) == 1:
+        return jnp.ravel(ts[0])
+    return jnp.concatenate([jnp.ravel(t) for t in ts])
+
+
+def _k_unflatten(flat, *, shapes):
+    """ONE dispatch: one flat buffer -> per-tensor views of `shapes`."""
+    import jax.numpy as jnp
+
+    outs, off = [], 0
+    for shp in shapes:
+        n = 1
+        for s in shp:
+            n *= int(s)
+        outs.append(jnp.reshape(flat[off:off + n], shp))
+        off += n
+    return tuple(outs)
+
+
+def flatten_arrays(jarrs):
+    """Pack raw jax buffers (same device, same dtype) into one flat
+    buffer with a single cached-executable dispatch."""
+    from . import _imperative
+
+    return track(_imperative.get_jitted(_k_flatten, {})(list(jarrs)))
+
+
+def unflatten_array(flat, shapes):
+    """Inverse of :func:`flatten_arrays`: one dispatch yielding the
+    per-tensor slices reshaped to ``shapes``."""
+    from . import _imperative
+
+    outs = _imperative.get_jitted(
+        _k_unflatten, {"shapes": tuple(tuple(int(s) for s in shp)
+                                       for shp in shapes)})(flat)
+    return [track(o) for o in outs]
+
+
+def batched_put(jarrs, device):
+    """One transfer submission moving every buffer in ``jarrs`` to
+    ``device`` (ref: CopyFromTo batched per destination) — the replica
+    broadcast uses this instead of a per-parameter device_put loop."""
+    import jax
+
+    outs = jax.device_put(list(jarrs), device)
+    return [track(o) for o in outs]
+
+
+# Donation coordination: the async checkpoint tier snapshots live
+# device-buffer REFERENCES and reads them back later on the d2h stream,
+# relying on XLA arrays being immutable.  Buffer DONATION (the fused
+# optimizer step on accelerator backends) voids that — a donated buffer
+# is deleted after the call.  While any hold is active, donating
+# consumers must fall back to their non-donating executables so held
+# references survive the readback window.
+
+_donation_holds = 0
+# RLock: the SIGTERM final-save hook may fire while the training thread
+# sits inside donation_dispatch_guard — its synchronous save must be
+# able to re-enter from the same thread (it completes, readback and
+# all, before the guarded dispatch resumes, so the snapshot is safe)
+_donation_mu = threading.RLock()
+
+
+def acquire_donation_hold():
+    global _donation_holds
+    with _donation_mu:
+        _donation_holds += 1
+
+
+def release_donation_hold():
+    global _donation_holds
+    with _donation_mu:
+        _donation_holds = max(0, _donation_holds - 1)
+
+
+@contextlib.contextmanager
+def donation_dispatch_guard():
+    """Make a donating dispatch atomic w.r.t. acquire_donation_hold():
+    a checkpoint capture on ANOTHER thread cannot slip between the
+    hold check and the donating executable call and snapshot buffers
+    that are about to be deleted.  Yields whether a hold is active."""
+    with _donation_mu:
+        yield _donation_holds > 0
+
+
+def donation_held():
+    return _donation_holds > 0

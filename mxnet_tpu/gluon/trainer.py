@@ -8,10 +8,36 @@ pod unchanged").
 """
 from __future__ import annotations
 
+from .. import engine as _engine
 from .. import kvstore as _kvstore
 from .. import optimizer as _opt
+from .. import profiler as _profiler
 from ..base import MXNetError
 from .parameter import Parameter, ParameterDict
+
+# ---------------------------------------------------------------------------
+# step-fusion window counters (surfaced as the "trainerStep" section of
+# profiler.dumps(), window-scoped under reset=True like cachedGraph)
+
+_step_stats = {"steps": 0, "params_fused": 0, "buckets_built": 0,
+               "dispatches": 0}
+
+
+def trainer_step_stats():
+    """Aggregate Trainer.step() fusion counters since the last reset:
+    steps, params_fused (params that rode a multi-tensor update call),
+    buckets_built (flat allreduce buckets), dispatches (device
+    submissions: update kernels + collectives + replica transfers), and
+    the derived dispatches_per_step."""
+    s = dict(_step_stats)
+    s["dispatches_per_step"] = (round(s["dispatches"] / s["steps"], 2)
+                                if s["steps"] else 0.0)
+    return s
+
+
+def reset_trainer_step_stats():
+    for k in _step_stats:
+        _step_stats[k] = 0
 
 
 class Trainer:
@@ -37,6 +63,10 @@ class Trainer:
         self._states = [None] * len(self._params)
         self._kv_initialized = False
         self._contexts = None
+        # per-step fusion accounting (published into _step_stats by step)
+        self._dispatches = 0
+        self._buckets = 0
+        self._params_fused = 0
 
     @property
     def learning_rate(self):
@@ -86,8 +116,19 @@ class Trainer:
                 "dynamic loss scaling (amp.scale_loss) is not supported "
                 "with update_on_kvstore; use update_on_kvstore=False")
         self._optimizer.rescale_grad = self._scale / batch_size
+        self._dispatches = self._buckets = self._params_fused = 0
         self._allreduce_grads()
         self._update(ignore_stale_grad)
+        _step_stats["steps"] += 1
+        _step_stats["dispatches"] += self._dispatches
+        _step_stats["buckets_built"] += self._buckets
+        _step_stats["params_fused"] += self._params_fused
+
+    def _fusion_enabled(self):
+        """The fused step is ON by default; aggregate_num=1 (or
+        MXNET_OPTIMIZER_AGGREGATION_SIZE=1) restores the sequential
+        one-dispatch-per-parameter behavior exactly."""
+        return getattr(self._optimizer, "aggregate_num", 1) > 1
 
     def allreduce_grads(self):
         self._init_kvstore()
@@ -99,17 +140,43 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
-        for i, p in enumerate(self._params):
-            grads = p.list_grad()
-            if self._update_on_kvstore:
+        if self._update_on_kvstore:
+            for i, p in enumerate(self._params):
+                grads = p.list_grad()
                 # push grads; server applies optimizer; pull new weights
                 self._kvstore.push(i, grads)
                 self._kvstore.pull(i, out=p.list_data())
-            else:
-                self._kvstore.pushpull(i, grads, out=grads)
-                # write reduced grad back into each replica's holder
+                # one server-side optimizer update + a reduce add and a
+                # pull transfer per EXTRA replica (single-replica rebinds
+                # are free)
+                self._dispatches += 2 * len(grads) - 1
+            return
+        if self._fusion_enabled() and len(self._params) > 1:
+            # fused path: submit EVERY param in one multi-key pushpull;
+            # the kvstore packs same-dtype grads into flat buckets and
+            # runs one allreduce per bucket
+            grads_per_key = [p.list_grad() for p in self._params]
+            with _profiler.op_scope("allreduce", cat="trainer"):
+                kvs = self._kvstore.pushpull(
+                    list(range(len(self._params))), grads_per_key,
+                    out=grads_per_key)
+            if kvs:
+                self._dispatches += kvs["dispatches"]
+                self._buckets += kvs["buckets"]
+            for p, grads in zip(self._params, grads_per_key):
                 for ctx, g in zip(p.list_ctx(), grads):
                     p._data[ctx]._grad = g
+            return
+        for i, p in enumerate(self._params):
+            grads = p.list_grad()
+            with _profiler.op_scope("allreduce", cat="trainer"):
+                self._kvstore.pushpull(i, grads, out=grads)
+            # a reduce add + a pull transfer per EXTRA replica; the
+            # single-replica case rebinds without any device work
+            self._dispatches += 2 * (len(grads) - 1)
+            # write reduced grad back into each replica's holder
+            for ctx, g in zip(p.list_ctx(), grads):
+                p._data[ctx]._grad = g
 
     def update(self, batch_size, ignore_stale_grad=False):
         self._init_kvstore()
@@ -133,33 +200,77 @@ class Trainer:
             self._scale = self._amp_original_scale / scaler.loss_scale
             if skip:
                 return
+        # grads are identical after allreduce: update ONCE on the first
+        # context and broadcast — keeps optimizer num_update correct
+        # (one tick per step, not per device) and optimizer state
+        # un-replicated, matching the reference's update_on_kvstore
+        # single-update semantics
+        from ..ndarray.sparse import BaseSparseNDArray
+
+        use_fused = self._fusion_enabled()
+        fused = []      # (index, weight, grad, state)
+        seq = []        # (index, weight, grad, state, is_row_sparse)
         for i, p in enumerate(self._params):
-            ctxs = p.list_ctx()
-            # grads are identical after allreduce: update ONCE on the first
-            # context and broadcast — keeps optimizer num_update correct
-            # (one tick per step, not per device) and optimizer state
-            # un-replicated, matching the reference's update_on_kvstore
-            # single-update semantics
-            ctx0 = ctxs[0]
+            ctx0 = p.list_ctx()[0]
             w = p.data(ctx0)
             g = p.grad(ctx0)
-            if (getattr(p, "grad_stype", "default") == "row_sparse"
-                    and getattr(self._optimizer, "supports_sparse", False)):
-                # sparse_grad embeddings: route through the lazy row-wise
-                # optimizer kernels (ref: trainer.py _row_sparse_pull path);
-                # optimizers without a sparse path keep the dense grad
-                from ..ndarray import sparse as _sparse
-
-                g = _sparse.cast_storage(g, "row_sparse")
+            sparse = (getattr(p, "grad_stype", "default") == "row_sparse"
+                      and getattr(self._optimizer, "supports_sparse",
+                                  False))
             if self._states[i] is None:
                 self._states[i] = {}
             if ctx0 not in self._states[i]:
                 self._states[i][ctx0] = \
                     self._optimizer.create_state_multi_precision(i, w)
-            self._optimizer.update_multi_precision(
-                i, w, g, self._states[i][ctx0])
+            st = self._states[i][ctx0]
+            if (use_fused and not sparse
+                    and not isinstance(g, BaseSparseNDArray)
+                    and not isinstance(w, BaseSparseNDArray)):
+                fused.append((i, w, g, st))
+            else:
+                seq.append((i, w, g, st, sparse))
+        if fused:
+            # one multi-tensor kernel call per (dtype, rule, hyperparam)
+            # group — the optimizer may still bounce ineligible params
+            # back to its sequential update (counted as seq_updates)
+            with _profiler.op_scope("fused_update", cat="trainer"):
+                fstats = self._optimizer.fused_update(
+                    [f[0] for f in fused], [f[1] for f in fused],
+                    [f[2] for f in fused], [f[3] for f in fused])
+            self._dispatches += fstats["fused_calls"] + \
+                fstats["seq_updates"]
+            self._params_fused += fstats["params_fused"]
+        for i, w, g, st, sparse in seq:
+            if sparse:
+                # sparse_grad embeddings: route through the lazy row-wise
+                # optimizer kernels (ref: trainer.py _row_sparse_pull
+                # path); optimizers without a sparse path keep the dense
+                # grad
+                from ..ndarray import sparse as _sparse
+
+                g = _sparse.cast_storage(g, "row_sparse")
+            self._optimizer.update_multi_precision(i, w, g, st)
+            self._dispatches += 1
+        self._broadcast_updated()
+
+    def _broadcast_updated(self):
+        """Refresh every replica with ONE batched device transfer per
+        extra context (both the fused and the sequential fallback path —
+        previously one as_in_context per parameter per context)."""
+        per_ctx = {}
+        for p in self._params:
+            ctxs = p.list_ctx()
+            if len(ctxs) <= 1:
+                continue
+            src = p.data(ctxs[0])
             for ctx in ctxs[1:]:
-                p.data(ctx)._data = w.as_in_context(ctx)._data
+                per_ctx.setdefault(ctx, []).append((p, ctx, src))
+        for ctx, entries in per_ctx.items():
+            outs = _engine.batched_put([s._data for _, _, s in entries],
+                                       ctx.jax_device())
+            for (p, c, _), new in zip(entries, outs):
+                p._data[c]._data = new
+            self._dispatches += 1
 
     # -- state io (ref: trainer.save_states/load_states) --------------------
 

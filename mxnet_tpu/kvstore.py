@@ -123,8 +123,182 @@ class KVStore:
                 o._data = src.as_in_context(o.context)._data
 
     def pushpull(self, key, value, out=None, priority=0):
+        """push+pull in one call.  The multi-key form takes the fused
+        path: dense same-dtype values are packed into size-capped flat
+        buckets (``MXTPU_KVSTORE_BUCKET_MB``, default 32), each bucket is
+        reduced/allreduced as ONE flat buffer, and the results are
+        unpacked into the existing out holders — one collective per
+        bucket instead of one per key (ref: the reference's fused
+        aggregate pushes; "Memory-efficient array redistribution"
+        motivates the many-small→few-large collective rewrite).
+        Bit-compatible with the sequential per-key path: the pairwise
+        reduce order over device slots is identical, and every remaining
+        op is elementwise.  Sparse values, gradient compression, the
+        server-side-optimizer and dist_async paths all fall through to
+        the sequential form unchanged."""
+        if isinstance(key, (list, tuple)) and len(key) > 1 \
+                and self._fusion_eligible():
+            keys, values = _normalize(key, value)
+            outs = _normalize(key, out)[1] if out is not None else values
+            fused, rest = self._split_fusable(keys, values, outs)
+            stats = {"buckets": 0, "dispatches": 0}
+            if fused:
+                self._pushpull_fused(fused, stats)
+            for k, vlist, olist in rest:
+                self.push(k, vlist, priority)
+                self.pull(k, olist, priority)
+                stats["dispatches"] += 2 * len(vlist)
+            return stats
         self.push(key, value, priority)
         self.pull(key, out if out is not None else value, priority)
+        return None
+
+    def _fusion_eligible(self):
+        # compression quantizes per (key, slot) with error feedback;
+        # update_on_kvstore applies the optimizer inside push; dist_async
+        # pushes per key to the PS — none of these compose with packing.
+        return (self._updater is None and self._compression is None
+                and not self._is_async())
+
+    def _split_fusable(self, keys, values, outs):
+        from .ndarray.sparse import BaseSparseNDArray
+
+        fused, rest = [], []
+        for k, vlist, olist in zip(keys, values, outs):
+            ok = (k in self._store and len(vlist) == len(olist) > 0
+                  and all(isinstance(v, NDArray)
+                          and not isinstance(v, BaseSparseNDArray)
+                          for v in vlist)
+                  and all(isinstance(o, NDArray)
+                          and not isinstance(o, BaseSparseNDArray)
+                          for o in olist)
+                  and len({str(v.dtype) for v in vlist}) == 1)
+            (fused if ok else rest).append((k, vlist, olist))
+        return fused, rest
+
+    def _pushpull_fused(self, items, stats):
+        import jax.numpy as jnp
+
+        from . import engine
+        from .base import getenv
+
+        cap = max(int(getenv("KVSTORE_BUCKET_MB", 32.0, float) * (1 << 20)),
+                  1)
+        if not self._is_dist():
+            # single replica + no cross-worker reduce: there is nothing
+            # to sum, so packing would be pure overhead — mirror
+            # push+pull's rebind exactly (zero device work when value,
+            # store and outs share one device)
+            multi = []
+            for k, vlist, olist in items:
+                if len(vlist) > 1:
+                    multi.append((k, vlist, olist))
+                    continue
+                store = self._store[k]
+                if vlist[0].context != store.context:
+                    stats["dispatches"] += 1
+                store._data = vlist[0].as_in_context(store.context)._data
+                for o in olist:
+                    if o.context != store.context:
+                        stats["dispatches"] += 1
+                    o._data = store.as_in_context(o.context)._data
+            items = multi
+            if not items:
+                return
+        # one bucket stream per (dtype, slot-count, slot-device layout);
+        # the fingerprint covers the VALUE slots — those are what gets
+        # packed into one flatten call, so every bucket member's slot s
+        # must live on the same device (outs may land anywhere: the
+        # unpack side transfers per destination device)
+        groups = {}
+        for item in items:
+            _, vlist, _olist = item
+            fp = (str(vlist[0].dtype), len(vlist),
+                  tuple(str(next(iter(v._data.devices()))) for v in vlist))
+            groups.setdefault(fp, []).append(item)
+        for members in groups.values():
+            bucket, size = [], 0
+            for item in members:
+                nbytes = item[1][0].size * item[1][0].dtype.itemsize
+                if bucket and size + nbytes > cap:
+                    self._reduce_bucket(bucket, stats, jnp, engine)
+                    bucket, size = [], 0
+                bucket.append(item)
+                size += nbytes
+            if bucket:
+                self._reduce_bucket(bucket, stats, jnp, engine)
+
+    def _reduce_bucket(self, bucket, stats, jnp, engine):
+        """ONE flat allreduce for every key in `bucket`; results land in
+        the canonical store and every out holder."""
+        ks = [b[0] for b in bucket]
+        shapes = [tuple(b[1][0].shape) for b in bucket]
+        n_slots = len(bucket[0][1])
+        single = len(bucket) == 1
+        if single:
+            # a lone key (e.g. one tensor bigger than the bucket cap)
+            # gains nothing from pack/unpack: reduce it directly
+            flats = [bucket[0][1][s]._data for s in range(n_slots)]
+        else:
+            # pack: one flat buffer per device slot
+            flats = [engine.flatten_arrays([b[1][s]._data for b in bucket])
+                     for s in range(n_slots)]
+            stats["dispatches"] += n_slots
+        # pairwise tree reduce across slots — same pair order as
+        # _reduce_sum, so the per-element sum order (and therefore the
+        # bits) match the sequential per-key path exactly
+        while len(flats) > 1:
+            nxt = []
+            for i in range(0, len(flats) - 1, 2):
+                a, b = flats[i], flats[i + 1]
+                dev_a = next(iter(a.devices()))
+                if next(iter(b.devices())) != dev_a:
+                    b = jax.device_put(b, dev_a)
+                    stats["dispatches"] += 1
+                nxt.append(engine.track(jnp.add(a, b)))
+                stats["dispatches"] += 1
+            if len(flats) % 2:
+                nxt.append(flats[-1])
+            flats = nxt
+        reduced = flats[0]
+        target_dev = self._store[ks[0]].context.jax_device()
+        if next(iter(reduced.devices())) != target_dev:
+            reduced = engine.track(jax.device_put(reduced, target_dev))
+            stats["dispatches"] += 1
+        if self._is_dist():
+            from .parallel import dist
+
+            reduced = dist.allreduce(_wrap(reduced))._data
+            stats["dispatches"] += 1
+        # unpack once per distinct destination device
+        per_dev = {}
+
+        def pieces_for(dev):
+            got = per_dev.get(dev)
+            if got is None:
+                flat = reduced
+                if next(iter(reduced.devices())) != dev:
+                    flat = engine.track(jax.device_put(reduced, dev))
+                    stats["dispatches"] += 1
+                if single:
+                    got = per_dev[dev] = [flat]
+                else:
+                    got = per_dev[dev] = engine.unflatten_array(flat,
+                                                                shapes)
+                    stats["dispatches"] += 1
+            return got
+
+        for i, (k, _vlist, olist) in enumerate(bucket):
+            # each key's canonical buffer stays on ITS OWN store
+            # context (keys in one bucket may live on different
+            # devices), matching the sequential per-key path — a write
+            # to ks[0]'s device would stick and relocate every later
+            # per-key reduce for that key
+            self._store[k]._data = pieces_for(
+                self._store[k].context.jax_device())[i]
+            for o in olist:
+                o._data = pieces_for(next(iter(o._data.devices())))[i]
+        stats["buckets"] += 1
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the requested rows (ref: KVStoreLocal::PullRowSparse).

@@ -259,6 +259,162 @@ def _k_ftml(w, g, d, v, z, lr, t, *, beta1, beta2, epsilon, rescale,
 
 
 # ---------------------------------------------------------------------------
+# fused multi-tensor kernels (ref: multi_sgd_update / multi_mp_sgd_update,
+# src/operator/optimizer_op.cc, and the reference Trainer's aggregate_num
+# grouping).  Each _fk_* forwards to its per-tensor _k_* twin — the ONE
+# source of update math, so fused and sequential can never drift — with
+# a reshuffled signature: wd and rescale ride as TRACED positional
+# scalars (alongside lr / t) instead of static kwargs, so LR schedules,
+# wd_mult groups and AMP rescale updates never recompile the aggregate
+# executable.  Every _k_* op is elementwise, so running one over a
+# concatenation of N flat tensors is bit-identical to N separate calls.
+
+
+def _fk_sgd(w, g, lr, wd, rescale, *, clip):
+    return _k_sgd(w, g, lr, rescale=rescale, clip=clip, wd=wd)
+
+
+def _fk_sgd_mom(w, g, mom, lr, wd, rescale, *, momentum, clip):
+    return _k_sgd_mom(w, g, mom, lr, momentum=momentum, rescale=rescale,
+                      clip=clip, wd=wd)
+
+
+def _fk_nag(w, g, mom, lr, wd, rescale, *, momentum, clip):
+    return _k_nag(w, g, mom, lr, momentum=momentum, rescale=rescale,
+                  clip=clip, wd=wd)
+
+
+def _fk_adam(w, g, mean, var, lr, t, wd, rescale, *, beta1, beta2,
+             epsilon, clip):
+    return _k_adam(w, g, mean, var, lr, t, beta1=beta1, beta2=beta2,
+                   epsilon=epsilon, rescale=rescale, clip=clip, wd=wd)
+
+
+def _fk_adamw(w, g, mean, var, lr, t, wd, rescale, *, beta1, beta2,
+              epsilon, clip):
+    return _k_adamw(w, g, mean, var, lr, t, beta1=beta1, beta2=beta2,
+                    epsilon=epsilon, rescale=rescale, clip=clip, wd=wd)
+
+
+def _fk_rmsprop(w, g, n, lr, wd, rescale, *, gamma1, epsilon, clip):
+    return _k_rmsprop(w, g, n, lr, gamma1=gamma1, epsilon=epsilon,
+                      rescale=rescale, clip=clip, wd=wd)
+
+
+def _fk_adagrad(w, g, hist, lr, wd, rescale, *, epsilon, clip):
+    return _k_adagrad(w, g, hist, lr, epsilon=epsilon, rescale=rescale,
+                      clip=clip, wd=wd)
+
+
+# pack -> kernel -> unpack as ONE jitted call per parameter group: the
+# concat/split live inside the executable, so a group of any size costs
+# a single dispatch (and XLA fuses the whole thing into one loop).
+_MULTI_WRAPPERS = {}
+
+
+def _multi_wrapper(kernel):
+    fn = _MULTI_WRAPPERS.get(kernel)
+    if fn is None:
+        # pack/unpack are the engine's flat-buffer staging kernels,
+        # traced INSIDE this executable — one shared implementation for
+        # the comm-fusion and update-fusion tiers
+        from .engine import _k_flatten, _k_unflatten
+
+        def fn(ws, gs, sts, scalars, *, static):
+            shapes = tuple(tuple(int(d) for d in w.shape) for w in ws)
+            outs = kernel(_k_flatten(ws), _k_flatten(gs),
+                          *[_k_flatten(col) for col in sts],
+                          *scalars, **dict(static))
+            if not isinstance(outs, tuple):
+                outs = (outs,)
+            return (list(_k_unflatten(outs[0], shapes=shapes)),
+                    [list(_k_unflatten(o, shapes=shapes))
+                     for o in outs[1:]])
+
+        fn.__name__ = "fused_" + kernel.__name__.removeprefix("_fk_")
+        _MULTI_WRAPPERS[kernel] = fn
+    return fn
+
+
+_donate_ok = None
+
+
+def _fused_donate_ok():
+    """Donate weight/state buffers to the fused executable (XLA updates
+    them in place instead of holding model+copy live).  Off on CPU —
+    PjRt:CPU has no donation and would warn per call; MXTPU_FUSED_DONATE
+    force-overrides either way (set 0 when an async checkpoint capture
+    must outlive the next step's update)."""
+    global _donate_ok
+    if _donate_ok is None:
+        from .base import getenv
+
+        forced = getenv("FUSED_DONATE", None)
+        if forced is not None:
+            _donate_ok = forced not in ("0", "false", "False", "")
+        else:
+            import jax
+
+            _donate_ok = jax.default_backend() != "cpu"
+    return _donate_ok
+
+
+# group signatures whose NON-donating executable has already run once
+# (see _fused_apply: the first call per signature skips donation so
+# both twins compile during warmup, not mid-step under a later hold)
+_nondonate_warmed = set()
+
+
+def _fused_apply(kernel, static, chunk, svals):
+    """Run one parameter group (a chunk of (weight, grad, states)
+    NDArray triples) through the fused kernel — ONE dispatch — and
+    rebind the holders to the results."""
+    from . import engine
+    from ._imperative import get_jitted
+
+    ws = [m[0]._data for m in chunk]
+    gs = [m[1]._data for m in chunk]
+    sts = [[m[2][slot]._data for m in chunk]
+           for slot in range(len(chunk[0][2]))]
+    scalars = [jnp.asarray(v, ws[0].dtype) for v in svals]
+    # the guard makes hold-check + dispatch + holder rebind atomic: a
+    # checkpoint capture on another thread can neither snapshot buffers
+    # after the check but before the donating call deletes them, nor
+    # catch the holders still pointing at just-donated buffers before
+    # the rebind below lands
+    with engine.donation_dispatch_guard() as held:
+        donate = None
+        if _fused_donate_ok() and not held:
+            # an active donation hold (async checkpoint capture
+            # mid-readback) means live references to these very
+            # buffers exist elsewhere: run the non-donating executable
+            # for this call.  The FIRST call per group signature also
+            # stays non-donating, so the non-donating twin compiles
+            # during warmup — a hold arriving later (async save
+            # overlapping a step) then switches executables without a
+            # mid-step XLA compile
+            sig = (kernel, static, len(sts),
+                   tuple((tuple(int(d) for d in w.shape), str(w.dtype))
+                         for w in ws))
+            if sig in _nondonate_warmed:
+                donate = (0, 2)
+            else:
+                _nondonate_warmed.add(sig)
+        jitted = get_jitted(_multi_wrapper(kernel), {"static": static},
+                            donate_argnums=donate)
+        new_ws, new_sts = jitted(ws, gs, sts, list(scalars))
+        for a in new_ws:
+            engine.track(a)
+        for col in new_sts:
+            for a in col:
+                engine.track(a)
+        for j, m in enumerate(chunk):
+            m[0]._data = new_ws[j]
+            for slot, st_nd in enumerate(m[2]):
+                st_nd._data = new_sts[slot][j]
+
+
+# ---------------------------------------------------------------------------
 
 
 class Optimizer:
@@ -271,7 +427,24 @@ class Optimizer:
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
                  multi_precision=False, param_dict=None, begin_num_update=0,
-                 **kwargs):
+                 aggregate_num=None, **kwargs):
+        # max params per fused multi-tensor update call (ref: the
+        # reference Trainer's aggregate_num / MXNET_OPTIMIZER_AGGREGATION_SIZE
+        # knob).  Precedence: env var > constructor arg > default.  The
+        # env knob matches upstream spelling (MXTPU_ prefix also
+        # accepted); 1 disables aggregation entirely and restores the
+        # sequential one-dispatch-per-parameter step.  Default is 64
+        # rather than upstream's 4: upstream's cap bounds CUDA kernel
+        # argument space, which XLA's concat-in-graph form doesn't have.
+        from .base import getenv
+
+        env_agg = getenv("OPTIMIZER_AGGREGATION_SIZE", None, int)
+        if env_agg is not None:
+            self.aggregate_num = int(env_agg)
+        elif aggregate_num is not None:
+            self.aggregate_num = int(aggregate_num)
+        else:
+            self.aggregate_num = 64
         self.rescale_grad = rescale_grad
         self.lr = learning_rate
         self.lr_scheduler = lr_scheduler
@@ -369,6 +542,65 @@ class Optimizer:
                     clip=self.clip_gradient,
                     wd=self._get_wd(index))
 
+    # -- fused multi-tensor path (ref: multi_sgd/aggregate updates) ---------
+
+    def _fused_spec(self, index):
+        """(kernel, n_states, scalar_names, static_kwargs) describing the
+        flat-buffer form of this optimizer's update, or None when the rule
+        has no elementwise fused kernel (norm-based rules like LAMB, the
+        centered RMSProp, python-schedule rules like Nadam) — those fall
+        through to the sequential per-parameter update."""
+        return None
+
+    def fused_update(self, indices, weights, grads, states):
+        """Aggregate update: group the given params by (kernel, dtype,
+        hyperparameter signature), then run each group of at most
+        ``aggregate_num`` params as ONE jitted call over concatenated
+        flat buffers with donated weight/state arguments; lr/t/wd/rescale
+        ride as traced scalars so LR schedules never recompile.  Params
+        without a fused spec (or with a multi-precision fp16 master copy)
+        take the sequential ``update_multi_precision`` path.  Returns a
+        stats dict: fused_calls / params_fused / seq_updates.  Bit-
+        compatible with calling ``update_multi_precision`` per param."""
+        stats = {"fused_calls": 0, "params_fused": 0, "seq_updates": 0}
+        groups = {}
+        for i, w, g, st in zip(indices, weights, grads, states):
+            spec = self._fused_spec(i)
+            sts = [] if st is None else (
+                [st] if isinstance(st, NDArray) else list(st))
+            if (spec is None
+                    or (self.multi_precision and w.dtype == np.float16)
+                    or g.dtype != w.dtype
+                    or len(sts) != spec[1]
+                    or any(s is None or s.dtype != w.dtype or
+                           s.shape != w.shape for s in sts)):
+                self.update_multi_precision(i, w, g, st)
+                stats["seq_updates"] += 1
+                continue
+            kernel, _, scalar_names, static = spec
+            # tick BEFORE reading lr/t, exactly like the sequential path
+            self._update_count(i)
+            t = self._index_update_count[i]
+            svals = tuple(
+                self._get_lr(i) if n == "lr" else float(t)
+                for n in scalar_names
+            ) + (self._get_wd(i), float(self.rescale_grad))
+            # device rides in the key: params placed on different
+            # devices (model-parallel layouts) must not share one
+            # jitted call, which would raise jax's incompatible-devices
+            # error instead of updating
+            key = (kernel, str(w.dtype), static, svals,
+                   str(next(iter(w._data.devices()))))
+            groups.setdefault(key, []).append((w, g, sts))
+        agg = max(1, int(self.aggregate_num))
+        for (kernel, _dt, static, svals, _dev), members in groups.items():
+            for c0 in range(0, len(members), agg):
+                chunk = members[c0:c0 + agg]
+                _fused_apply(kernel, static, chunk, svals)
+                stats["fused_calls"] += 1
+                stats["params_fused"] += len(chunk)
+        return stats
+
     @staticmethod
     def _scalar(v, like):
         return _wrap(jnp.asarray(v, dtype=like.dtype))
@@ -388,6 +620,14 @@ class SGD(Optimizer):
             return _nd.zeros(weight.shape, dtype=weight.dtype,
                              ctx=weight.context)
         return None
+
+    def _fused_spec(self, index):
+        if self.momentum == 0.0:
+            return (_fk_sgd, 0, ("lr",),
+                    (("clip", self.clip_gradient),))
+        return (_fk_sgd_mom, 1, ("lr",),
+                (("clip", self.clip_gradient),
+                 ("momentum", self.momentum)))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -428,6 +668,11 @@ class NAG(Optimizer):
     def create_state(self, index, weight):
         return _nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
 
+    def _fused_spec(self, index):
+        return (_fk_nag, 1, ("lr",),
+                (("clip", self.clip_gradient),
+                 ("momentum", self.momentum)))
+
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr = self._scalar(self._get_lr(index), weight)
@@ -451,6 +696,12 @@ class Adam(Optimizer):
         z = lambda: _nd.zeros(weight.shape, dtype=weight.dtype,
                               ctx=weight.context)
         return (z(), z())
+
+    def _fused_spec(self, index):
+        return (_fk_adam, 2, ("lr", "t"),
+                (("beta1", self.beta1), ("beta2", self.beta2),
+                 ("epsilon", self.epsilon),
+                 ("clip", self.clip_gradient)))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -483,6 +734,12 @@ class Adam(Optimizer):
 class AdamW(Adam):
     supports_sparse = False  # decoupled-wd path has no row kernel
 
+    def _fused_spec(self, index):
+        return (_fk_adamw, 2, ("lr", "t"),
+                (("beta1", self.beta1), ("beta2", self.beta2),
+                 ("epsilon", self.epsilon),
+                 ("clip", self.clip_gradient)))
+
     def update(self, index, weight, grad, state):
         self._update_count(index)
         t = self._index_update_count[index]
@@ -511,6 +768,13 @@ class RMSProp(Optimizer):
             return (z(), z(), z())  # n, mean-grad, delta
         return z()
 
+    def _fused_spec(self, index):
+        if self.centered:
+            return None  # centered variant stays on the sequential path
+        return (_fk_rmsprop, 1, ("lr",),
+                (("gamma1", self.gamma1), ("epsilon", self.epsilon),
+                 ("clip", self.clip_gradient)))
+
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr = self._scalar(self._get_lr(index), weight)
@@ -537,6 +801,11 @@ class AdaGrad(Optimizer):
 
     def create_state(self, index, weight):
         return _nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
+
+    def _fused_spec(self, index):
+        return (_fk_adagrad, 1, ("lr",),
+                (("epsilon", self.float_stable_eps),
+                 ("clip", self.clip_gradient)))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)

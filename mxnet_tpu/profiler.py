@@ -167,6 +167,22 @@ def _graph_cache_counters(reset=False):
     return stats
 
 
+def _trainer_step_counters(reset=False):
+    """Step-fusion counters from gluon.Trainer (params_fused,
+    buckets_built, dispatches_per_step) — window-scoped under reset=True
+    exactly like cachedGraph; only present when the gluon tier is
+    loaded."""
+    import sys
+
+    trainer = sys.modules.get(__package__ + ".gluon.trainer")
+    if trainer is None:
+        return None
+    stats = trainer.trainer_step_stats()
+    if reset:
+        trainer.reset_trainer_step_stats()
+    return stats
+
+
 def dumps(reset=False, format="json"):
     """Return the trace (ref: mx.profiler.dumps).
 
@@ -192,6 +208,9 @@ def dumps(reset=False, format="json"):
     graph = _graph_cache_counters(reset)
     if graph is not None:
         data["cachedGraph"] = graph
+    steps = _trainer_step_counters(reset)
+    if steps is not None:
+        data["trainerStep"] = steps
     return json.dumps(data)
 
 
@@ -227,7 +246,9 @@ def _aggregate_table(reset=False):
         lines.append("Memory Statistics (peak over profiled window):")
         for key, val in _mem_peak.items():
             lines.append(f"{key:<40}{val / 1e6:>14.3f} MB")
-    graph = _graph_cache_counters()
+    # counter sections are window-scoped under reset=True exactly like
+    # the event table above (and like the JSON format path)
+    graph = _graph_cache_counters(reset)
     if graph is not None:
         lines.append("")
         lines.append("Compiled-Graph Cache (CachedOp):")
@@ -235,6 +256,15 @@ def _aggregate_table(reset=False):
                      f"{graph['compiles']:>12}")
         lines.append(f"{'graph reuses (cache hit)':<40}"
                      f"{graph['reuses']:>12}")
+    steps = _trainer_step_counters(reset)
+    if steps is not None:
+        lines.append("")
+        lines.append("Trainer Step Fusion:")
+        for label, key in (("steps", "steps"),
+                           ("params fused", "params_fused"),
+                           ("allreduce buckets built", "buckets_built"),
+                           ("dispatches per step", "dispatches_per_step")):
+            lines.append(f"{label:<40}{steps[key]:>12}")
     return "\n".join(lines)
 
 

@@ -1,0 +1,514 @@
+"""Fused trainer step (multi-tensor optimizer update + bucketed
+allreduce + batched replica broadcast).
+
+The contract under test: the fused path (on by default) is BIT-
+compatible with the sequential path (`aggregate_num=1` /
+MXNET_OPTIMIZER_AGGREGATION_SIZE=1), sparse/AMP configurations fall
+through to the sequential code unchanged, and states snapshots move
+freely between fused and sequential restarts.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd, profiler, _imperative
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon import trainer as trainer_mod
+from mxnet_tpu.gluon.parameter import Parameter
+
+MIXED_SPECS = [((3, 4), "float32"), ((17,), "float32"),
+               ((2, 3, 2), "float32"), ((5, 5), "float16"),
+               ((1,), "float32"), ((4, 1), "float16"), ((6,), "float16")]
+
+
+def make_params(specs, ctx=None, seed=0, **param_kwargs):
+    rng = np.random.RandomState(seed)
+    params = []
+    for j, (shape, dtype) in enumerate(specs):
+        p = Parameter(f"p{j}", shape=shape, dtype=dtype, **param_kwargs)
+        p.initialize(ctx=ctx)
+        p.set_data(nd.array(rng.randn(*shape).astype(dtype)))
+        params.append(p)
+    return params
+
+
+def set_grads(params, seed=1):
+    """Deterministic per-(param, replica) gradients: replicas get
+    DIFFERENT grads so the allreduce actually has something to sum."""
+    rng = np.random.RandomState(seed)
+    for p in params:
+        for c in p.list_ctx():
+            g = rng.randn(*p.shape).astype(p.dtype)
+            p._data[c]._grad = nd.array(g, ctx=c, dtype=p.dtype)
+
+
+def run_steps(opt, opt_args, specs, n_steps, aggregate_num=None, ctx=None,
+              batch_size=2, params=None, trainer=None, seed0=0):
+    if params is None:
+        params = make_params(specs, ctx=ctx)
+    if trainer is None:
+        kwargs = dict(opt_args)
+        if aggregate_num is not None:
+            kwargs["aggregate_num"] = aggregate_num
+        trainer = gluon.Trainer(params, opt, kwargs)
+    for step in range(n_steps):
+        set_grads(params, seed=seed0 + step)
+        trainer.step(batch_size)
+    return params, trainer
+
+
+def states_leaves(blob):
+    out = []
+
+    def walk(v):
+        if v is None:
+            return
+        if isinstance(v, (tuple, list)):
+            for x in v:
+                walk(x)
+        elif isinstance(v, dict):
+            for x in v.values():
+                walk(x)
+        elif hasattr(v, "asnumpy"):
+            out.append(v.asnumpy())
+        elif isinstance(v, np.ndarray):
+            out.append(v)
+
+    walk(blob["states"])
+    return out
+
+
+@pytest.mark.parametrize("opt,opt_args", [
+    ("sgd", {"learning_rate": 0.05, "wd": 0.01}),
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9, "wd": 0.01}),
+    ("adam", {"learning_rate": 0.01, "wd": 0.01}),
+])
+def test_fused_bit_parity_mixed_dtypes_shapes(opt, opt_args):
+    fused_p, fused_tr = run_steps(opt, opt_args, MIXED_SPECS, 4)
+    seq_p, seq_tr = run_steps(opt, opt_args, MIXED_SPECS, 4,
+                              aggregate_num=1)
+    assert fused_tr._fusion_enabled() and not seq_tr._fusion_enabled()
+    for a, b in zip(fused_p, seq_p):
+        np.testing.assert_array_equal(a.data().asnumpy(),
+                                      b.data().asnumpy())
+    for a, b in zip(states_leaves(fused_tr.states_dict()),
+                    states_leaves(seq_tr.states_dict())):
+        np.testing.assert_array_equal(a, b)
+    assert fused_tr.optimizer.num_update == seq_tr.optimizer.num_update
+
+
+def test_fused_parity_with_clip_and_lr_schedule():
+    from mxnet_tpu import lr_scheduler
+
+    results = []
+    for agg in (None, 1):
+        sched = lr_scheduler.FactorScheduler(step=2, factor=0.5,
+                                             base_lr=0.1)
+        args = {"learning_rate": 0.1, "lr_scheduler": sched,
+                "momentum": 0.9, "clip_gradient": 0.4, "wd": 0.001}
+        if agg is not None:
+            args["aggregate_num"] = agg
+        p, _ = run_steps("sgd", args, MIXED_SPECS[:4], 6)
+        results.append([q.data().asnumpy() for q in p])
+    for a, b in zip(*results):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_fused_multi_device_allreduce_and_grad_writeback():
+    ctxs = [mx.xla(0), mx.xla(1)]
+    specs = [((4, 3), "float32"), ((7,), "float32"), ((2, 2), "float32"),
+             ((9,), "float32")]
+    outcome = {}
+    for agg in (None, 1):
+        params, tr = run_steps("sgd",
+                               {"learning_rate": 0.1, "momentum": 0.9},
+                               specs, 3, aggregate_num=agg, ctx=ctxs,
+                               batch_size=1)
+        outcome[agg] = params
+        if agg is None:
+            assert tr._kvstore is not None
+    # grads summed across replicas, written back into EVERY holder
+    rng = np.random.RandomState(0 + 2)  # seed of the last step
+    for p in outcome[None]:
+        expected = sum(rng.randn(*p.shape).astype(p.dtype)
+                       for _ in p.list_ctx())
+        for c in p.list_ctx():
+            got = p._data[c]._grad
+            assert got is not None and got.context == c
+            np.testing.assert_allclose(got.asnumpy(), expected,
+                                       rtol=2e-6, atol=2e-6)
+    for pa, pb in zip(outcome[None], outcome[1]):
+        ref = pa.data(pa.list_ctx()[0]).asnumpy()
+        for c in pa.list_ctx():
+            # fused == sequential AND replicas identical
+            np.testing.assert_array_equal(pa.data(c).asnumpy(),
+                                          pb.data(c).asnumpy())
+            np.testing.assert_array_equal(pa.data(c).asnumpy(), ref)
+
+
+def test_bucket_size_cap_builds_multiple_buckets(monkeypatch):
+    # ~100-byte buckets: every param bucket overflows, so the 4 fp32
+    # params of >25 floats each land in separate buckets — parity must
+    # survive the split
+    monkeypatch.setenv("MXTPU_KVSTORE_BUCKET_MB", "0.0001")
+    ctxs = [mx.xla(0), mx.xla(1)]
+    specs = [((10, 4), "float32"), ((37,), "float32"), ((6, 5), "float32"),
+             ((40,), "float32")]
+    trainer_mod.reset_trainer_step_stats()
+    fused, _ = run_steps("sgd", {"learning_rate": 0.1}, specs, 2,
+                         ctx=ctxs, batch_size=1)
+    assert trainer_mod.trainer_step_stats()["buckets_built"] >= 2 * 4
+    monkeypatch.delenv("MXTPU_KVSTORE_BUCKET_MB")
+    seq, _ = run_steps("sgd", {"learning_rate": 0.1}, specs, 2,
+                       aggregate_num=1, ctx=ctxs, batch_size=1)
+    for a, b in zip(fused, seq):
+        for c in a.list_ctx():
+            np.testing.assert_array_equal(a.data(c).asnumpy(),
+                                          b.data(c).asnumpy())
+
+
+def test_amp_overflow_skips_whole_fused_group():
+    from mxnet_tpu.amp import LossScaler
+
+    params = make_params(MIXED_SPECS[:3])
+    tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.1})
+    tr._amp_loss_scaler = LossScaler(init_scale=2.0 ** 8)
+    tr._amp_original_scale = tr._scale
+    before = [p.data().asnumpy().copy() for p in params]
+    set_grads(params, seed=0)
+    # poison ONE param's grad: the whole fused group must skip
+    p0 = params[0]
+    bad = np.full(p0.shape, np.inf, np.float32)
+    p0._data[p0.list_ctx()[0]]._grad = nd.array(bad)
+    scale_before = tr._amp_loss_scaler.loss_scale
+    tr.step(1)
+    for p, w in zip(params, before):
+        np.testing.assert_array_equal(p.data().asnumpy(), w)
+    assert tr._amp_loss_scaler.loss_scale == scale_before / 2.0
+    # clean grads: the update resumes (weights move)
+    set_grads(params, seed=1)
+    tr.step(1)
+    assert not np.array_equal(params[1].data().asnumpy(), before[1])
+
+
+def test_row_sparse_params_excluded_from_fusion():
+    specs = [((8, 3), "float32"), ((5,), "float32"), ((4, 4), "float32")]
+    outcome = {}
+    for agg in (None, 1):
+        params = make_params(specs)
+        sp = Parameter("emb", shape=(12, 3), grad_stype="row_sparse")
+        sp.initialize()
+        sp.set_data(nd.array(np.random.RandomState(7).randn(12, 3)
+                             .astype(np.float32)))
+        params.append(sp)
+        kwargs = {"learning_rate": 0.05, "momentum": 0.9}
+        if agg is not None:
+            kwargs["aggregate_num"] = agg
+        tr = gluon.Trainer(params, "sgd", kwargs)
+        trainer_mod.reset_trainer_step_stats()
+        for step in range(3):
+            set_grads(params, seed=step)
+            tr.step(1)
+        if agg is None:
+            stats = trainer_mod.trainer_step_stats()
+            # the row_sparse param never rides a fused group
+            assert stats["params_fused"] == 3 * len(specs)
+        outcome[agg] = params
+    for a, b in zip(outcome[None], outcome[1]):
+        np.testing.assert_array_equal(a.data().asnumpy(),
+                                      b.data().asnumpy())
+
+
+def test_states_dict_roundtrip_across_fused_sequential_restart():
+    opt_args = {"learning_rate": 0.01, "wd": 0.01}
+    # continuous fused run: 5 steps
+    cont_p, cont_tr = run_steps("adam", opt_args, MIXED_SPECS[:4], 5)
+    # fused 3 steps -> snapshot -> restart SEQUENTIAL for 2 more
+    a_p, a_tr = run_steps("adam", opt_args, MIXED_SPECS[:4], 3)
+    blob = a_tr.states_dict()
+    b_p = make_params(MIXED_SPECS[:4])
+    for src, dst in zip(a_p, b_p):
+        dst.set_data(src.data())
+    b_tr = gluon.Trainer(b_p, "adam", dict(opt_args, aggregate_num=1))
+    b_tr.load_states_dict(blob)
+    run_steps("adam", opt_args, None, 2, params=b_p, trainer=b_tr,
+              seed0=3)
+    for a, b in zip(cont_p, b_p):
+        np.testing.assert_array_equal(a.data().asnumpy(),
+                                      b.data().asnumpy())
+    # and back: sequential snapshot resumed under the fused path
+    blob2 = b_tr.states_dict()
+    c_p = make_params(MIXED_SPECS[:4])
+    for src, dst in zip(b_p, c_p):
+        dst.set_data(src.data())
+    c_tr = gluon.Trainer(c_p, "adam", dict(opt_args))
+    c_tr.load_states_dict(blob2)
+    run_steps("adam", opt_args, None, 2, params=c_p, trainer=c_tr,
+              seed0=5)
+    cont2_p, _ = run_steps("adam", opt_args, MIXED_SPECS[:4], 7)
+    for a, b in zip(cont2_p, c_p):
+        np.testing.assert_array_equal(a.data().asnumpy(),
+                                      b.data().asnumpy())
+
+
+def test_no_recompile_across_decaying_lr_schedule():
+    from mxnet_tpu import lr_scheduler
+
+    sched = lr_scheduler.FactorScheduler(step=3, factor=0.9, base_lr=0.1)
+    params = make_params(MIXED_SPECS[:4])
+    tr = gluon.Trainer(params, "adam",
+                       {"learning_rate": 0.1, "lr_scheduler": sched})
+    for step in range(3):  # warmup covers every group signature
+        set_grads(params, seed=step)
+        tr.step(1)
+    nd.waitall()
+    lr0 = tr.learning_rate
+    c0 = _imperative.compiled_executable_count()
+    for step in range(10):
+        set_grads(params, seed=3 + step)
+        tr.step(1)
+    nd.waitall()
+    assert _imperative.compiled_executable_count() == c0
+    assert tr.learning_rate < lr0
+
+
+def test_profiler_trainer_step_section_window_scoped():
+    trainer_mod.reset_trainer_step_stats()
+    run_steps("sgd", {"learning_rate": 0.1}, MIXED_SPECS[:3], 2)
+    out = json.loads(profiler.dumps(reset=True))
+    ts = out["trainerStep"]
+    assert ts["steps"] == 2
+    assert ts["params_fused"] == 2 * 3
+    assert ts["dispatches_per_step"] > 0
+    # reset=True scoped the window: a second dump starts from zero
+    again = json.loads(profiler.dumps(reset=True))["trainerStep"]
+    assert again["steps"] == 0 and again["params_fused"] == 0
+
+
+def test_aggregation_env_knob_beats_ctor_arg(monkeypatch):
+    from mxnet_tpu import optimizer as opt_mod
+
+    monkeypatch.setenv("MXNET_OPTIMIZER_AGGREGATION_SIZE", "1")
+    opt = opt_mod.create("sgd", aggregate_num=32)
+    assert opt.aggregate_num == 1  # env wins (documented precedence)
+    monkeypatch.delenv("MXNET_OPTIMIZER_AGGREGATION_SIZE")
+    assert opt_mod.create("sgd", aggregate_num=7).aggregate_num == 7
+    assert opt_mod.create("sgd").aggregate_num == 64
+    # env=1 restores the sequential trainer path end to end
+    monkeypatch.setenv("MXTPU_OPTIMIZER_AGGREGATION_SIZE", "1")
+    trainer_mod.reset_trainer_step_stats()
+    _, tr = run_steps("sgd", {"learning_rate": 0.1}, MIXED_SPECS[:3], 1)
+    assert not tr._fusion_enabled()
+    assert trainer_mod.trainer_step_stats()["params_fused"] == 0
+
+
+def test_aggregate_num_caps_group_size():
+    params = make_params([((4,), "float32")] * 10)
+    tr = gluon.Trainer(params, "sgd",
+                       {"learning_rate": 0.1, "aggregate_num": 4})
+    trainer_mod.reset_trainer_step_stats()
+    set_grads(params, seed=0)
+    tr.step(1)
+    stats = trainer_mod.trainer_step_stats()
+    assert stats["params_fused"] == 10
+    # 10 params in chunks of <=4 -> 3 fused dispatches
+    assert stats["dispatches_per_step"] == 3
+
+
+def test_donation_hold_gates_fused_donation(monkeypatch):
+    """While an async checkpoint capture is draining (donation hold),
+    the fused update must run its NON-donating executable so the held
+    buffer references survive the d2h readback."""
+    from mxnet_tpu import _imperative, engine
+    from mxnet_tpu import optimizer as opt_mod
+
+    recorded = []
+    real = _imperative.get_jitted
+
+    def spy(fn, kwargs, donate_argnums=None):
+        recorded.append(donate_argnums)
+        return real(fn, kwargs)  # never actually donate (CPU backend)
+
+    monkeypatch.setattr(_imperative, "get_jitted", spy)
+    monkeypatch.setattr(opt_mod, "_donate_ok", True)  # fake accelerator
+    monkeypatch.setattr(opt_mod, "_nondonate_warmed", set())
+    params = make_params(MIXED_SPECS[:2])
+    tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.1})
+    # the FIRST call per group signature warms the non-donating twin
+    # (so a later checkpoint hold never compiles mid-step)...
+    set_grads(params, seed=0)
+    tr.step(1)
+    assert recorded and all(d is None for d in recorded), recorded
+    recorded.clear()
+    # ...and every later call donates
+    set_grads(params, seed=2)
+    tr.step(1)
+    assert (0, 2) in recorded, recorded
+    recorded.clear()
+    engine.acquire_donation_hold()
+    try:
+        assert engine.donation_held()
+        set_grads(params, seed=1)
+        tr.step(1)
+        assert recorded and all(d is None for d in recorded), recorded
+    finally:
+        engine.release_donation_hold()
+    assert not engine.donation_held()
+
+
+def test_checkpoint_capture_holds_donation(tmp_path, monkeypatch):
+    """CheckpointManager.save holds off donation from capture until the
+    d2h readback completes, and releases it afterwards."""
+    from mxnet_tpu import engine
+    from mxnet_tpu.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path), keep_n=2)
+    held = []
+    real_readback = mgr._readback
+
+    def spy_readback(state):
+        held.append(engine.donation_held())
+        return real_readback(state)
+
+    monkeypatch.setattr(mgr, "_readback", spy_readback)
+    params = make_params(MIXED_SPECS[:2])
+    tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.1})
+    set_grads(params, seed=0)
+    tr.step(1)
+    mgr.save(1, params={p.name: p.data() for p in params}, trainer=tr)
+    mgr.wait_until_finished()
+    assert held == [True]
+    assert not engine.donation_held()
+
+
+def test_fused_update_groups_by_device():
+    """Model-parallel placement: params living on DIFFERENT devices must
+    update correctly on the default fused path (grouped per device, not
+    jammed into one jitted call that jax rejects)."""
+    specs = [((4, 3), "float32"), ((6,), "float32"),
+             ((2, 5), "float32"), ((3,), "float32")]
+    outcome = {}
+    for agg in (None, 1):
+        params = []
+        for j, (shape, dtype) in enumerate(specs):
+            p = Parameter(f"p{j}", shape=shape, dtype=dtype)
+            p.initialize(ctx=mx.xla(j % 2))  # alternate devices
+            p.set_data(nd.array(np.random.RandomState(j).randn(*shape)
+                                .astype(dtype), ctx=mx.xla(j % 2)))
+            params.append(p)
+        kwargs = {"learning_rate": 0.05, "momentum": 0.9}
+        if agg is not None:
+            kwargs["aggregate_num"] = agg
+        tr = gluon.Trainer(params, "sgd", kwargs)
+        trainer_mod.reset_trainer_step_stats()
+        for step in range(3):
+            set_grads(params, seed=step)
+            tr.step(1)
+        if agg is None:
+            assert trainer_mod.trainer_step_stats()["params_fused"] == \
+                3 * len(specs)
+        outcome[agg] = params
+    for a, b in zip(outcome[None], outcome[1]):
+        np.testing.assert_array_equal(a.data().asnumpy(),
+                                      b.data().asnumpy())
+
+
+def test_pushpull_buckets_by_value_device():
+    """Multi-key pushpull with VALUE slots on different devices (outs
+    co-located) must not pack mixed-device buffers into one bucket."""
+    store = mx.kv.create("local")
+    rng = np.random.RandomState(0)
+    vals_np = [(rng.randn(5).astype(np.float32),
+                rng.randn(5).astype(np.float32)) for _ in range(4)]
+    outs = []
+    for k in range(4):
+        store.init(k, nd.zeros((5,), ctx=mx.xla(0)))
+        outs.append([nd.zeros((5,), ctx=mx.xla(0)),
+                     nd.zeros((5,), ctx=mx.xla(0))])
+    # keys alternate slot-device layout: (dev0,dev1) vs (dev1,dev0)
+    values = [[nd.array(v0, ctx=mx.xla(k % 2)),
+               nd.array(v1, ctx=mx.xla((k + 1) % 2))]
+              for k, (v0, v1) in enumerate(vals_np)]
+    stats = store.pushpull(list(range(4)), values, out=outs)
+    assert stats is not None and stats["buckets"] >= 2
+    for (v0, v1), o in zip(vals_np, outs):
+        np.testing.assert_allclose(o[0].asnumpy(), v0 + v1, rtol=1e-6)
+        np.testing.assert_allclose(o[1].asnumpy(), v0 + v1, rtol=1e-6)
+
+
+def test_pushpull_single_replica_skips_packing():
+    """One value slot + no distributed reduce = nothing to sum: the
+    multi-key path must rebind like sequential push+pull, building no
+    buckets and dispatching no pack/unpack kernels."""
+    store = mx.kv.create("local")
+    rng = np.random.RandomState(3)
+    vals_np = [rng.randn(4).astype(np.float32) for _ in range(3)]
+    outs = []
+    for k, v in enumerate(vals_np):
+        store.init(k, nd.zeros((4,), ctx=mx.xla(0)))
+        outs.append([nd.zeros((4,), ctx=mx.xla(0))])
+    values = [[nd.array(v, ctx=mx.xla(0))] for v in vals_np]
+    stats = store.pushpull(list(range(3)), values, out=outs)
+    assert stats == {"buckets": 0, "dispatches": 0}
+    for v, o in zip(vals_np, outs):
+        np.testing.assert_allclose(o[0].asnumpy(), v, rtol=1e-6)
+
+
+def test_pushpull_preserves_per_key_store_context():
+    """Keys bucketed together may have canonical store buffers on
+    DIFFERENT devices: the fused writeback must land each on its own
+    store context (like the sequential path), not the bucket anchor."""
+    store = mx.kv.create("local")
+    rng = np.random.RandomState(1)
+    vals_np = [(rng.randn(6).astype(np.float32),
+                rng.randn(6).astype(np.float32)) for _ in range(4)]
+    outs = []
+    for k in range(4):
+        store.init(k, nd.zeros((6,), ctx=mx.xla(k % 2)))
+        outs.append([nd.zeros((6,), ctx=mx.xla(0)),
+                     nd.zeros((6,), ctx=mx.xla(0))])
+    values = [[nd.array(v0, ctx=mx.xla(0)), nd.array(v1, ctx=mx.xla(0))]
+              for v0, v1 in vals_np]
+    stats = store.pushpull(list(range(4)), values, out=outs)
+    assert stats is not None and stats["buckets"] >= 1
+    for k, (v0, v1) in enumerate(vals_np):
+        held = store._store[k]
+        assert held.context == mx.xla(k % 2)
+        assert next(iter(held._data.devices())) == \
+            mx.xla(k % 2).jax_device()
+        np.testing.assert_allclose(held.asnumpy(), v0 + v1, rtol=1e-6)
+        np.testing.assert_allclose(outs[k][0].asnumpy(), v0 + v1,
+                                   rtol=1e-6)
+
+
+def test_fused_step_in_real_training_loop():
+    """End-to-end: hybridized net + autograd grads, fused vs sequential
+    trainers converge to bit-identical weights."""
+    def run(agg):
+        mx.random.seed(3)
+        np.random.seed(3)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, in_units=8, activation="relu"),
+                nn.Dense(4, in_units=16))
+        net.initialize(mx.init.Xavier())
+        net.hybridize()
+        kwargs = {"learning_rate": 0.1, "momentum": 0.9}
+        if agg is not None:
+            kwargs["aggregate_num"] = agg
+        tr = gluon.Trainer(net.collect_params(), "sgd", kwargs)
+        x = nd.array(np.random.RandomState(5).rand(16, 8)
+                     .astype(np.float32))
+        for _ in range(4):
+            with autograd.record():
+                loss = (net(x) ** 2).sum()
+            loss.backward()
+            tr.step(16)
+        # fresh blocks get fresh auto-prefixes: compare by position
+        return [p.data().asnumpy()
+                for p in net.collect_params().values()]
+    fused, seq = run(None), run(1)
+    assert len(fused) == len(seq) == 4
+    for a, b in zip(fused, seq):
+        np.testing.assert_array_equal(a, b)

@@ -1,0 +1,95 @@
+"""Step-fusion gate for `make verify` (see docs/performance.md).
+
+50 fused Trainer.step()s on a multi-param model under a DECAYING LR
+schedule must execute with ZERO post-warmup XLA compiles (lr/t/wd/
+rescale ride as traced scalars), the fused path must actually engage
+(params_fused > 0), and a 5-step fused-vs-sequential A/B must be
+bit-identical.  Runs on the CPU backend so the gate is deterministic
+and fast on any host.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the gate A/Bs fused vs aggregate_num=1 — an exported aggregation-size
+# env var beats the ctor arg and would collapse both arms into one
+for _var in ("MXNET_OPTIMIZER_AGGREGATION_SIZE",
+             "MXTPU_OPTIMIZER_AGGREGATION_SIZE"):
+    os.environ.pop(_var, None)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import _imperative, autograd, gluon, lr_scheduler, nd  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+from mxnet_tpu.gluon import trainer as trainer_mod  # noqa: E402
+
+N_LAYERS, UNITS, WARMUP, STEPS = 15, 16, 5, 50
+
+
+def build(aggregate_num=None):
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    for _ in range(N_LAYERS):
+        net.add(nn.Dense(UNITS, in_units=UNITS))
+    net.initialize(mx.init.Xavier())
+    kwargs = {"learning_rate": 0.1, "momentum": 0.9,
+              "lr_scheduler": lr_scheduler.FactorScheduler(
+                  step=5, factor=0.95, base_lr=0.1)}
+    if aggregate_num is not None:
+        kwargs["aggregate_num"] = aggregate_num
+    trainer = gluon.Trainer(net.collect_params(), "sgd", kwargs)
+    x = nd.array(np.random.rand(4, UNITS).astype(np.float32))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    return net, trainer
+
+
+def main():
+    net, trainer = build()
+    for _ in range(WARMUP):
+        trainer.step(1)
+    nd.waitall()
+    lr0 = trainer.learning_rate
+    trainer_mod.reset_trainer_step_stats()
+    c0 = _imperative.compiled_executable_count()
+    for _ in range(STEPS):
+        trainer.step(1)
+    nd.waitall()
+    compiles = _imperative.compiled_executable_count() - c0
+    stats = trainer_mod.trainer_step_stats()
+    assert compiles == 0, \
+        f"step fusion recompiled: {compiles} new executables in " \
+        f"{STEPS} post-warmup steps (lr schedule must ride as a " \
+        "traced scalar)"
+    assert trainer.learning_rate < lr0, \
+        f"LR schedule did not decay ({lr0} -> {trainer.learning_rate})"
+    assert stats["params_fused"] == STEPS * 2 * N_LAYERS, \
+        f"fused path did not engage: {stats}"
+
+    # 5-step bit parity: fused (default) vs aggregate_num=1 sequential
+    net_seq, trainer_seq = build(aggregate_num=1)
+    for _ in range(5):
+        trainer_seq.step(1)
+    net_fused, trainer_fused = build()
+    for _ in range(5):
+        trainer_fused.step(1)
+    for a, b in zip(net_fused.collect_params().values(),
+                    net_seq.collect_params().values()):
+        if not np.array_equal(a.data().asnumpy(), b.data().asnumpy()):
+            raise AssertionError(
+                f"fused/sequential weight divergence on {a.name}")
+
+    print(f"STEP_FUSION_SMOKE_OK steps={STEPS} "
+          f"post_warmup_compiles={compiles} "
+          f"dispatches_per_step={stats['dispatches_per_step']} "
+          f"params_fused={stats['params_fused']} "
+          f"lr {lr0:.4f}->{trainer.learning_rate:.4f}")
+
+
+if __name__ == "__main__":
+    main()
