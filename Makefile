@@ -56,9 +56,15 @@ serve-smoke:
 step-fusion-smoke:
 	env PYTHONPATH=. python tools/step_fusion_smoke.py
 
+# input-pipeline gate: prefetch overlap engaged, zero post-warmup
+# compiles over mixed lengths, bit-identical mid-epoch resume — see
+# tools/pipeline_smoke.py / docs/data.md
+pipeline-smoke:
+	env PYTHONPATH=. python tools/pipeline_smoke.py
+
 # the ROADMAP tier-1 gate, verbatim ($$ = make-escaped shell $)
 verify: SHELL := /bin/bash
-verify: serve-smoke step-fusion-smoke
+verify: serve-smoke step-fusion-smoke pipeline-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
-.PHONY: all clean test verify serve-smoke step-fusion-smoke
+.PHONY: all clean test verify serve-smoke step-fusion-smoke pipeline-smoke

@@ -536,8 +536,129 @@ def _leaf_trainer_step(platform):
     }))
 
 
+def _leaf_input_pipeline(platform):
+    """Input-pipeline A/B (mxnet_tpu.pipeline): end-to-end train-loop
+    throughput with prefetch_to_device vs synchronous feeding, through
+    a real hybridized train step (DataParallelTrainer's single jitted
+    SPMD step — the GIL-light consumer the pipeline is designed for).
+
+    The ingest stage models the production input shape: a per-sample
+    blocking fetch (real file read + a fixed remote-storage service
+    latency, MXTPU_BENCH_INGEST_MS) and a light decode.  Synchronous
+    feeding serializes fetch latency into every step; the pipeline's
+    map workers + h2d double-buffering hide it behind the previous
+    step.  A/B on the same warmed executables: post_warmup_compiles
+    must stay 0 (the acceptance invariant)."""
+    # parallel blocking fetches need headroom beyond the default 4 host
+    # workers; set BEFORE mxnet_tpu reads it at pool creation
+    os.environ.setdefault("MXTPU_CPU_WORKER_NTHREADS", "8")
+    _leaf_setup(platform)
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import _imperative, gluon, pipeline
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import data_parallel
+    from mxnet_tpu.pipeline import pipeline_stats, reset_pipeline_stats
+
+    from mxnet_tpu.base import getenv
+
+    feat, bs, n, rounds = 4096, 8, 64, 3
+    service_ms = getenv("BENCH_INGEST_MS", 8.0, float)
+    workdir = tempfile.mkdtemp(prefix="mxtpu-input-pipeline-")
+    try:
+        rng = np.random.RandomState(0)
+        files = []
+        for i in range(n):
+            p = os.path.join(workdir, f"s{i}.bin")
+            with open(p, "wb") as f:
+                f.write(rng.rand(feat).astype(np.float32).tobytes())
+            files.append((p, np.float32(i % 10)))
+
+        def ingest(s):
+            path, y = s
+            with open(path, "rb") as f:
+                payload = f.read()
+            time.sleep(service_ms / 1e3)  # remote-storage service time
+            return np.frombuffer(payload, np.float32) * (1.0 / 255.0), y
+
+        def build_pipe(sync):
+            return (pipeline.Pipeline(files, sync=sync)
+                    .map(ingest, inflight=8)
+                    .batch(bs, last_batch="discard")
+                    .prefetch_to_device(mx.cpu(), depth=2))
+
+        mx.random.seed(0)
+        np.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(512, in_units=feat, activation="relu"),
+                nn.Dense(512, in_units=512, activation="relu"),
+                nn.Dense(10, in_units=512))
+        net.initialize(mx.init.Xavier())
+        trainer = data_parallel.DataParallelTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.01})
+
+        def epoch(pipe):
+            for x, y in pipe:
+                trainer.step(x, y).asnumpy()
+
+        epoch(build_pipe(True))   # warmup: compiles the step once
+        epoch(build_pipe(False))
+        c0 = _imperative.compiled_executable_count()
+        step_cache0 = trainer._step_fn._cache_size() \
+            if hasattr(trainer._step_fn, "_cache_size") else None
+        sync_times, pf_times, sync_wait, pf_wait = [], [], [], []
+        pf_stats = None
+        for _ in range(rounds):           # interleaved A/B rounds
+            reset_pipeline_stats()
+            t0 = time.perf_counter()
+            epoch(build_pipe(True))
+            sync_times.append(time.perf_counter() - t0)
+            sync_wait.append(pipeline_stats()["wait_ms"])
+            reset_pipeline_stats()
+            t0 = time.perf_counter()
+            epoch(build_pipe(False))
+            pf_times.append(time.perf_counter() - t0)
+            pf_stats = pipeline_stats()
+            pf_wait.append(pf_stats["wait_ms"])
+        compiles = _imperative.compiled_executable_count() - c0
+        if step_cache0 is not None:
+            compiles += trainer._step_fn._cache_size() - step_cache0
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    n_batches = n // bs
+    sync_s, pf_s = min(sync_times), min(pf_times)
+    import jax
+
+    dev = jax.devices()[0]
+    print(json.dumps({
+        "metric": "input_pipeline_train_throughput",
+        "value": round(n_batches / pf_s, 2),
+        "unit": "batches/sec",
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "batch_size": bs,
+        "feature_dim": feat,
+        "ingest_service_ms": service_ms,
+        "synchronous_batches_per_sec": round(n_batches / sync_s, 2),
+        "speedup_vs_synchronous": round(sync_s / pf_s, 4),
+        "post_warmup_compiles": compiles,
+        "wait_on_input_ms_sync": round(min(sync_wait), 1),
+        "wait_on_input_ms_prefetch": round(min(pf_wait), 1),
+        "prefetch_hits": pf_stats["prefetch_hits"],
+        "prefetch_misses": pf_stats["prefetch_misses"],
+        "h2d_ms": pf_stats["h2d_ms"],
+    }))
+
+
 _LEAVES = {"resnet": _leaf_resnet, "bert": _leaf_bert,
-           "serve": _leaf_serve, "trainer_step": _leaf_trainer_step}
+           "serve": _leaf_serve, "trainer_step": _leaf_trainer_step,
+           "input_pipeline": _leaf_input_pipeline}
 
 
 # ---------------------------------------------------------------------------
@@ -663,9 +784,11 @@ def main():
     # tpu-dead latch must not have already demoted the primary metric
     # to CPU on a healthy chip
     records = {}
-    # serve/trainer_step last: their records are satellites of the two
-    # north-star workloads and must never delay or demote them
-    for model in ("bert", "resnet", "serve", "trainer_step"):
+    # serve/trainer_step/input_pipeline last: their records are
+    # satellites of the two north-star workloads and must never delay
+    # or demote them
+    for model in ("bert", "resnet", "serve", "trainer_step",
+                  "input_pipeline"):
         rec, tpu_ok = _measure(model, tpu_ok, note)
         if rec is not None:
             records[model] = rec

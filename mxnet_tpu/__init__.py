@@ -40,6 +40,7 @@ _LAZY = {
     "profiler": ".profiler",
     "test_utils": ".test_utils",
     "parallel": ".parallel",
+    "pipeline": ".pipeline",
     "models": ".models",
     "amp": ".amp",
     "monitor": ".monitor",

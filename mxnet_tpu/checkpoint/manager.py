@@ -184,12 +184,16 @@ class CheckpointManager:
 
     # -- save ---------------------------------------------------------------
 
-    def save(self, step, params=None, trainer=None, epoch=None, extra=None,
-             sync=False):
+    def save(self, step, params=None, trainer=None, pipeline=None,
+             epoch=None, extra=None, sync=False):
         """Checkpoint `step` asynchronously; returns the commit future.
 
         params : gluon Block or name->NDArray dict (optional)
         trainer : gluon.Trainer (optional) — optimizer states + counters
+        pipeline : pipeline.Pipeline (optional) — every stage's iterator
+            state (source position, shuffle ring + RNG, in-flight
+            batches), captured synchronously at call time so the resumed
+            job replays the exact remaining batch sequence
         extra : JSON-serializable user metadata stored in the manifest
         sync : block until committed (always implied under NaiveEngine)
 
@@ -224,6 +228,11 @@ class CheckpointManager:
                         "params": _capture(_param_dict(params)),
                         "trainer": (None if trainer is None
                                     else _capture(trainer.states_dict())),
+                        # pipeline state is host trees by construction
+                        # (in-flight device batches drain to numpy), so
+                        # the d2h readback passes it through untouched
+                        "pipeline": (None if pipeline is None
+                                     else pipeline.state_dict()),
                         "rng": _random.get_state(),
                     }
                 meta = {"format_version": self.FORMAT_VERSION,
@@ -315,6 +324,11 @@ class CheckpointManager:
                 with open(p, "wb") as f:
                     pickle.dump(state["trainer"], f)
                 atomic.fsync_file(p)
+            if state["pipeline"] is not None:
+                p = os.path.join(tmp, f"pipeline-shard{rank}.state")
+                with open(p, "wb") as f:
+                    pickle.dump(state["pipeline"], f)
+                atomic.fsync_file(p)
             atomic.write_json(os.path.join(tmp, f"rng-shard{rank}.json"),
                               state["rng"])
             atomic.fsync_dir(tmp)
@@ -374,16 +388,21 @@ class CheckpointManager:
 
     # -- restore ------------------------------------------------------------
 
-    def restore(self, step=None, params=None, trainer=None,
+    def restore(self, step=None, params=None, trainer=None, pipeline=None,
                 restore_rng=True):
         """Load checkpoint `step` (default: ``latest()``) in place.
 
-        params/trainer mirror ``save()`` targets; parameters load into
-        the Block/dict, optimizer states + update counters into the
-        Trainer, and the global RNG is rewound so the resumed run draws
-        the same stream the killed run would have.  Returns the manifest
-        metadata ``{"step", "epoch", "extra", "params"}`` — "params" is
-        the loaded name->NDArray dict only when no target was given.
+        params/trainer/pipeline mirror ``save()`` targets; parameters
+        load into the Block/dict, optimizer states + update counters
+        into the Trainer, iterator state into a freshly built
+        identically-composed Pipeline (which then replays the exact
+        remaining batch sequence), and the global RNG is rewound so the
+        resumed run draws the same stream the killed run would have.
+        The RNG is restored BEFORE the pipeline so replay-skip sources
+        that draw from it replay the saved epoch's permutation.
+        Returns the manifest metadata ``{"step", "epoch", "extra",
+        "params"}`` — "params" is the loaded name->NDArray dict only
+        when no target was given.
         """
         self.wait_until_finished()
         if step is None:
@@ -431,6 +450,7 @@ class CheckpointManager:
                 if os.path.isfile(rpath):
                     with open(rpath) as f:
                         _random.set_state(json.load(f))
+            self._restore_pipeline(d, rank, pipeline)
         return {"step": int(manifest["step"]),
                 "epoch": manifest.get("epoch"),
                 "extra": manifest.get("extra"),
@@ -489,6 +509,18 @@ class CheckpointManager:
             else:  # NDArray
                 tgt._data = arr._data
         return None
+
+    def _restore_pipeline(self, d, rank, pipeline):
+        pfile = os.path.join(d, f"pipeline-shard{rank}.state")
+        if pipeline is None:
+            return
+        if not os.path.isfile(pfile):
+            raise MXNetError(
+                f"{d}: checkpoint has no input-pipeline state for "
+                f"process {rank} (was it saved without pipeline=?)")
+        with open(pfile, "rb") as f:
+            blob = pickle.load(f)
+        pipeline.load_state_dict(blob)
 
     def _restore_trainer(self, d, rank, trainer):
         tfile = os.path.join(d, f"trainer-shard{rank}.states")

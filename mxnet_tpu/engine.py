@@ -287,6 +287,14 @@ def d2h_stream(ctx=None):
     return stream_manager().get(ctx, "d2h")
 
 
+def h2d_stream(ctx=None):
+    """The host→device staging lane for `ctx` — the pipeline's device
+    prefetcher double-buffers batches here (pull + batched_put per
+    batch, FIFO within the lane) so input staging overlaps both the
+    consumer's previous step and the d2h checkpoint readbacks."""
+    return stream_manager().get(ctx, "h2d")
+
+
 # ---------------------------------------------------------------------------
 # Flat-buffer staging (the fused trainer-step tier; ref: the reference's
 # aggregate multi_sgd updates + the bucketed gradient fusion the

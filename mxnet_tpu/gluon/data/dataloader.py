@@ -2,33 +2,77 @@
 
 The reference uses multiprocessing workers rebuilding NDArrays through
 shared memory; that exists to dodge the GIL during OpenCV decode.  Here
-host-side batchification runs on the engine's thread pool (NumPy/PIL
-release the GIL) with a bounded prefetch queue — same overlap, no
-process fork (fork is unsafe once the PjRt runtime is live, the same
-reason the reference forks workers BEFORE CUDA init).
+the loader is a THIN COMPOSITION over ``mxnet_tpu.pipeline``: batch
+indices stream from the sampler into a ``map`` stage that batchifies on
+the engine's host thread pool (NumPy/PIL release the GIL) with a
+bounded in-flight window — same overlap, no process fork (fork is
+unsafe once the PjRt runtime is live, the same reason the reference
+forks workers BEFORE CUDA init).
+
+``timeout`` is honored per batch: a fetch exceeding it raises an
+actionable error naming the stuck batch index (``timeout=0`` or
+``None`` disables the bound, matching the ref convention where 0
+means "wait forever").  ``pin_memory`` is
+accepted for ref-API compatibility but is a no-op — host→device
+staging belongs to ``pipeline.prefetch_to_device`` / the engine's h2d
+stream, and XLA owns its own pinned staging buffers.
 """
 from __future__ import annotations
 
-import numpy as np
+import collections
 
-from ... import engine
-from ...ndarray import ndarray as _nd
-from ...ndarray.ndarray import NDArray
+from ...pipeline.stages import default_batchify as default_batchify_fn  # noqa: F401 - re-export (canonical copy lives in pipeline)
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
 
-def default_batchify_fn(data):
-    """Stack samples into a batch (ref: default_batchify_fn)."""
-    if isinstance(data[0], NDArray):
-        import jax.numpy as jnp
+class _EpochBatches:
+    """Stateful batch-index source for ``DataLoader.as_pipeline()``.
 
-        return _nd.from_jax(jnp.stack([d._data for d in data]))
-    if isinstance(data[0], tuple):
-        return tuple(default_batchify_fn(list(x)) for x in zip(*data))
-    arr = np.asarray(data)
-    if arr.dtype == np.float64:
-        arr = arr.astype(np.float32)
-    return _nd.array(arr)
+    Ordinary iteration streams lazily from the batch_sampler (no
+    memory overhead, unbounded samplers keep working).  Only
+    ``state_dict()`` pins the epoch: it drains the REMAINDER of the
+    live sampler iterator into a queue (indices only) and saves that,
+    so a shuffled epoch's permutation is part of the saved state — not
+    re-drawn from any RNG on restore, where a fresh ``RandomSampler``
+    draw would silently diverge.  The live source keeps serving from
+    the same queue afterwards, so capture never perturbs the stream.
+    State capture therefore requires a finite epoch."""
+
+    def __init__(self, batch_sampler):
+        self._batch_sampler = batch_sampler
+        self._it = None
+        self._queued = collections.deque()
+        self._pinned = False  # queue is the whole remainder
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._queued:
+            return self._queued.popleft()
+        if self._pinned:
+            raise StopIteration
+        if self._it is None:
+            self._it = iter(self._batch_sampler)
+        return next(self._it)
+
+    def reset(self):
+        self._it = None  # next epoch re-samples (fresh shuffle)
+        self._queued.clear()
+        self._pinned = False
+
+    def state_dict(self):
+        if not self._pinned:
+            if self._it is None:
+                self._it = iter(self._batch_sampler)
+            self._queued.extend(self._it)
+            self._pinned = True
+        return {"remaining": [list(b) for b in self._queued]}
+
+    def load_state_dict(self, state):
+        self._queued = collections.deque(
+            list(b) for b in state["remaining"])
+        self._pinned = True
 
 
 class DataLoader:
@@ -54,28 +98,20 @@ class DataLoader:
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._prefetch_depth = max(
             1, prefetch if prefetch is not None else 2 * max(num_workers, 1))
+        self._timeout = timeout
+
+    def as_pipeline(self):
+        """One epoch as a ``pipeline.Pipeline`` — compose further stages
+        (``shard``, ``prefetch_to_device``) or checkpoint it via
+        ``CheckpointManager.save(..., pipeline=...)``."""
+        from ...pipeline import Pipeline
+
+        return Pipeline(_EpochBatches(self._batch_sampler)).map(
+            self._fetch_batch, inflight=self._prefetch_depth,
+            timeout=self._timeout)
 
     def __iter__(self):
-        fetch = self._fetch_batch
-        batches = iter(self._batch_sampler)
-        pending = []
-
-        def enqueue():
-            try:
-                idxs = next(batches)
-            except StopIteration:
-                return False
-            pending.append(engine.push_host(fetch, idxs))
-            return True
-
-        for _ in range(self._prefetch_depth):
-            if not enqueue():
-                break
-        while pending:
-            fut = pending.pop(0)
-            out = fut.result()
-            enqueue()
-            yield out
+        return iter(self.as_pipeline())
 
     def _fetch_batch(self, idxs):
         return self._batchify_fn([self._dataset[i] for i in idxs])

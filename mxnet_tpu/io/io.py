@@ -81,6 +81,17 @@ class DataIter:
     def getpad(self):
         return 0
 
+    def as_pipeline(self):
+        """Adapt this iterator into the pipeline tier
+        (``mxnet_tpu.pipeline``): downstream stages — ``rebatch`` to a
+        new batch geometry, ``shard``, ``prefetch_to_device`` — compose
+        over the emitted DataBatch stream.  Iterators exposing
+        ``state_dict``/``load_state_dict`` (``NDArrayIter``) resume
+        exactly from a checkpoint; others replay (reset + skip)."""
+        from ..pipeline import Pipeline
+
+        return Pipeline(self)
+
 
 class NDArrayIter(DataIter):
     """Iterate over in-memory arrays (ref: mx.io.NDArrayIter)."""
@@ -97,7 +108,13 @@ class NDArrayIter(DataIter):
         self.cursor = -batch_size
         self._order = np.arange(self.num_data)
         if shuffle:
-            np.random.shuffle(self._order)
+            # draw from the framework's seeded RNG, not numpy's global
+            # stream: mx.random.seed() makes shuffled epochs reproducible
+            # and get_state/set_state (the checkpoint RNG snapshot)
+            # captures the permutation source
+            from .. import random as _random
+
+            _random.np_rng().shuffle(self._order)
         if last_batch_handle == "discard":
             self.num_batches = self.num_data // batch_size
         else:
@@ -116,7 +133,20 @@ class NDArrayIter(DataIter):
     def reset(self):
         self.cursor = -self.batch_size
         if self.shuffle:
-            np.random.shuffle(self._order)
+            from .. import random as _random
+
+            _random.np_rng().shuffle(self._order)
+
+    def state_dict(self):
+        """Exact mid-epoch iterator state: cursor + the epoch's (possibly
+        shuffled) permutation — a pipeline ``IterableSource`` delegates
+        here so a checkpoint-restored stream replays bit-identically
+        without replay-skipping or touching the global RNG."""
+        return {"cursor": int(self.cursor), "order": self._order.copy()}
+
+    def load_state_dict(self, state):
+        self.cursor = int(state["cursor"])
+        self._order = np.asarray(state["order"])
 
     def iter_next(self):
         self.cursor += self.batch_size

@@ -1,0 +1,28 @@
+"""mxnet_tpu.pipeline — device-prefetching, checkpointable input
+pipeline (see docs/data.md).
+
+The missing quadrant next to checkpoint (fault tolerance), serve
+(inference), and the fused trainer step (compute): once the step is one
+allreduce + one fused update, a real job's bottleneck moves to the
+input side.  This subsystem keeps the chip fed with AOT-shaped batches
+(zero post-warmup compiles via bucket padding), overlaps host build and
+H2D transfer with the previous step (dedicated h2d stream, double
+buffering), partitions the stream per replica with a deterministic
+uneven-tail contract, and checkpoints every stage's iterator state so a
+SIGTERM-resumed job replays the exact remaining batch sequence::
+
+    from mxnet_tpu import pipeline
+
+    pipe = (pipeline.Pipeline(dataset)
+            .shuffle(1024, seed=7)
+            .map(augment)
+            .batch(32, bucket_spec=spec)
+            .shard(num_replicas, rank)
+            .prefetch_to_device(mx.xla(0), depth=2))
+    mgr.save(step, params=net, trainer=trainer, pipeline=pipe)
+"""
+from .stages import (Pipeline, Stage, DatasetSource,  # noqa: F401
+                     IterableSource, ShuffleStage, MapStage, BatchStage,
+                     RebatchStage, ShardStage, PrefetchToDeviceStage,
+                     default_batchify)
+from .stats import pipeline_stats, reset_pipeline_stats  # noqa: F401

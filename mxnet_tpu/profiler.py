@@ -183,6 +183,22 @@ def _trainer_step_counters(reset=False):
     return stats
 
 
+def _data_pipeline_counters(reset=False):
+    """Input-pipeline counters (batches, host-build/h2d/wait ms,
+    prefetch hit/miss) — window-scoped under reset=True exactly like
+    cachedGraph/trainerStep; only present when the pipeline tier is
+    loaded."""
+    import sys
+
+    pstats = sys.modules.get(__package__ + ".pipeline.stats")
+    if pstats is None:
+        return None
+    stats = pstats.pipeline_stats()
+    if reset:
+        pstats.reset_pipeline_stats()
+    return stats
+
+
 def dumps(reset=False, format="json"):
     """Return the trace (ref: mx.profiler.dumps).
 
@@ -211,6 +227,9 @@ def dumps(reset=False, format="json"):
     steps = _trainer_step_counters(reset)
     if steps is not None:
         data["trainerStep"] = steps
+    pipe = _data_pipeline_counters(reset)
+    if pipe is not None:
+        data["dataPipeline"] = pipe
     return json.dumps(data)
 
 
@@ -265,6 +284,17 @@ def _aggregate_table(reset=False):
                            ("allreduce buckets built", "buckets_built"),
                            ("dispatches per step", "dispatches_per_step")):
             lines.append(f"{label:<40}{steps[key]:>12}")
+    pipe = _data_pipeline_counters(reset)
+    if pipe is not None:
+        lines.append("")
+        lines.append("Data Pipeline:")
+        for label, key in (("batches delivered", "batches"),
+                           ("host build (ms)", "host_build_ms"),
+                           ("h2d staging (ms)", "h2d_ms"),
+                           ("step wait-on-input (ms)", "wait_ms"),
+                           ("prefetch hits", "prefetch_hits"),
+                           ("prefetch misses", "prefetch_misses")):
+            lines.append(f"{label:<40}{pipe[key]:>12}")
     return "\n".join(lines)
 
 

@@ -1,0 +1,487 @@
+"""mxnet_tpu.pipeline — the device-prefetching, checkpointable input
+pipeline.
+
+Covers the subsystem's contract: stage composition is batch-for-batch
+identical to the plain DataLoader; sharding is deterministic across
+ranks with the documented uneven-tail contract; bucket-padded batching
+keeps the compile surface CLOSED over mixed-length data (zero
+post-warmup executables — the ISSUE acceptance demonstration); the
+DataLoader timeout raises an actionable error naming the stuck batch;
+and a checkpoint→kill→restore run replays the exact remaining batch
+sequence bit-identically, prefetch depth and all.
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import _imperative, checkpoint, io, pipeline, profiler
+from mxnet_tpu.gluon import data as gdata
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.pipeline import pipeline_stats, reset_pipeline_stats
+from mxnet_tpu.serve import BucketSpec
+
+FEAT = 3
+
+
+def _samples(n, feat=FEAT, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(rng.rand(4, feat).astype(np.float32),
+             np.float32(i % 5)) for i in range(n)]
+
+
+def _varlen_samples(n, lengths=(2, 3, 5, 7, 8), feat=FEAT, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(rng.rand(int(rng.choice(lengths)), feat).astype(np.float32),
+             np.float32(i % 5)) for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# stage behavior
+
+
+def test_pipeline_parity_vs_dataloader():
+    """map+batch composition yields byte-identical batches to the plain
+    (sequential) DataLoader over the same dataset."""
+    data = _samples(22)
+    ds = gdata.ArrayDataset([d for d, _ in data], [l for _, l in data])
+    dl = gdata.DataLoader(ds, batch_size=5, shuffle=False)
+    pipe = pipeline.Pipeline(ds).batch(5, last_batch="keep")
+    got = list(pipe)
+    want = list(dl)
+    assert len(got) == len(want) == len(dl)
+    for (gx, gy), (wx, wy) in zip(got, want):
+        assert np.array_equal(gx.asnumpy(), wx.asnumpy())
+        assert np.array_equal(gy.asnumpy(), wy.asnumpy())
+
+
+def test_shuffle_seeded_and_epoch_advances():
+    data = list(range(40))
+    a = list(pipeline.Pipeline(data).shuffle(16, seed=9))
+    b = list(pipeline.Pipeline(data).shuffle(16, seed=9))
+    assert a == b                      # same seed -> same order
+    assert sorted(a) == data           # a permutation, nothing lost
+    assert a != data                   # and actually shuffled
+    p = pipeline.Pipeline(data).shuffle(16, seed=9)
+    e1 = list(p)
+    p.reset()
+    e2 = list(p)
+    assert e1 == a
+    assert e1 != e2                    # RNG stream continues across epochs
+
+
+def test_map_ordered_async():
+    data = list(range(30))
+    p = pipeline.Pipeline(data).map(lambda v: v * v, inflight=6)
+    assert list(p) == [v * v for v in data]
+
+
+def test_batch_last_batch_modes():
+    data = list(range(10))
+    keep = list(pipeline.Pipeline(data).batch(4, last_batch="keep"))
+    assert [b.shape[0] for b in keep] == [4, 4, 2]
+    disc = list(pipeline.Pipeline(data).batch(4, last_batch="discard"))
+    assert [b.shape[0] for b in disc] == [4, 4]
+    p = pipeline.Pipeline(data).batch(4, last_batch="rollover")
+    assert [b.shape[0] for b in p] == [4, 4]
+    p.reset()                          # remainder carries into epoch 2
+    e2 = list(p)
+    assert [b.shape[0] for b in e2] == [4, 4, 4]
+    assert e2[0].asnumpy().tolist() == [8.0, 9.0, 0.0, 1.0]
+
+
+def test_rebatch_from_data_iter():
+    x = np.arange(60, dtype=np.float32).reshape(30, 2)
+    y = np.arange(30, dtype=np.float32)
+    it = io.NDArrayIter(x, y, batch_size=7, last_batch_handle="discard")
+    p = it.as_pipeline().map(lambda b: (b.data[0], b.label[0])).rebatch(5)
+    chunks = list(p)
+    assert [c[0].shape[0] for c in chunks] == [5, 5, 5, 5, 5, 3]
+    got = np.concatenate([c[0].asnumpy() for c in chunks])
+    assert np.array_equal(got, x[:28])
+    got_y = np.concatenate([c[1].asnumpy() for c in chunks])
+    assert np.array_equal(got_y, y[:28])
+
+
+def test_prefetch_to_device_lands_ndarrays():
+    data = _samples(9)
+    p = (pipeline.Pipeline(data).batch(3)
+         .prefetch_to_device(mx.cpu(), depth=2))
+    out = list(p)
+    assert len(out) == 3
+    for x, y in out:
+        assert isinstance(x, mx.nd.NDArray)
+        assert isinstance(y, mx.nd.NDArray)
+    ref = list(pipeline.Pipeline(data).batch(3))
+    for (gx, _), (wx, _) in zip(out, ref):
+        assert np.array_equal(gx.asnumpy(), wx.asnumpy())
+
+
+# ---------------------------------------------------------------------------
+# sharding contract
+
+
+def test_shard_determinism_and_uneven_tails():
+    data = list(range(11))  # 11 = 3*3 + 2: uneven tail of 2
+
+    def rank_stream(rank, tail):
+        return list(pipeline.Pipeline(data).shard(3, rank, tail=tail))
+
+    # drop: the partial group vanishes on EVERY rank -> equal counts
+    drops = [rank_stream(r, "drop") for r in range(3)]
+    assert drops == [[0, 3, 6], [1, 4, 7], [2, 5, 8]]
+    assert len({len(d) for d in drops}) == 1
+    # pad: every rank still yields the same count; tail ranks wrap
+    # deterministically (rank % len(partial))
+    pads = [rank_stream(r, "pad") for r in range(3)]
+    assert pads == [[0, 3, 6, 9], [1, 4, 7, 10], [2, 5, 8, 9]]
+    assert len({len(p) for p in pads}) == 1
+    # running twice is identical (determinism across "ranks" = runs)
+    assert [rank_stream(r, "pad") for r in range(3)] == pads
+    with pytest.raises(mx.MXNetError):
+        pipeline.Pipeline(data).shard(3, 3)
+    with pytest.raises(mx.MXNetError):
+        pipeline.Pipeline(data).shard(0, 0)
+
+
+def test_shard_composes_with_batching():
+    data = _samples(26)
+    per_rank = [
+        list(pipeline.Pipeline(data).shard(2, r).batch(4,
+                                                       last_batch="discard"))
+        for r in range(2)]
+    assert len(per_rank[0]) == len(per_rank[1]) == 3
+    # rank streams are disjoint interleavings of the source
+    r0 = np.concatenate([b[0].asnumpy() for b in per_rank[0]])
+    r1 = np.concatenate([b[0].asnumpy() for b in per_rank[1]])
+    assert not np.array_equal(r0, r1)
+
+
+# ---------------------------------------------------------------------------
+# closed compile surface over mixed lengths
+
+
+def test_bucket_batching_zero_post_warmup_compiles():
+    """Mixed-length elements padded into a BucketSpec grid: after one
+    warmup epoch has visited every bucket shape, further epochs run
+    with ZERO new XLA executables."""
+    spec = BucketSpec(batch_sizes=(4,), example_shape=(None, FEAT),
+                      lengths=(4, 8))
+    data = _varlen_samples(24)
+    mx.random.seed(5)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, flatten=False, in_units=FEAT, activation="relu"),
+            nn.Dense(2, flatten=False, in_units=8))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+
+    def epoch():
+        shapes = set()
+        p = (pipeline.Pipeline(data)
+             .batch(4, last_batch="discard", bucket_spec=spec)
+             .prefetch_to_device(mx.cpu(), depth=2))
+        for x, _ in p:
+            net(x).wait_to_read()
+            shapes.add(tuple(x.shape))
+        return shapes
+
+    warm_shapes = epoch()   # warmup: compiles one executable per bucket
+    assert warm_shapes <= {(4, 4, FEAT), (4, 8, FEAT)}
+    assert len(warm_shapes) == 2  # both buckets actually exercised
+    c0 = _imperative.compiled_executable_count()
+    for _ in range(2):
+        epoch()
+    assert _imperative.compiled_executable_count() - c0 == 0
+
+
+# ---------------------------------------------------------------------------
+# DataLoader satellites
+
+
+def test_dataloader_timeout_names_stuck_batch():
+    class Slow:
+        def __len__(self):
+            return 5
+
+        def __getitem__(self, i):
+            if i == 3:
+                time.sleep(5)
+            return np.float32(i)
+
+    dl = gdata.DataLoader(Slow(), batch_size=1, timeout=0.4)
+    with pytest.raises(mx.MXNetError, match=r"batch 3"):
+        list(dl)
+    # generous timeout passes untouched; pin_memory accepted as no-op
+    dl = gdata.DataLoader(list(np.arange(6, dtype=np.float32)),
+                          batch_size=2, timeout=300, pin_memory=True)
+    assert len(list(dl)) == 3
+
+
+def test_dataloader_as_pipeline_checkpoints():
+    ds = gdata.ArrayDataset(np.arange(24, dtype=np.float32).reshape(12, 2),
+                            np.arange(12, dtype=np.float32))
+    dl = gdata.DataLoader(ds, batch_size=3, shuffle=False)
+    p = dl.as_pipeline()
+    next(p)
+    st = p.state_dict()
+    rest = [b[0].asnumpy() for b in p]
+    q = dl.as_pipeline()
+    q.load_state_dict(st)
+    rest2 = [b[0].asnumpy() for b in q]
+    assert len(rest) == len(rest2) == 3
+    assert all(np.array_equal(a, b) for a, b in zip(rest, rest2))
+
+
+def test_shuffled_dataloader_resume_exact():
+    """Review regression: a shuffle=True DataLoader pipeline must
+    resume the exact remaining batch sequence — the epoch's permutation
+    rides in the saved state instead of being re-drawn on restore."""
+    ds = gdata.ArrayDataset(np.arange(30, dtype=np.float32).reshape(15, 2),
+                            np.arange(15, dtype=np.float32))
+    dl = gdata.DataLoader(ds, batch_size=3, shuffle=True)
+    p = dl.as_pipeline()
+    next(p)
+    st = p.state_dict()
+    rest = [b[0].asnumpy() for b in p]
+    np.random.seed(999)  # restore must not depend on any global RNG
+    q = dl.as_pipeline()
+    q.load_state_dict(st)
+    rest2 = [b[0].asnumpy() for b in q]
+    assert len(rest) == len(rest2) == 4
+    for a, b in zip(rest, rest2):
+        assert np.array_equal(a, b)
+
+
+def test_dataloader_iteration_stays_lazy():
+    """Review regression: plain DataLoader iteration must stream from
+    the batch_sampler, not drain it upfront — an unbounded sampler
+    works until state_dict() pins the epoch."""
+    import itertools
+
+    class Unbounded:
+        def __iter__(self):
+            return ([i, i + 1] for i in itertools.count(0, 2))
+
+        def __len__(self):
+            return 1 << 30
+
+    ds = list(np.arange(1000, dtype=np.float32))
+    dl = gdata.DataLoader(ds, batch_sampler=Unbounded())
+    got = list(itertools.islice(iter(dl), 3))
+    assert [b.asnumpy().tolist() for b in got] == \
+        [[0, 1], [2, 3], [4, 5]]
+
+
+def test_rebatch_drops_data_iter_pad_rows():
+    """Review regression: NDArrayIter's last_batch_handle='pad' wraps
+    tail batches around to the first samples and records DataBatch.pad;
+    rebatch must drop those rows, not re-emit them as real samples."""
+    x = np.arange(20, dtype=np.float32).reshape(10, 2)
+    y = np.arange(10, dtype=np.float32)
+    it = io.NDArrayIter(x, y, batch_size=4, last_batch_handle="pad")
+    chunks = list(it.as_pipeline().rebatch(5))
+    got = np.concatenate([c[0].asnumpy() for c in chunks])
+    assert got.shape[0] == 10  # exactly the dataset, no duplicated head
+    assert np.array_equal(np.sort(got[:, 0]), x[:, 0])
+
+
+def test_prefetch_hit_stats_exclude_eos():
+    """Review regression: end-of-epoch sentinels are not batches and
+    must not inflate the prefetch hit/miss telemetry."""
+    reset_pipeline_stats()
+    n = len(list(pipeline.Pipeline(_samples(6)).batch(3)
+                 .prefetch_to_device(mx.cpu(), depth=2)))
+    s = pipeline_stats()
+    assert n == 2
+    assert s["prefetch_hits"] + s["prefetch_misses"] == n
+
+
+def test_ndarrayiter_shuffle_draws_from_mx_random():
+    """satellite: the permutation comes from mx.random's capturable
+    numpy stream — seeded construction is reproducible, and
+    get_state/set_state replays reset()'s reshuffle exactly."""
+    x = np.arange(20, dtype=np.float32)
+    mx.random.seed(123)
+    a = io.NDArrayIter(x, batch_size=4, shuffle=True)
+    mx.random.seed(123)
+    b = io.NDArrayIter(x, batch_size=4, shuffle=True)
+    assert np.array_equal(a._order, b._order)
+    snap = mx.random.get_state()
+    a.reset()
+    after = a._order.copy()
+    mx.random.set_state(snap)
+    b.reset()
+    assert np.array_equal(after, b._order)
+
+
+# ---------------------------------------------------------------------------
+# mid-epoch resume
+
+
+def _build_resume_pipe(data):
+    return (pipeline.Pipeline(data).shuffle(7, seed=13)
+            .map(lambda s: (s[0] * 2.0, s[1]))
+            .batch(4, last_batch="rollover")
+            .prefetch_to_device(mx.cpu(), depth=2))
+
+
+def test_checkpoint_kill_restore_replays_exact_sequence(tmp_path):
+    """The acceptance path: consume part of an epoch, checkpoint with
+    pipeline= alongside params, 'kill' (fresh objects), restore, and
+    the remaining batch sequence is bit-identical — shuffle ring,
+    in-flight prefetch depth and rollover remainder included."""
+    data = _varlen_samples(30, lengths=(4,))
+    mx.random.seed(2)
+    net = nn.Dense(2, in_units=FEAT)
+    net.initialize(mx.init.Xavier())
+    mgr = checkpoint.CheckpointManager(str(tmp_path), keep_n=2)
+
+    p = _build_resume_pipe(data)
+    for _ in range(3):
+        next(p)
+    mgr.save(7, params=net, pipeline=p, sync=True)
+    rest = [(x.asnumpy(), y.asnumpy()) for x, y in p]
+    assert rest  # mid-epoch: something actually remains
+
+    net2 = nn.Dense(2, in_units=FEAT)
+    net2.initialize(mx.init.Xavier())
+    q = _build_resume_pipe(data)
+    meta = mgr.restore(params=net2, pipeline=q)
+    assert meta["step"] == 7
+    rest2 = [(x.asnumpy(), y.asnumpy()) for x, y in q]
+    assert len(rest) == len(rest2)
+    for (ax, ay), (bx, by) in zip(rest, rest2):
+        assert np.array_equal(ax, bx)
+        assert np.array_equal(ay, by)
+    # params restored too (the hook saves atomically alongside them)
+    assert np.array_equal(net.weight.data().asnumpy(),
+                          net2.weight.data().asnumpy())
+
+
+def test_restore_rejects_mismatched_composition(tmp_path):
+    data = _samples(10)
+    p = pipeline.Pipeline(data).batch(2)
+    next(p)
+    mgr = checkpoint.CheckpointManager(str(tmp_path))
+    mgr.save(1, pipeline=p, sync=True)
+    q = pipeline.Pipeline(data).shuffle(4).batch(2)  # different stages
+    with pytest.raises(mx.MXNetError, match="composition"):
+        mgr.restore(pipeline=q)
+    with pytest.raises(mx.MXNetError, match="pipeline"):
+        # a params-only checkpoint cannot restore a pipeline target
+        mgr.save(2, pipeline=None, sync=True)
+        mgr.restore(step=2, pipeline=pipeline.Pipeline(data).batch(2))
+
+
+def test_iterable_source_replay_resume():
+    """Sources without their own state_dict resume by replay
+    (reset + skip), bit-exact for deterministic sources."""
+    x = np.arange(36, dtype=np.float32).reshape(18, 2)
+    src = [row for row in x]
+    p = pipeline.Pipeline(src).batch(4, last_batch="discard")
+    next(p)
+    st = p.state_dict()
+    rest = [b.asnumpy() for b in p]
+    q = pipeline.Pipeline(src).batch(4, last_batch="discard")
+    q.load_state_dict(st)
+    rest2 = [b.asnumpy() for b in q]
+    assert all(np.array_equal(a, b) for a, b in zip(rest, rest2))
+
+
+# ---------------------------------------------------------------------------
+# profiler section (satellite: window scoping regression)
+
+
+def test_profiler_datapipeline_window_scoped():
+    profiler.set_config(aggregate_stats=True)
+    profiler.start()
+    try:
+        reset_pipeline_stats()
+        data = _samples(12)
+        list(pipeline.Pipeline(data).map(lambda s: s).batch(3)
+             .prefetch_to_device(mx.cpu(), depth=2))
+        live = pipeline_stats()
+        assert live["batches"] == 4
+        d = json.loads(profiler.dumps(reset=True))
+        assert d["dataPipeline"]["batches"] == 4
+        assert d["dataPipeline"]["host_build_ms"] >= 0.0
+        # reset=True window-scoped the counters exactly like
+        # cachedGraph/trainerStep — the next dump starts from zero
+        d2 = json.loads(profiler.dumps())
+        assert d2["dataPipeline"]["batches"] == 0
+        # table path: section present and window-scoped the same way
+        list(pipeline.Pipeline(data).batch(3))
+        table = profiler.dumps(reset=True, format="table")
+        assert "Data Pipeline:" in table
+        assert json.loads(profiler.dumps())["dataPipeline"]["batches"] == 0
+    finally:
+        profiler.stop()
+        profiler.reset()
+        profiler.set_config(aggregate_stats=False)
+
+
+def test_wait_ms_counts_consumer_blocking():
+    reset_pipeline_stats()
+
+    def slow_map(s):
+        time.sleep(0.02)
+        return s
+
+    list(pipeline.Pipeline(_samples(6)).map(slow_map, inflight=1).batch(3))
+    s = pipeline_stats()
+    assert s["host_build_ms"] > 0
+    assert s["wait_ms"] > 0  # the input-bound signal actually moves
+
+
+# ---------------------------------------------------------------------------
+# stress (slow)
+
+
+@pytest.mark.slow
+def test_concurrent_prefetch_and_reload_stress(tmp_path):
+    """Checkpoint a live, deep-prefetching pipeline every few batches
+    while consuming it from the main thread, then restore from the LAST
+    checkpoint and verify the tail sequence — state capture must
+    quiesce the async lanes without corrupting the live stream."""
+    data = _varlen_samples(120, lengths=(4,), seed=3)
+
+    def build():
+        return (pipeline.Pipeline(data).shuffle(16, seed=21)
+                .map(lambda s: (s[0] + 1.0, s[1]))
+                .batch(4)
+                .prefetch_to_device(mx.cpu(), depth=3))
+
+    mgr = checkpoint.CheckpointManager(str(tmp_path), keep_n=2)
+    p = build()
+    seen = []
+    saves = 0
+    errors = []
+
+    def save_now(pipe, step):
+        try:
+            mgr.save(step, pipeline=pipe, sync=True)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    for i, (x, y) in enumerate(p):
+        seen.append((x.asnumpy(), y.asnumpy()))
+        if i % 7 == 3:
+            # capture from another thread, racing the consumer's next()
+            t = threading.Thread(target=save_now, args=(p, i))
+            t.start()
+            t.join()
+            saves += 1
+    assert not errors
+    assert saves >= 3
+    last_step = mgr.latest()
+    q = build()
+    mgr.restore(pipeline=q)
+    rest = [(x.asnumpy(), y.asnumpy()) for x, y in q]
+    tail = seen[last_step + 1:]
+    assert len(rest) == len(tail)
+    for (ax, ay), (bx, by) in zip(tail, rest):
+        assert np.array_equal(ax, bx)
+        assert np.array_equal(ay, by)
