@@ -656,9 +656,138 @@ def _leaf_input_pipeline(platform):
     }))
 
 
+def _leaf_recovery(platform):
+    """Recovery record (mxnet_tpu.resilience): time-to-resume and steps
+    lost after a HARD kill (a preemption whose final-save window was
+    missed — no preemption state registered) of a supervised training
+    run checkpointing every K steps.  The supervisor restarts
+    in-process, restore falls back to the last committed step, and the
+    replayed tail must leave the final params bit-identical to an
+    uninjected run — the recovery-cost twin of the chaos-smoke
+    correctness gate."""
+    _leaf_setup(platform)
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, checkpoint, gluon, pipeline, resilience
+    from mxnet_tpu.gluon import nn
+
+    feat, bs, n, ckpt_every, kill_step = 64, 8, 160, 4, 10
+
+    rng = np.random.RandomState(0)
+    data = [(rng.rand(feat).astype(np.float32), np.float32(i % 2))
+            for i in range(n)]
+
+    def build_model():
+        mx.random.seed(0)
+        np.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(32, in_units=feat, activation="relu"),
+                nn.Dense(1, in_units=32))
+        net.initialize(mx.init.Xavier())
+        net.hybridize()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.05},
+                                kvstore="dist_sync",
+                                update_on_kvstore=False)
+        return net, trainer
+
+    def run(ckdir, plan):
+        if plan is not None:
+            resilience.install_plan(plan)
+        try:
+            mgr = checkpoint.CheckpointManager(ckdir, keep_n=3)
+            sup = resilience.Supervisor(
+                mgr, on_preemption="resume", max_restarts=2,
+                retry=resilience.RetryPolicy(max_retries=2,
+                                             base_delay=0.01))
+            executed, marks = [], {}
+
+            def train(ctx):
+                net, trainer = build_model()
+                pipe = (pipeline.Pipeline(data).shuffle(8, seed=5)
+                        .batch(bs, last_batch="discard"))
+                start = 0
+                if ctx.manager.latest() is not None:
+                    t0 = time.perf_counter()
+                    meta = ctx.manager.restore(params=net,
+                                               trainer=trainer,
+                                               pipeline=pipe)
+                    marks["restore_done"] = time.perf_counter()
+                    marks["restore_ms"] = (marks["restore_done"] - t0) \
+                        * 1e3
+                    start = meta["step"] + 1
+                # NO preemption state: a kill loses everything since the
+                # last periodic checkpoint (the hard-kill model)
+                step = start
+                for x, y in pipe:
+                    with autograd.record():
+                        loss = ((net(x) - y.reshape((-1, 1))) ** 2).sum()
+                    loss.backward()
+                    trainer.step(bs)
+                    executed.append(step)
+                    save = dict(params=net, trainer=trainer,
+                                pipeline=pipe, sync=True) \
+                        if step % ckpt_every == 0 else None
+                    ctx.step_done(step, save=save)
+                    step += 1
+                return {k: v.data().asnumpy() for k, v in
+                        net._collect_params_with_prefix().items()}
+
+            params = sup.run(train)
+            return params, executed, marks
+        finally:
+            if plan is not None:
+                resilience.clear_plan()
+
+    d_ref = tempfile.mkdtemp(prefix="mxtpu-recovery-ref-")
+    d_chaos = tempfile.mkdtemp(prefix="mxtpu-recovery-")
+    try:
+        ref, _, _ = run(d_ref, None)
+        resilience.reset_resilience_stats()  # scope time_lost to the run
+        plan = resilience.FaultPlan([
+            {"site": "train.step", "action": "kill",
+             "match": {"step": kill_step}}])
+        got, executed, marks = run(d_chaos, plan)
+    finally:
+        shutil.rmtree(d_ref, ignore_errors=True)
+        shutil.rmtree(d_chaos, ignore_errors=True)
+
+    assert plan.fired(), "kill never fired"
+    bit_identical = set(ref) == set(got) and all(
+        np.array_equal(ref[k], got[k]) for k in ref)
+    steps_lost = len(executed) - len(set(executed))
+    # time to resume = fail->re-invocation (supervisor's time_lost_ms)
+    # + the restore itself; the replayed steps_lost are priced
+    # separately since they run at normal step speed
+    stats = resilience.resilience_stats()
+    time_to_resume_ms = round(stats["time_lost_ms"]
+                              + marks.get("restore_ms", 0.0), 2)
+    import jax
+
+    dev = jax.devices()[0]
+    print(json.dumps({
+        "metric": "recovery_time_to_resume",
+        "value": time_to_resume_ms,
+        "unit": "ms",
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "steps_lost": steps_lost,
+        "checkpoint_every": ckpt_every,
+        "kill_step": kill_step,
+        "restore_ms": round(marks.get("restore_ms", 0.0), 2),
+        "restarts": stats["restarts"],
+        "final_params_bit_identical": bool(bit_identical),
+    }))
+
+
 _LEAVES = {"resnet": _leaf_resnet, "bert": _leaf_bert,
            "serve": _leaf_serve, "trainer_step": _leaf_trainer_step,
-           "input_pipeline": _leaf_input_pipeline}
+           "input_pipeline": _leaf_input_pipeline,
+           "recovery": _leaf_recovery}
 
 
 # ---------------------------------------------------------------------------
@@ -784,11 +913,11 @@ def main():
     # tpu-dead latch must not have already demoted the primary metric
     # to CPU on a healthy chip
     records = {}
-    # serve/trainer_step/input_pipeline last: their records are
-    # satellites of the two north-star workloads and must never delay
-    # or demote them
+    # serve/trainer_step/input_pipeline/recovery last: their records
+    # are satellites of the two north-star workloads and must never
+    # delay or demote them
     for model in ("bert", "resnet", "serve", "trainer_step",
-                  "input_pipeline"):
+                  "input_pipeline", "recovery"):
         rec, tpu_ok = _measure(model, tpu_ok, note)
         if rec is not None:
             records[model] = rec

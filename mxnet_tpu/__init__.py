@@ -41,6 +41,7 @@ _LAZY = {
     "test_utils": ".test_utils",
     "parallel": ".parallel",
     "pipeline": ".pipeline",
+    "resilience": ".resilience",
     "models": ".models",
     "amp": ".amp",
     "monitor": ".monitor",

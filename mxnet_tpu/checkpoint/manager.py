@@ -73,6 +73,57 @@ def _num_processes():
         return 1
 
 
+def _get_logger():
+    from ..log import get_logger
+
+    return get_logger("mxnet_tpu.checkpoint")
+
+
+def _is_corrupt_failure(e):
+    """Does this restore failure mean the checkpoint PAYLOAD is damaged
+    (fall back to an older step), as opposed to a caller error like a
+    shape/topology mismatch (raise)?  Raw deserialization errors —
+    pickle/EOF/json — are damage by definition; MXNetErrors count only
+    when they carry the serialization tier's corrupt/truncated wording.
+    OSError deliberately does NOT count: a transient I/O blip (NFS/
+    object-store hiccup, EACCES misconfig) on an intact newest step
+    must surface retriably, not silently forfeit its progress to an
+    older step."""
+    if isinstance(e, MXNetError):
+        text = str(e).lower()
+        return "corrupt" in text or "truncated" in text
+    return isinstance(e, (pickle.UnpicklingError, EOFError, ValueError))
+
+
+def _is_fallback_skippable(e):
+    """During the auto-resume fallback scan, a step is also skippable
+    when it simply lacks a component the caller asked for (saved
+    without params=/trainer=/pipeline=) — a per-step property, not a
+    caller error, so an older complete step may still satisfy the
+    restore."""
+    return _is_corrupt_failure(e) or (
+        isinstance(e, MXNetError) and "saved without" in str(e))
+
+
+def _first_line(e):
+    """First line of an exception message, safe for empty messages
+    (a bare OSError()/EOFError() strs to '')."""
+    lines = str(e).splitlines()
+    return lines[0][:200] if lines else type(e).__name__
+
+
+def _resilience_fallback_restore():
+    """Book a successful corrupt-latest fallback into the resilience
+    telemetry (profiler 'resilience' section) when that tier is
+    available; never a hard dependency."""
+    try:
+        from ..resilience import stats as _rstats
+
+        _rstats.add("fallback_restores")
+    except Exception:  # pragma: no cover - resilience tier absent
+        pass
+
+
 # -- snapshot trees ---------------------------------------------------------
 # Two phases so the expensive part never runs on the training thread:
 # _capture (sync, cheap) swaps NDArray leaves for their underlying
@@ -297,6 +348,7 @@ class CheckpointManager:
 
     def _readback(self, state):
         with profiler.op_scope("checkpoint.save.readback", cat="checkpoint"):
+            engine.fault_point("engine.d2h")
             return _fetch(state)
 
     def _write_commit(self, fetch_fut, step, meta):
@@ -331,6 +383,11 @@ class CheckpointManager:
                 atomic.fsync_file(p)
             atomic.write_json(os.path.join(tmp, f"rng-shard{rank}.json"),
                               state["rng"])
+            # chaos site: a 'truncate' fault here corrupts a shard AFTER
+            # the writes but BEFORE the manifest/rename, committing a
+            # checkpoint whose payload is damaged — the injected failure
+            # the restore() corrupt-latest fallback is tested against
+            engine.fault_point("checkpoint.commit", dir=tmp, step=step)
             atomic.fsync_dir(tmp)
             _barrier("checkpoint-save")
             if rank == 0:
@@ -403,15 +460,67 @@ class CheckpointManager:
         Returns the manifest metadata ``{"step", "epoch", "extra",
         "params"}`` — "params" is the loaded name->NDArray dict only
         when no target was given.
+
+        With ``step=None`` a corrupt or truncated newest step does NOT
+        raise: it is logged loudly and the previous retained step is
+        restored instead (checkpoints exist to survive exactly this),
+        falling back step by step; only when *no* retained step loads
+        does restore raise, listing every step's failure.  An explicit
+        ``step=`` keeps strict semantics (corruption raises).
         """
         self.wait_until_finished()
-        if step is None:
-            step = self.latest()
-        if step is None:
+        if step is not None:
+            return self._restore_step(int(step), params, trainer,
+                                      pipeline, restore_rng)
+        steps = self.steps()
+        if not steps:
             raise MXNetError(
                 f"no committed checkpoint under {self.directory}: nothing "
                 "to resume (an interrupted save's *.tmp directory does "
                 "not count)")
+        failures = []
+        for s in reversed(steps):
+            try:
+                meta = self._restore_step(s, params, trainer, pipeline,
+                                          restore_rng)
+            except Exception as e:  # noqa: BLE001 — filtered below
+                if not _is_fallback_skippable(e):
+                    if failures:
+                        # a failed earlier attempt may already have
+                        # applied some components (e.g. params landed,
+                        # then the trainer blob raised): never let the
+                        # caller mistake this for an untouched target
+                        raise MXNetError(
+                            f"restore failed at step {s} while falling "
+                            f"back past corrupt step(s) "
+                            f"{[f[0] for f in failures]}: "
+                            f"{_first_line(e)} — the restore target may "
+                            "be PARTIALLY mutated by the failed "
+                            "attempt(s); restore an explicit step= or "
+                            "rebuild the targets before retrying") from e
+                    raise
+                failures.append((s, e))
+                _get_logger().error(
+                    "checkpoint step %d under %s is corrupt, truncated "
+                    "or incomplete (%s); falling back to the previous "
+                    "retained step",
+                    s, self.directory, _first_line(e))
+                continue
+            if failures:
+                _get_logger().error(
+                    "restored step %d after %d newer corrupt step(s): %s "
+                    "— training resumes from older state; investigate "
+                    "the storage layer",
+                    s, len(failures), [f[0] for f in failures])
+                _resilience_fallback_restore()
+            return meta
+        raise MXNetError(
+            f"no retained checkpoint under {self.directory} is loadable "
+            "— every step failed: "
+            + "; ".join(f"step {s}: {_first_line(e)[:150]}"
+                        for s, e in failures))
+
+    def _restore_step(self, step, params, trainer, pipeline, restore_rng):
         d = self._dir_for(int(step))
         mpath = os.path.join(d, MANIFEST)
         if not os.path.isfile(mpath):

@@ -353,8 +353,54 @@ def batched_put(jarrs, device):
     broadcast uses this instead of a per-parameter device_put loop."""
     import jax
 
+    fault_point("engine.h2d", n=len(jarrs), device=str(device))
     outs = jax.device_put(list(jarrs), device)
     return [track(o) for o in outs]
+
+
+# ---------------------------------------------------------------------------
+# Fault points (mxnet_tpu.resilience.faults): named chaos-injection sites
+# compiled into the runtime's failure-prone seams — transfers, collectives,
+# checkpoint commits, pipeline map batches, training-step boundaries.  The
+# default binding is a pure no-op; ``resilience.faults.install_plan``
+# rebinds the module global to the armed plan's dispatcher, so callers
+# (`engine.fault_point(...)` — attribute lookup resolves the CURRENT
+# binding) pay one no-op call when nothing is armed and zero branches are
+# taken.  ``MXTPU_FAULT_PLAN`` (JSON, inline or a file path) arms a plan
+# at first fire without import-order coupling.
+
+
+def _fault_noop(site, /, **ctx):
+    """Disarmed fault point: nothing beyond the call is evaluated.
+    (`site` is positional-only so ctx keys like `name` never clash.)"""
+    return None
+
+
+fault_point = _fault_noop
+
+
+def set_fault_dispatcher(fn):
+    """Rebind the fault-point hook (resilience.faults installs/clears
+    the armed plan's dispatcher here; ``None`` restores the no-op)."""
+    global fault_point
+    fault_point = _fault_noop if fn is None else fn
+
+
+def fault_points_armed():
+    return fault_point is not _fault_noop
+
+
+if getenv("FAULT_PLAN"):
+    def _fault_bootstrap(site, /, **ctx):
+        # first fire installs the env plan (lazy: resilience imports
+        # engine, so the import must not happen at engine-import time),
+        # which rebinds `fault_point`; dispatch through the new binding
+        from .resilience import faults
+
+        faults.install_from_env()
+        return fault_point(site, **ctx)
+
+    fault_point = _fault_bootstrap
 
 
 # Donation coordination: the async checkpoint tier snapshots live

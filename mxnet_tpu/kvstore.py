@@ -136,6 +136,9 @@ class KVStore:
         op is elementwise.  Sparse values, gradient compression, the
         server-side-optimizer and dist_async paths all fall through to
         the sequential form unchanged."""
+        from . import engine as _engine
+
+        _engine.fault_point("kvstore.pushpull")
         if isinstance(key, (list, tuple)) and len(key) > 1 \
                 and self._fusion_eligible():
             keys, values = _normalize(key, value)

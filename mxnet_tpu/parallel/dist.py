@@ -16,9 +16,22 @@ from __future__ import annotations
 import os
 import threading
 
+from .. import engine as _engine
 from ..base import MXNetError, getenv
 
 _initialized = False
+
+
+def _collective_timeout():
+    """The bounded-failure-detector window, seconds; 0 = wait forever.
+
+    ``MXTPU_DIST_TIMEOUT`` is the documented knob (docs/ENV_VARS.md);
+    the original ``MXTPU_BARRIER_TIMEOUT_S`` spelling is honored as a
+    fallback so existing launch scripts keep working."""
+    t = getenv("DIST_TIMEOUT", None, float)
+    if t is None:
+        t = getenv("BARRIER_TIMEOUT_S", 0.0, float)
+    return t
 
 
 def _bounded(fn, what):
@@ -27,12 +40,12 @@ def _bounded(fn, what):
     Ref: ps-lite vans retry with timeouts and the Postoffice barrier
     has PS_VAN_TIMEOUT; XLA's in-graph collectives instead HANG when a
     peer dies mid-step (gRPC keeps the stream open for minutes).
-    MXTPU_BARRIER_TIMEOUT_S bounds that: the call runs on a watchdog
+    MXTPU_DIST_TIMEOUT bounds that: the call runs on a watchdog
     thread and a timeout raises a diagnosable MXNetError naming the
     likely cause and the recovery path.  0 (default) = wait forever
     (single-job semantics, same as the reference's default).
     """
-    timeout = getenv("BARRIER_TIMEOUT_S", 0.0, float)
+    timeout = _collective_timeout()
     if not timeout:
         try:
             return fn()
@@ -56,7 +69,7 @@ def _bounded(fn, what):
     if not done.wait(timeout):
         raise MXNetError(_peer_death_msg(
             f"{what} did not complete within "
-            f"MXTPU_BARRIER_TIMEOUT_S={timeout:g}s"))
+            f"MXTPU_DIST_TIMEOUT={timeout:g}s"))
     if "error" in box:
         err = box["error"]
         if isinstance(err, Exception):
@@ -80,13 +93,18 @@ def _peer_death_msg(prefix):
     import jax
 
     return (
-        f"{prefix} (process {jax.process_index()}/"
-        f"{jax.process_count()}): a peer process is likely dead or "
-        "partitioned. Check the other workers' logs, then restart the "
-        "job and resume from the last committed checkpoint: "
-        "mxnet_tpu.checkpoint.CheckpointManager(ckpt_dir)"
+        f"{prefix} (rank {jax.process_index()} of "
+        f"{jax.process_count()} workers): a peer process is likely "
+        "dead or partitioned. Check the other workers' logs. A job "
+        "running under mxnet_tpu.resilience.Supervisor recovers "
+        "automatically — it classifies this failure as peer_death, "
+        "re-inits the process group where possible, and otherwise "
+        "exits cleanly with a resume marker so a restart continues "
+        "from the last committed checkpoint. Manual recovery: restart "
+        "the job and mxnet_tpu.checkpoint.CheckpointManager(ckpt_dir)"
         ".restore(params=net, trainer=trainer) picks the newest "
-        "complete snapshot (see docs/checkpointing.md).")
+        "complete snapshot (see docs/resilience.md, "
+        "docs/checkpointing.md).")
 
 
 def _raise_if_peer_death(e, what):
@@ -174,6 +192,9 @@ def allreduce(value):
     """
     import jax
 
+    # before the single-process early-out so chaos rehearsals can
+    # inject collective faults without a multi-process launch
+    _engine.fault_point("dist.allreduce")
     if jax.process_count() <= 1:
         return value
     import jax.numpy as jnp
@@ -204,10 +225,30 @@ def allreduce(value):
     return _wrap(track(out))
 
 
+def reinit():
+    """Tear down and re-create the process group — the supervisor's
+    peer-death recovery attempt.  Only succeeds when every SURVIVING
+    peer (plus any replacement worker) calls it under the same
+    coordinator; callers treat any exception as "not possible
+    in-process" and fall back to clean exit + resume marker."""
+    global _initialized, _world_mesh_cache
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    except Exception:  # noqa: BLE001 — already dead is fine
+        pass
+    _world_mesh_cache = None
+    _allreduce_jit_cache.clear()
+    _initialized = False
+    init()
+
+
 def barrier(name="kvstore"):
     """Ref: Postoffice barrier."""
     import jax
 
+    _engine.fault_point("dist.barrier", name=name)
     if jax.process_count() <= 1:
         return
     from jax.experimental import multihost_utils

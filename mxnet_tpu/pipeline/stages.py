@@ -314,6 +314,7 @@ class MapStage(Stage):
     def _run(self, item):
         t0 = time.perf_counter()
         with profiler.op_scope("pipeline.map", cat="dataPipeline"):
+            engine.fault_point("pipeline.map")
             out = self._fn(item)
         _stats.add("host_build_ms", (time.perf_counter() - t0) * 1e3)
         return out

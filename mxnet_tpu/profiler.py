@@ -128,6 +128,33 @@ def record_op(name, begin_us, end_us, shapes=None, cat="operator"):
             })
 
 
+# Open-scope registry: while armed (the supervisor's watchdog turns it
+# on via track_scopes), every entered-but-not-exited op scope is
+# visible per thread — how a stalled job names its stuck PHASE (a
+# completed-events trace can only name what finished).  One global
+# boolean check per scope when disarmed.
+_scope_track = False
+_scope_lock = threading.Lock()
+_open_scopes = {}  # thread ident -> [scope names, innermost last]
+
+
+def track_scopes(on=True):
+    """Arm/disarm open-scope tracking (watchdog diagnostics)."""
+    global _scope_track
+    _scope_track = bool(on)
+    if not on:
+        with _scope_lock:
+            _open_scopes.clear()
+
+
+def active_scopes():
+    """Snapshot of currently OPEN op scopes per thread; populated only
+    while ``track_scopes(True)``."""
+    with _scope_lock:
+        return {tid: list(stack) for tid, stack in _open_scopes.items()
+                if stack}
+
+
 class _OpScope:
     __slots__ = ("name", "cat", "t0")
 
@@ -136,12 +163,22 @@ class _OpScope:
         self.cat = cat
 
     def __enter__(self):
+        if _scope_track:
+            with _scope_lock:
+                _open_scopes.setdefault(threading.get_ident(),
+                                        []).append(self.name)
         self.t0 = time.perf_counter() * 1e6
         return self
 
     def __exit__(self, *a):
         record_op(self.name, self.t0, time.perf_counter() * 1e6,
                   cat=self.cat)
+        if _scope_track:
+            with _scope_lock:
+                stack = _open_scopes.get(threading.get_ident())
+                # entered before arming: nothing of ours to pop
+                if stack and stack[-1] == self.name:
+                    stack.pop()
 
 
 def op_scope(name, cat="operator"):
@@ -199,6 +236,22 @@ def _data_pipeline_counters(reset=False):
     return stats
 
 
+def _resilience_counters(reset=False):
+    """Supervisor/fault-recovery counters (restarts, retries by fault
+    class, fallback_restores, watchdog_fires, time_lost_ms) —
+    window-scoped under reset=True exactly like cachedGraph/trainerStep/
+    dataPipeline; only present when the resilience tier is loaded."""
+    import sys
+
+    rstats = sys.modules.get(__package__ + ".resilience.stats")
+    if rstats is None:
+        return None
+    stats = rstats.resilience_stats()
+    if reset:
+        rstats.reset_resilience_stats()
+    return stats
+
+
 def dumps(reset=False, format="json"):
     """Return the trace (ref: mx.profiler.dumps).
 
@@ -230,6 +283,9 @@ def dumps(reset=False, format="json"):
     pipe = _data_pipeline_counters(reset)
     if pipe is not None:
         data["dataPipeline"] = pipe
+    res = _resilience_counters(reset)
+    if res is not None:
+        data["resilience"] = res
     return json.dumps(data)
 
 
@@ -295,6 +351,18 @@ def _aggregate_table(reset=False):
                            ("prefetch hits", "prefetch_hits"),
                            ("prefetch misses", "prefetch_misses")):
             lines.append(f"{label:<40}{pipe[key]:>12}")
+    res = _resilience_counters(reset)
+    if res is not None:
+        lines.append("")
+        lines.append("Resilience (supervisor):")
+        for label, key in (("restarts", "restarts"),
+                           ("fallback restores", "fallback_restores"),
+                           ("watchdog fires", "watchdog_fires"),
+                           ("time lost (ms)", "time_lost_ms")):
+            lines.append(f"{label:<40}{res[key]:>12}")
+        for cls in sorted(res["retries"]):
+            lines.append(f"{'retries[' + cls + ']':<40}"
+                         f"{res['retries'][cls]:>12}")
     return "\n".join(lines)
 
 

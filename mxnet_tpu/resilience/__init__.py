@@ -1,0 +1,25 @@
+"""mxnet_tpu.resilience — fault-injection harness + self-healing
+training supervisor.
+
+Two halves that test each other (see docs/resilience.md):
+
+- :mod:`.faults` — a seeded, deterministic :class:`FaultPlan` arming
+  named ``engine.fault_point`` sites (kill-at-step-N, transient
+  collective errors, transfer delays, checkpoint-commit truncation,
+  pipeline map stalls).  Zero overhead unless armed.
+- :mod:`.supervisor` — :class:`Supervisor`.run(train_fn) owns the
+  retry/resume policy: classification, bounded backoff
+  (:class:`RetryPolicy`), preemption final-save + restart, peer-death
+  re-init or clean exit with a resume marker, corrupt-checkpoint
+  fallback, and a progress watchdog naming the stuck phase.
+
+Recovery telemetry lands in the profiler's ``resilience`` section
+(:func:`resilience_stats`).
+"""
+from .faults import (FaultInjected, FaultPlan, FaultSpec,  # noqa: F401
+                     TransientFault, armed, clear_plan, install_from_env,
+                     install_plan, parse_plan)
+from .retry import RetryPolicy  # noqa: F401
+from .stats import resilience_stats, reset_resilience_stats  # noqa: F401
+from .supervisor import (Preempted, ResumeRequired, RunContext,  # noqa: F401
+                         Supervisor, WatchdogTimeout, classify)

@@ -1,0 +1,593 @@
+"""mxnet_tpu.resilience — fault-injection harness + self-healing
+training supervisor.
+
+Covers the subsystem's contract (docs/resilience.md): a disarmed fault
+point is a pure no-op (zero-overhead acceptance check); an armed
+FaultPlan replays deterministically; the RetryPolicy backs off
+exponentially, bounded and seeded; exception classification routes
+every fault class to its recovery; a kill-at-step-N SIGTERM resumes
+bit-identically (params + RNG + batch sequence); a corrupt-latest
+checkpoint falls back to the previous retained step loudly; the
+watchdog diagnostic names the stuck phase; and the resilience profiler
+section window-scopes like every other section.
+"""
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, checkpoint, engine, gluon, pipeline
+from mxnet_tpu import profiler, resilience
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel import dist
+from mxnet_tpu.resilience import (FaultPlan, FaultSpec, Preempted,
+                                  ResumeRequired, RetryPolicy, Supervisor,
+                                  TransientFault, WatchdogTimeout, armed,
+                                  classify, resilience_stats,
+                                  reset_resilience_stats)
+
+FEAT, BS, N = 4, 4, 32
+
+
+# ---------------------------------------------------------------------------
+# fault harness
+
+
+def test_fault_point_noop_when_disarmed_zero_overhead():
+    """No plan armed: the hook IS the module no-op (nothing evaluated
+    beyond the call), and a hot-loop of fires costs no measurable
+    time."""
+    assert engine.fault_point is engine._fault_noop
+    fire = engine.fault_point
+    t0 = time.perf_counter()
+    for _ in range(100_000):
+        fire("kvstore.pushpull")
+    dt = time.perf_counter() - t0
+    # ~10ns/call in practice; 1.5s is 15us/call — pure anti-flake margin
+    assert dt < 1.5, f"disarmed fault point cost {dt:.3f}s / 100k calls"
+
+
+def test_fault_plan_arm_disarm_rebinds_hook():
+    plan = FaultPlan([{"site": "x", "action": "delay", "delay_s": 0.0}])
+    plan.arm()
+    try:
+        assert engine.fault_points_armed()
+        assert getattr(engine.fault_point, "__self__", None) is plan
+    finally:
+        plan.disarm()
+    assert engine.fault_point is engine._fault_noop
+
+
+def test_fault_plan_deterministic_replay():
+    """Same plan (seed + specs) + same hit sequence => identical fire
+    record, including probabilistic specs."""
+    spec = [{"site": "s", "action": "delay", "delay_s": 0.0,
+             "prob": 0.3, "times": None}]
+
+    def drive(plan):
+        with armed(plan):
+            for _ in range(200):
+                engine.fault_point("s")
+        return [(f["site"], f["hit"]) for f in plan.fired()]
+
+    a = drive(FaultPlan(spec, seed=11))
+    b = drive(FaultPlan(spec, seed=11))
+    c = drive(FaultPlan(spec, seed=12))
+    assert a == b and len(a) > 0
+    assert a != c, "different seeds should draw different fire patterns"
+    # reset() rewinds counters AND per-spec RNGs: the same object replays
+    plan = FaultPlan(spec, seed=11)
+    assert drive(plan) == drive(plan.reset()) == a
+
+
+def test_fault_spec_match_on_hit_times():
+    plan = FaultPlan([
+        {"site": "train.step", "action": "raise", "match": {"step": 2},
+         "times": 1},
+        {"site": "io", "action": "raise", "on_hit": 3},
+    ])
+    with armed(plan):
+        engine.fault_point("train.step", step=0)
+        engine.fault_point("train.step", step=1)
+        with pytest.raises(TransientFault):
+            engine.fault_point("train.step", step=2)
+        engine.fault_point("train.step", step=2)  # times=1: exhausted
+        engine.fault_point("io")
+        engine.fault_point("io")
+        with pytest.raises(TransientFault):
+            engine.fault_point("io")
+    assert plan.hits("train.step") == 4
+    assert [f["site"] for f in plan.fired()] == ["train.step", "io"]
+
+
+def test_fault_plan_validation_and_env_parse(tmp_path):
+    with pytest.raises(MXNetError, match="unknown fault action"):
+        FaultSpec("s", "explode")
+    with pytest.raises(MXNetError, match="on_hit is 1-based"):
+        FaultSpec("s", "raise", on_hit=0)
+    with pytest.raises(MXNetError, match="prob"):
+        FaultSpec("s", "raise", prob=1.5)
+    with pytest.raises(MXNetError, match="neither a JSON object"):
+        resilience.parse_plan("{not json")
+    with pytest.raises(MXNetError, match="'faults' list"):
+        resilience.parse_plan('{"seed": 1}')
+    # inline JSON and file forms both parse
+    blob = ('{"seed": 5, "faults": '
+            '[{"site": "s", "action": "raise", "on_hit": 1}]}')
+    p = tmp_path / "plan.json"
+    p.write_text(blob)
+    for src in (blob, str(p)):
+        plan = resilience.parse_plan(src)
+        assert plan.seed == 5 and len(plan._specs) == 1
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+
+
+def test_retry_policy_backoff_bounded_and_deterministic():
+    p = RetryPolicy(max_retries=4, base_delay=0.1, max_delay=0.5,
+                    multiplier=2.0)
+    assert [p.delay_for(i) for i in (1, 2, 3, 4, 5)] == \
+        [0.1, 0.2, 0.4, 0.5, 0.5]
+    assert p.should_retry(4) and not p.should_retry(5)
+    # jitter is drawn from the policy's own seeded RNG: replayable
+    a = RetryPolicy(max_retries=3, base_delay=0.1, jitter=0.5, seed=9)
+    b = RetryPolicy(max_retries=3, base_delay=0.1, jitter=0.5, seed=9)
+    da = [a.delay_for(i) for i in (1, 2, 3)]
+    assert da == [b.delay_for(i) for i in (1, 2, 3)]
+    assert all(0.05 <= d <= 0.9 for d in da)
+    with pytest.raises(MXNetError, match="max_retries"):
+        RetryPolicy(max_retries=-1)
+
+
+def test_retry_policy_call_retries_then_raises():
+    p = RetryPolicy(max_retries=2, base_delay=0.001)
+    calls = []
+
+    def flaky(succeed_at):
+        calls.append(1)
+        if len(calls) < succeed_at:
+            raise TransientFault("flaky")
+        return "ok"
+
+    assert p.call(flaky, 3) == "ok"
+    assert len(calls) == 3
+    calls.clear()
+    with pytest.raises(TransientFault):
+        p.call(flaky, 10)
+    assert len(calls) == 3  # initial + max_retries
+
+
+# ---------------------------------------------------------------------------
+# classification
+
+
+def test_classification_routes_every_fault_class():
+    assert classify(TransientFault("x")) == "transient"
+    assert classify(Preempted("x")) == "preemption"
+    assert classify(WatchdogTimeout("x")) == "watchdog"
+    assert classify(MXNetError(dist._peer_death_msg("barrier hung"))) \
+        == "peer_death"
+    assert classify(MXNetError(
+        "f.params: corrupt or truncated NDArray file")) \
+        == "corrupt_checkpoint"
+    assert classify(MXNetError("collective UNAVAILABLE: try again")) \
+        == "transient"
+    assert classify(MXNetError("shape mismatch for 'w'")) == "fatal"
+    assert classify(ValueError("boom")) == "fatal"
+
+
+def test_peer_death_msg_names_rank_and_supervisor():
+    msg = dist._peer_death_msg("allreduce hung")
+    assert "rank 0 of" in msg
+    assert "resilience.Supervisor" in msg
+    assert "resume" in msg
+
+
+def test_dist_timeout_env_bounds_collectives(monkeypatch):
+    """MXTPU_DIST_TIMEOUT (new spelling) bounds _bounded; the timeout
+    error is the diagnosable peer-death message."""
+    monkeypatch.setenv("MXTPU_DIST_TIMEOUT", "0.2")
+    with pytest.raises(MXNetError) as ei:
+        dist._bounded(lambda: time.sleep(10), "test collective")
+    assert "MXTPU_DIST_TIMEOUT=0.2" in str(ei.value)
+    assert "likely dead or partitioned" in str(ei.value)
+    assert classify(ei.value) == "peer_death"
+    # legacy spelling still honored as the fallback
+    monkeypatch.delenv("MXTPU_DIST_TIMEOUT")
+    monkeypatch.setenv("MXTPU_BARRIER_TIMEOUT_S", "0.2")
+    with pytest.raises(MXNetError, match="likely dead"):
+        dist._bounded(lambda: time.sleep(10), "test collective")
+    # 0 = wait forever: the call just runs
+    monkeypatch.setenv("MXTPU_BARRIER_TIMEOUT_S", "0")
+    assert dist._bounded(lambda: 42, "fast") == 42
+
+
+# ---------------------------------------------------------------------------
+# supervised training: shared harness
+
+
+def _make_data(n=N):
+    rng = np.random.RandomState(0)
+    return [(rng.rand(FEAT).astype(np.float32), np.float32(i % 2))
+            for i in range(n)]
+
+
+def _build_model():
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=FEAT, activation="relu"),
+            nn.Dense(1, in_units=8))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    # dist_sync (single-process it degrades to device semantics) with a
+    # local update keeps the kvstore.pushpull fault point on the step
+    # path
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05},
+                            kvstore="dist_sync", update_on_kvstore=False)
+    return net, trainer
+
+
+def _params_np(net):
+    return {k: v.data().asnumpy()
+            for k, v in net._collect_params_with_prefix().items()}
+
+
+def _supervised_run(ckdir, plan=None, save_every=1, n_data=N,
+                    **sup_kwargs):
+    """One full supervised training job; returns (final params, batch
+    log, supervisor)."""
+    if plan is not None:
+        resilience.install_plan(plan)
+    try:
+        mgr = checkpoint.CheckpointManager(str(ckdir), keep_n=3)
+        sup_kwargs.setdefault("retry",
+                              RetryPolicy(max_retries=3, base_delay=0.001))
+        sup = Supervisor(mgr, on_preemption="resume", max_restarts=4,
+                         **sup_kwargs)
+        data = _make_data(n_data)
+        batches = {}
+
+        def train(ctx):
+            net, trainer = _build_model()
+            pipe = (pipeline.Pipeline(data).shuffle(8, seed=5)
+                    .batch(BS, last_batch="discard"))
+            start = 0
+            if ctx.manager.latest() is not None:
+                meta = ctx.manager.restore(params=net, trainer=trainer,
+                                           pipeline=pipe)
+                start = meta["step"] + 1
+            cur = {"step": start - 1}
+            ctx.set_preemption_state(lambda: dict(
+                step=cur["step"], params=net, trainer=trainer,
+                pipeline=pipe))
+            step = start
+            for x, y in pipe:
+                with autograd.record():
+                    loss = ((net(x) - y.reshape((-1, 1))) ** 2).sum()
+                loss.backward()
+                trainer.step(BS)
+                batches[step] = x.asnumpy().tobytes()
+                cur["step"] = step
+                save = dict(params=net, trainer=trainer, pipeline=pipe,
+                            sync=True) if step % save_every == 0 else None
+                ctx.step_done(step, save=save)
+                step += 1
+            return _params_np(net)
+
+        return sup.run(train), batches, sup
+    finally:
+        if plan is not None:
+            resilience.clear_plan()
+
+
+# ---------------------------------------------------------------------------
+# supervisor recovery paths
+
+
+def test_supervisor_transient_retry(tmp_path):
+    reset_resilience_stats()
+    plan = FaultPlan([{"site": "kvstore.pushpull", "action": "raise",
+                       "on_hit": 3}])
+    ref, blog_ref, _ = _supervised_run(tmp_path / "ref")
+    got, blog, _ = _supervised_run(tmp_path / "chaos", plan)
+    assert [f["site"] for f in plan.fired()] == ["kvstore.pushpull"]
+    stats = resilience_stats()
+    assert stats["retries"].get("transient") == 1
+    assert stats["restarts"] == 1
+    assert stats["time_lost_ms"] > 0
+    assert blog == blog_ref
+    for k in ref:
+        assert np.array_equal(ref[k], got[k]), f"param {k} diverged"
+
+
+def test_supervisor_transient_budget_exhausts(tmp_path):
+    plan = FaultPlan([{"site": "kvstore.pushpull", "action": "raise",
+                       "times": None}])  # unbounded: never recovers
+    with pytest.raises(MXNetError, match="persisted through"):
+        _supervised_run(tmp_path, plan,
+                        retry=RetryPolicy(max_retries=2, base_delay=0.001))
+
+
+def test_supervisor_kill_at_step_resume_bit_identical(tmp_path):
+    """The acceptance core: SIGTERM at step 3 (the PR-1 final-save hook
+    fires), in-process restart, restore — final params AND the
+    remaining batch sequence are bit-identical to the uninjected run,
+    and recovery is visible in the profiler resilience section."""
+    reset_resilience_stats()
+    ref, blog_ref, _ = _supervised_run(tmp_path / "ref")
+    plan = FaultPlan([{"site": "train.step", "action": "kill",
+                       "match": {"step": 3}}])
+    got, blog, _ = _supervised_run(tmp_path / "chaos", plan)
+    assert plan.fired() and plan.fired()[0]["action"] == "kill"
+    stats = resilience_stats()
+    assert stats["restarts"] == 1
+    assert stats["retries"].get("preemption") == 1
+    assert blog.keys() == blog_ref.keys()
+    assert blog == blog_ref, "batch sequence diverged after resume"
+    for k in ref:
+        assert np.array_equal(ref[k], got[k]), f"param {k} diverged"
+    section = json.loads(profiler.dumps())["resilience"]
+    assert section["restarts"] >= 1
+
+
+def test_supervisor_preemption_exit_writes_resume_marker(tmp_path):
+    """Default (real-preemption) policy: final save, resume marker,
+    ResumeRequired — no in-process restart."""
+    mgr = checkpoint.CheckpointManager(str(tmp_path), keep_n=2)
+    sup = Supervisor(mgr, on_preemption="exit")
+    w = mx.nd.ones((2, 2))
+
+    def train(ctx):
+        ctx.set_preemption_state(
+            lambda: dict(step=7, params={"w": w}))
+        ctx.step_done(7)
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(5)  # signal lands before this expires
+        raise AssertionError("SIGTERM was swallowed")
+
+    with pytest.raises(ResumeRequired, match="resume marker"):
+        sup.run(train)
+    assert mgr.latest() == 7, "final save must be committed before exit"
+    marker = json.load(open(sup.resume_marker))
+    assert marker["reason"] == "preemption"
+    assert marker["latest_checkpoint"] == 7
+    # the supervisor restored the original (default) SIGTERM disposition
+    assert signal.getsignal(signal.SIGTERM) in (signal.SIG_DFL,
+                                                signal.default_int_handler)
+
+
+def test_supervisor_fatal_errors_pass_through(tmp_path):
+    sup = Supervisor(checkpoint.CheckpointManager(str(tmp_path)))
+
+    def train(ctx):
+        raise ValueError("a real bug, not a fault")
+
+    with pytest.raises(ValueError, match="a real bug"):
+        sup.run(train)
+    assert resilience_stats() is not None  # no crash in telemetry
+
+
+def test_supervisor_budgets_reset_on_progress(tmp_path):
+    """Budgets are per stall point: a job making progress between
+    flakes never exhausts max_retries, while a loop stuck at one step
+    still trips the bound."""
+    sup = Supervisor(checkpoint.CheckpointManager(str(tmp_path)),
+                     on_preemption="resume",
+                     retry=RetryPolicy(max_retries=1, base_delay=0.001))
+    attempts = []
+
+    def train(ctx):
+        a = len(attempts)
+        attempts.append(a)
+        # each attempt completes one MORE step than the last, then
+        # flakes: 4 transient failures total, but progress between each
+        # resets the (max_retries=1) budget
+        for step in range(a + 1):
+            ctx.step_done(step)
+        if a < 4:
+            raise TransientFault(f"flake after step {a}")
+        return "done"
+
+    assert sup.run(train) == "done"
+    assert len(attempts) == 5
+
+
+def test_supervisor_exhausted_fallback_is_fatal(tmp_path):
+    """restore()'s terminal every-step-failed error must NOT be
+    classified as a restartable corrupt_checkpoint (restarting cannot
+    fix it)."""
+    err = MXNetError(
+        f"no retained checkpoint under {tmp_path} is loadable — every "
+        "step failed: step 2: corrupt or truncated NDArray file")
+    assert classify(err) == "fatal"
+
+
+def test_runcontext_heartbeat_feeds_watchdog(tmp_path):
+    """A step-free tail longer than watchdog_sec survives when it
+    heartbeats."""
+    sup = Supervisor(checkpoint.CheckpointManager(str(tmp_path)),
+                     watchdog_sec=0.4, max_restarts=0)
+
+    def train(ctx):
+        ctx.step_done(0)
+        for _ in range(4):  # 0.8s of step-free "export" work
+            time.sleep(0.2)
+            ctx.heartbeat()
+        return "exported"
+
+    assert sup.run(train) == "exported"
+    assert resilience_stats()["watchdog_fires"] == 0
+
+
+# ---------------------------------------------------------------------------
+# corrupt-latest checkpoint fallback (satellite regression, via the
+# injected truncation fault)
+
+
+def test_restore_falls_back_past_corrupt_latest(tmp_path):
+    reset_resilience_stats()
+    mgr = checkpoint.CheckpointManager(str(tmp_path), keep_n=3)
+    w1, w2 = mx.nd.ones((3,)) * 1, mx.nd.ones((3,)) * 2
+    mgr.save(1, params={"w": w1}, sync=True)
+    plan = FaultPlan([{"site": "checkpoint.commit", "action": "truncate"}])
+    with armed(plan):
+        mgr.save(2, params={"w": w2}, sync=True)
+    assert plan.fired(), "truncation fault must fire inside the commit"
+    assert mgr.latest() == 2, "the truncated save still COMMITS"
+    # auto-selection falls back loudly to step 1 instead of raising
+    meta = mgr.restore()
+    assert meta["step"] == 1
+    assert np.array_equal(meta["params"]["w"].asnumpy(), w1.asnumpy())
+    assert resilience_stats()["fallback_restores"] == 1
+    # an explicit step= keeps strict semantics
+    with pytest.raises(MXNetError, match="corrupt or truncated"):
+        mgr.restore(step=2)
+
+
+def test_restore_fallback_skips_component_free_steps(tmp_path):
+    """Auto-resume also skips past a step that simply lacks a component
+    the caller asked for (saved without trainer=): an older complete
+    step still satisfies the restore."""
+    mgr = checkpoint.CheckpointManager(str(tmp_path), keep_n=3)
+    net, trainer = _build_model()
+    x = mx.nd.ones((2, FEAT))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(BS)
+    mgr.save(1, params=net, trainer=trainer, sync=True)
+    mgr.save(2, params=net, sync=True)  # no trainer states at step 2
+    net2, trainer2 = _build_model()
+    meta = mgr.restore(params=net2, trainer=trainer2)
+    assert meta["step"] == 1
+    # explicit step= keeps strict semantics for the same condition
+    with pytest.raises(MXNetError, match="saved without"):
+        mgr.restore(step=2, params=net2, trainer=trainer2)
+
+
+def test_restore_raises_when_every_step_corrupt(tmp_path):
+    mgr = checkpoint.CheckpointManager(str(tmp_path), keep_n=3)
+    plan = FaultPlan([{"site": "checkpoint.commit", "action": "truncate",
+                       "times": None}])
+    with armed(plan):
+        mgr.save(1, params={"w": mx.nd.ones((3,))}, sync=True)
+        mgr.save(2, params={"w": mx.nd.ones((3,))}, sync=True)
+    with pytest.raises(MXNetError, match="every step failed"):
+        mgr.restore()
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+
+
+def test_watchdog_diagnostic_names_stuck_phase(tmp_path):
+    reset_resilience_stats()
+    sup = Supervisor(checkpoint.CheckpointManager(str(tmp_path)),
+                     watchdog_sec=0.4, max_restarts=0)
+
+    def train(ctx):
+        ctx.step_done(0)
+        with profiler.op_scope("dist.allreduce", cat="operator"):
+            time.sleep(30)  # interrupted by the watchdog
+        raise AssertionError("watchdog never fired")
+
+    t0 = time.monotonic()
+    with pytest.raises(WatchdogTimeout) as ei:
+        sup.run(train)
+    assert time.monotonic() - t0 < 20
+    msg = str(ei.value)
+    assert "no training step completed" in msg
+    assert "dist.allreduce" in msg, f"diagnostic must name the phase: {msg}"
+    assert "last completed step: 0" in msg
+    assert resilience_stats()["watchdog_fires"] == 1
+    # tracking is disarmed after the run: scopes no longer registered
+    with profiler.op_scope("after"):
+        assert profiler.active_scopes() == {}
+
+
+def test_watchdog_restart_counts_against_budget(tmp_path):
+    reset_resilience_stats()
+    calls = []
+    sup = Supervisor(checkpoint.CheckpointManager(str(tmp_path)),
+                     watchdog_sec=0.3, max_restarts=1)
+
+    def train(ctx):
+        calls.append(1)
+        if len(calls) == 1:
+            time.sleep(30)  # stall attempt 1
+        return "done"
+
+    assert sup.run(train) == "done"
+    assert len(calls) == 2
+    stats = resilience_stats()
+    assert stats["retries"].get("watchdog") == 1
+
+
+# ---------------------------------------------------------------------------
+# profiler section scoping
+
+
+def test_profiler_resilience_section_window_scoping():
+    from mxnet_tpu.resilience import stats as rstats
+
+    reset_resilience_stats()
+    rstats.add("restarts")
+    rstats.add_retry("transient", 2)
+    rstats.add("time_lost_ms", 12.5)
+    d = json.loads(profiler.dumps())
+    assert d["resilience"]["restarts"] == 1
+    assert d["resilience"]["retries"] == {"transient": 2}
+    # reset=True scopes the section to the window like cachedGraph et al.
+    json.loads(profiler.dumps(reset=True))
+    d2 = json.loads(profiler.dumps())
+    assert d2["resilience"]["restarts"] == 0
+    assert d2["resilience"]["retries"] == {}
+    assert d2["resilience"]["time_lost_ms"] == 0
+    # table form renders the block (and resets under reset=True too)
+    rstats.add_retry("watchdog")
+    profiler.set_config(aggregate_stats=True)
+    try:
+        table = profiler.dumps(reset=True, format="table")
+        assert "Resilience (supervisor):" in table
+        assert "retries[watchdog]" in table
+        assert json.loads(profiler.dumps())["resilience"]["retries"] == {}
+    finally:
+        profiler.set_config(aggregate_stats=False)
+
+
+# ---------------------------------------------------------------------------
+# multi-fault stress
+
+
+@pytest.mark.slow
+def test_multi_restart_stress_bit_identical(tmp_path):
+    """Kill + two transients + a delayed h2d across one job: every
+    recovery lands and the result still bit-matches the clean run."""
+    reset_resilience_stats()
+    ref, blog_ref, _ = _supervised_run(tmp_path / "ref", n_data=64)
+    plan = FaultPlan([
+        {"site": "train.step", "action": "kill", "match": {"step": 2}},
+        {"site": "train.step", "action": "kill", "match": {"step": 9}},
+        {"site": "kvstore.pushpull", "action": "raise", "on_hit": 7},
+        {"site": "kvstore.pushpull", "action": "raise", "on_hit": 13},
+        {"site": "engine.h2d", "action": "delay", "delay_s": 0.02,
+         "times": 2},
+    ], seed=3)
+    got, blog, _ = _supervised_run(tmp_path / "chaos", plan, n_data=64)
+    kinds = [f["action"] for f in plan.fired()]
+    assert kinds.count("kill") == 2 and kinds.count("raise") == 2
+    stats = resilience_stats()
+    assert stats["restarts"] == 4
+    assert stats["retries"] == {"preemption": 2, "transient": 2}
+    assert blog == blog_ref
+    for k in ref:
+        assert np.array_equal(ref[k], got[k]), f"param {k} diverged"
