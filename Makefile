@@ -65,13 +65,21 @@ pipeline-smoke:
 # resilience gate: a supervised run survives one injected SIGTERM and
 # one injected transient collective failure bit-identically, with the
 # recovery visible in the profiler and zero disarmed fault-point
-# overhead — see tools/chaos_smoke.py / docs/resilience.md
+# overhead, and the runtime lock-order checker observes zero
+# inversions — see tools/chaos_smoke.py / docs/resilience.md
 chaos-smoke:
 	env PYTHONPATH=. python tools/chaos_smoke.py
 
+# static-analysis gate: the mxtpu-analyze pass families (lock-order
+# races, trace-safety, determinism, repo invariants) must run clean
+# modulo the justified baseline, within the ~30s latency budget — see
+# tools/mxtpu_analyze.py / docs/static-analysis.md
+analyze:
+	env JAX_PLATFORMS=cpu PYTHONPATH=. python tools/mxtpu_analyze.py
+
 # the ROADMAP tier-1 gate, verbatim ($$ = make-escaped shell $)
 verify: SHELL := /bin/bash
-verify: serve-smoke step-fusion-smoke pipeline-smoke chaos-smoke
+verify: analyze serve-smoke step-fusion-smoke pipeline-smoke chaos-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
-.PHONY: all clean test verify serve-smoke step-fusion-smoke pipeline-smoke chaos-smoke
+.PHONY: all clean test verify analyze serve-smoke step-fusion-smoke pipeline-smoke chaos-smoke

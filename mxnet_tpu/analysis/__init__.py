@@ -1,0 +1,60 @@
+"""mxtpu-analyze: framework-aware static analysis over the mxnet_tpu
+package (docs/static-analysis.md has the pass catalog).
+
+Four pass families, each a plain ``run(index) -> [Finding]``:
+
+==========  ==============================================================
+MXA1xx      lock-order race detection (cycles, unguarded shared globals,
+            non-reentrant self-reacquire) — :mod:`.locks`
+MXA2xx      trace-safety of jit-reachable / hot-path code (host syncs,
+            control flow on traced values, unhashable jit signatures)
+            — :mod:`.trace`
+MXA3xx      determinism of the seeded-replay surface (wallclock or
+            global RNGs where bit-identical resume is promised)
+            — :mod:`.determinism`
+MXA4xx      repo invariants (base.getenv + ENV_VARS.md, profiler
+            window-scoped resets, fault-point catalog) — :mod:`.invariants`
+==========  ==============================================================
+
+Entry points: ``tools/mxtpu_analyze.py`` (= ``make analyze``, wired
+into ``make verify``); :func:`analyze` for programmatic use; and
+:mod:`.runtime` — the debug-mode runtime lock-order checker enabled by
+``make chaos-smoke`` and the slow concurrency stress tests.
+"""
+from __future__ import annotations
+
+from . import determinism, invariants, locks, trace
+from .core import (AnalysisConfig, Finding, Index, apply_baseline,
+                   load_baseline, run_passes)
+
+# ordered pass registry: (name, run) — adding a family = one entry here
+PASSES = (
+    ("locks", locks.run),
+    ("trace", trace.run),
+    ("determinism", determinism.run),
+    ("invariants", invariants.run),
+)
+
+PASS_CODES = {
+    "locks": ("MXA101", "MXA102", "MXA103"),
+    "trace": ("MXA201", "MXA202", "MXA203", "MXA204"),
+    "determinism": ("MXA301", "MXA302"),
+    "invariants": ("MXA401", "MXA402", "MXA403", "MXA404"),
+}
+
+
+def analyze(root, cfg=None, passes=None, baseline_path=None):
+    """Run the registered passes over `root` and apply the baseline.
+
+    Returns ``{"new": [...], "suppressed": [...], "unused": [...],
+    "findings": [...]}`` of :class:`Finding` (unused = stale baseline
+    keys)."""
+    findings, index = run_passes(root, cfg, passes)
+    baseline = load_baseline(baseline_path) if baseline_path else {}
+    new, suppressed, unused = apply_baseline(findings, baseline)
+    return {"new": new, "suppressed": suppressed, "unused": unused,
+            "findings": findings, "index": index}
+
+
+__all__ = ["AnalysisConfig", "Finding", "Index", "PASSES", "PASS_CODES",
+           "analyze", "apply_baseline", "load_baseline", "run_passes"]

@@ -1,0 +1,125 @@
+"""Pass family 3: determinism of the seeded-replay surface (MXA3xx).
+
+The resilience contract (docs/resilience.md, ``make chaos-smoke``) is
+that a killed+restored run replays the exact remaining batch/fault
+sequence bit-identically.  That only holds while the seeded surface —
+pipeline shuffle/map state, fault plans, retry backoff — stays a pure
+function of (seed, state).  These lints catch the two ways purity
+rots: wallclock leaking into replay state, and draws from process-
+global RNGs that a restore cannot rewind.
+
+MXA301  wallclock in replay state — a ``time.*()`` value assigned to
+        ``self.*``, returned, stored by ``state_dict``-family methods,
+        or fed to an RNG seed inside a seeded module.  (Telemetry
+        timing into locals/stat sinks is fine and not flagged.)
+MXA302  process-global RNG in a seeded module — stdlib ``random.*``
+        module calls or ``np.random.*`` global-generator draws.
+        Instantiating seeded generators (``random.Random(seed)``,
+        ``np.random.RandomState(seed)``, ``default_rng``) is the
+        sanctioned pattern and allowed.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding
+
+_TIME_FNS = {"time", "monotonic", "perf_counter", "time_ns",
+             "monotonic_ns", "perf_counter_ns"}
+_NP_RANDOM_OK = {"RandomState", "default_rng", "Generator", "SeedSequence",
+                 "PCG64", "Philox", "MT19937", "BitGenerator"}
+_RANDOM_OK = {"Random", "SystemRandom", "getstate", "setstate"}
+_STATE_FNS = {"state_dict", "load_state_dict", "getstate", "setstate",
+              "__getstate__", "__setstate__"}
+_SEED_SINKS = {"RandomState", "Random", "default_rng", "seed",
+               "SeedSequence"}
+
+
+def _seeded_modules(index):
+    want = set(index.cfg.seeded_modules)
+    return [m for name, m in sorted(index.modules.items()) if name in want]
+
+
+def _time_calls(index, mod, expr):
+    out = []
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            tgt = index.ext_call_target(mod, node.func)
+            if tgt and tgt.startswith("time.") and \
+                    tgt.split(".", 1)[1] in _TIME_FNS:
+                out.append((node, tgt))
+    return out
+
+
+def _wallclock_findings(index, mod, func, findings):
+    qual = func.key[1]
+    in_state_fn = func.name in _STATE_FNS
+
+    def flag(node, tgt, where):
+        findings.append(Finding(
+            "MXA301", mod.relpath, node.lineno, f"{qual}:{tgt}",
+            f"{tgt}() {where} in {qual} — replay state must be a pure "
+            f"function of (seed, state), not wallclock"))
+
+    for node in ast.walk(func.node):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            persists = any(
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self" for t in targets)
+            if persists or in_state_fn:
+                for call, tgt in _time_calls(index, mod, node.value):
+                    flag(call, tgt,
+                         "stored in instance/replay state")
+        elif isinstance(node, ast.Return) and node.value is not None:
+            if in_state_fn:
+                for call, tgt in _time_calls(index, mod, node.value):
+                    flag(call, tgt, "returned from a state_dict")
+        elif isinstance(node, ast.Call):
+            f = node.func
+            sink = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if sink in _SEED_SINKS:
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    for call, tgt in _time_calls(index, mod, arg):
+                        flag(call, tgt, f"seeds {sink}(...)")
+
+
+def _global_rng_findings(index, mod, func, findings):
+    qual = func.key[1]
+    for node in ast.walk(func.node):
+        if not isinstance(node, ast.Call):
+            continue
+        tgt = index.ext_call_target(mod, node.func)
+        if tgt is None:
+            continue
+        if tgt.startswith("random."):
+            fn = tgt.split(".", 1)[1]
+            if fn not in _RANDOM_OK:
+                findings.append(Finding(
+                    "MXA302", mod.relpath, node.lineno, f"{qual}:{tgt}",
+                    f"stdlib global RNG {tgt}() in seeded module "
+                    f"{mod.modname} — use a seeded random.Random/"
+                    f"np.random.RandomState instance"))
+        elif tgt.startswith("numpy.random."):
+            fn = tgt.split(".")[-1]
+            if fn not in _NP_RANDOM_OK:
+                findings.append(Finding(
+                    "MXA302", mod.relpath, node.lineno, f"{qual}:{tgt}",
+                    f"numpy global RNG {tgt}() in seeded module "
+                    f"{mod.modname} — draw from a seeded RandomState/"
+                    f"default_rng held in stage state"))
+
+
+def run(index):
+    findings = []
+    for mod in _seeded_modules(index):
+        for key, func in sorted(index.funcs.items()):
+            if func.module is not mod:
+                continue
+            _wallclock_findings(index, mod, func, findings)
+            _global_rng_findings(index, mod, func, findings)
+    return findings

@@ -1,0 +1,474 @@
+"""Pass family 1: lock-order race detection (MXA1xx).
+
+MXA101  lock-order cycle — the cross-module lock-acquisition graph
+        (every ``with lock:`` nesting, direct or through resolvable
+        calls) contains a cycle: two code paths acquire the same locks
+        in opposite orders, a potential deadlock inversion.
+MXA102  unguarded shared global — a module-global container/name is
+        mutated by code reachable from a thread entry point
+        (``threading.Thread(target=...)``, pool ``.submit``/``.push``)
+        with no ``with lock:`` lexically guarding the mutation.
+MXA103  self-reacquire — while a NON-reentrant ``threading.Lock`` is
+        held, a resolvable call path acquires the same lock again
+        (guaranteed self-deadlock the first time that path runs).
+
+Lock identity is the *declaration site*: ``module.NAME`` for globals,
+``module.Class.attr`` for ``self.attr = threading.Lock()``.  A
+``threading.Condition(existing_lock)`` aliases the underlying lock, so
+``with self._not_empty:`` and ``with self._lock:`` are one node.  Two
+instances from the same declaration site collapse to one node and
+self-edges are ignored (instance-level ordering is the runtime
+checker's job — mxnet_tpu.analysis.runtime).
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+_REENTRANT = {"RLock"}
+_MUTATORS = {"append", "extend", "insert", "pop", "remove", "clear",
+             "update", "setdefault", "popitem", "add", "discard",
+             "appendleft", "popleft", "sort", "reverse"}
+
+
+def _threading_ctor(index, mod, call):
+    """'Lock'/'RLock'/'Condition'/... when `call` constructs a
+    threading primitive, else None."""
+    if not isinstance(call, ast.Call):
+        return None
+    f = call.func
+    if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+            and mod.ext_aliases.get(f.value.id) == "threading"
+            and f.attr in _LOCK_CTORS):
+        return f.attr
+    if (isinstance(f, ast.Name) and f.id in mod.ext_from
+            and mod.ext_from[f.id][0] == "threading"
+            and mod.ext_from[f.id][1] in _LOCK_CTORS):
+        return mod.ext_from[f.id][1]
+    return None
+
+
+def _find_ctor(index, mod, value):
+    """Find a threading ctor inside `value` (direct call, or a list
+    comprehension / list display of locks)."""
+    kind = _threading_ctor(index, mod, value)
+    if kind:
+        return kind, value
+    for node in ast.walk(value):
+        kind = _threading_ctor(index, mod, node)
+        if kind:
+            return kind, node
+    return None, None
+
+
+class _LockTable:
+    def __init__(self):
+        self.kinds = {}     # lock id -> ctor kind
+        self.aliases = {}   # lock id -> canonical lock id (Condition)
+
+    def canon(self, lock_id):
+        while lock_id in self.aliases:
+            lock_id = self.aliases[lock_id]
+        return lock_id
+
+
+def _collect_locks(index):
+    table = _LockTable()
+    pending_alias = []   # (alias id, mod, cls, ctor-arg expr)
+    for mod in index.modules.values():
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign):
+                kind, ctor = _find_ctor(index, mod, node.value)
+                if not kind:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        lid = (mod.modname, t.id)
+                        table.kinds[lid] = kind
+                        if kind == "Condition" and ctor.args:
+                            pending_alias.append((lid, mod, None,
+                                                  ctor.args[0]))
+    for (modname, qual), func in index.funcs.items():
+        if func.cls is None:
+            continue
+        for node in ast.walk(func.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            kind, ctor = _find_ctor(index, func.module, node.value)
+            if not kind:
+                continue
+            for t in node.targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    lid = (modname, f"{func.cls}.{t.attr}")
+                    table.kinds[lid] = kind
+                    if kind == "Condition" and ctor.args:
+                        pending_alias.append((lid, func.module, func.cls,
+                                              ctor.args[0]))
+    for lid, mod, cls, arg in pending_alias:
+        target = _resolve_lock_expr(index, table, mod, cls, arg)
+        if target is not None and target != lid:
+            table.aliases[lid] = target
+    return table
+
+
+def _resolve_lock_expr(index, table, mod, cls, expr):
+    """Lock id a with-item / Condition-arg expression names, or None."""
+    if isinstance(expr, ast.Subscript):
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        lid = (mod.modname, expr.id)
+        if lid in table.kinds:
+            return table.canon(lid)
+        alias = mod.module_aliases.get(expr.id)
+        # `from x import some_lock` style
+        if expr.id in mod.func_imports:
+            tgt = mod.func_imports[expr.id]
+            lid = (tgt[0], tgt[1])
+            if lid in table.kinds:
+                return table.canon(lid)
+        del alias
+    elif isinstance(expr, ast.Attribute):
+        base = expr.value
+        if isinstance(base, ast.Subscript):
+            base = base.value
+        if isinstance(base, ast.Name):
+            if base.id in ("self", "cls") and cls is not None:
+                lid = (mod.modname, f"{cls}.{expr.attr}")
+                if lid in table.kinds:
+                    return table.canon(lid)
+            m = mod.module_aliases.get(base.id)
+            if m is not None:
+                lid = (m, expr.attr)
+                if lid in table.kinds:
+                    return table.canon(lid)
+        elif (isinstance(base, ast.Attribute)
+              and isinstance(base.value, ast.Name)
+              and base.value.id == "self" and cls is not None):
+            cinfo = index.classes.get((mod.modname, cls))
+            tgt = cinfo.attr_types.get(base.attr) if cinfo else None
+            if tgt is not None:
+                lid = (tgt[0], f"{tgt[1]}.{expr.attr}")
+                if lid in table.kinds:
+                    return table.canon(lid)
+    return None
+
+
+def _direct_acquires(index, table, func):
+    """Lock ids this function acquires directly (with-statements)."""
+    out = set()
+    for node in ast.walk(func.node):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                lid = _resolve_lock_expr(index, table, func.module,
+                                         func.cls, item.context_expr)
+                if lid is not None:
+                    out.add(lid)
+    return out
+
+
+def _closure_acquires(index, table):
+    """funckey -> lock ids acquired directly or through any resolvable
+    call chain (fixpoint over the call graph)."""
+    graph = index.call_graph()
+    direct = {k: _direct_acquires(index, table, f)
+              for k, f in index.funcs.items()}
+    closure = {k: set(v) for k, v in direct.items()}
+    changed = True
+    while changed:
+        changed = False
+        for k, callees in graph.items():
+            cur = closure[k]
+            before = len(cur)
+            for c in callees:
+                cur |= closure.get(c, set())
+            if len(cur) != before:
+                changed = True
+    return direct, closure
+
+
+def _lock_name(lid):
+    mod, name = lid
+    return f"{mod or '<root>'}.{name}"
+
+
+def _walk_with_held(index, table, closure, func, findings_edges):
+    """Emit (held, acquired, site) edges: direct `with` nesting plus
+    locks any call made while holding may take."""
+    mod, cls = func.module, func.cls
+
+    def visit(node, held):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = []
+            for item in node.items:
+                lid = _resolve_lock_expr(index, table, mod, cls,
+                                         item.context_expr)
+                if lid is not None:
+                    for h in held:
+                        findings_edges.append(
+                            (h, lid, func, node.lineno, "with"))
+                    acquired.append(lid)
+                    held = held + [lid]
+                else:
+                    # a with-item that's a call (e.g. op_scope(...)) may
+                    # acquire locks inside __enter__
+                    for sub in ast.walk(item.context_expr):
+                        if isinstance(sub, ast.Call):
+                            _call_edges(sub, held)
+            for child in node.body:
+                visit(child, held)
+            return
+        if isinstance(node, ast.Call):
+            _call_edges(node, held)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                # nested defs/lambdas run later, not under these locks
+                for sub in ast.walk(child):
+                    if isinstance(sub, (ast.With, ast.AsyncWith, ast.Call)):
+                        visit_nested(sub)
+                continue
+            visit(child, held)
+
+    def _call_edges(call, held):
+        if not held:
+            return
+        for target in index.resolve_call(func, call.func):
+            for lid in closure.get(target, ()):
+                for h in held:
+                    if h != lid or table.kinds.get(h) not in _REENTRANT:
+                        findings_edges.append(
+                            (h, lid, func, call.lineno,
+                             f"call {target[1]}"))
+
+    def visit_nested(node):
+        # nested function body analyzed with an empty held stack
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            visit(node, [])
+
+    for stmt in func.node.body:
+        visit(stmt, [])
+
+
+def _thread_roots(index):
+    """Function keys handed to Thread(target=...) or pool submit/push."""
+    roots = set()
+    for key, func in index.funcs.items():
+        for node in ast.walk(func.node):
+            if not isinstance(node, ast.Call):
+                continue
+            cands = []
+            f = node.func
+            is_thread = (isinstance(f, ast.Attribute)
+                         and f.attr == "Thread") or \
+                        (isinstance(f, ast.Name) and f.id == "Thread")
+            if is_thread:
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        cands.append(kw.value)
+            elif (isinstance(f, ast.Attribute)
+                  and f.attr in ("submit", "push", "push_host")):
+                if node.args:
+                    cands.append(node.args[0])
+            for c in cands:
+                if isinstance(c, ast.Lambda) and isinstance(c.body,
+                                                            ast.Call):
+                    c = c.body.func
+                roots.update(index.resolve_call(func, c))
+    return roots
+
+
+def _unguarded_global_mutations(index, table, reachable):
+    findings = []
+    for key in sorted(reachable):
+        func = index.funcs[key]
+        mod = func.module
+        # names assigned locally (or params) shadow module globals
+        declared_global = set()
+        local = set()
+        for node in ast.walk(func.node):
+            if isinstance(node, ast.Global):
+                declared_global.update(node.names)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Lambda)):
+                for a in ast.walk(node.args):
+                    if isinstance(a, ast.arg):
+                        local.add(a.arg)
+        for node in ast.walk(func.node):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id not in \
+                            declared_global:
+                        local.add(t.id)
+
+        def is_shared(name):
+            return (name in mod.globals_
+                    and (name in declared_global or name not in local)
+                    and (mod.modname, name) not in table.kinds)
+
+        def guarded(node):
+            for w in ast.walk(func.node):
+                if isinstance(w, (ast.With, ast.AsyncWith)):
+                    end = getattr(w, "end_lineno", w.lineno)
+                    if not (w.lineno <= node.lineno <= end):
+                        continue
+                    for item in w.items:
+                        if _resolve_lock_expr(index, table, mod, func.cls,
+                                              item.context_expr):
+                            return True
+            return False
+
+        for node in ast.walk(func.node):
+            name = None
+            what = None
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if (isinstance(t, ast.Name) and t.id in declared_global
+                            and is_shared(t.id)):
+                        name, what = t.id, "rebound"
+                    elif (isinstance(t, ast.Subscript)
+                          and isinstance(t.value, ast.Name)
+                          and is_shared(t.value.id)):
+                        name, what = t.value.id, "item-assigned"
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and isinstance(node.func.value, ast.Name)
+                  and node.func.attr in _MUTATORS
+                  and is_shared(node.func.value.id)):
+                name, what = node.func.value.id, f".{node.func.attr}()"
+            if name is not None and not guarded(node):
+                findings.append(Finding(
+                    "MXA102", mod.relpath, node.lineno,
+                    f"{key[1]}:{name}",
+                    f"module global '{name}' {what} in {key[1]}, "
+                    f"reachable from a thread entry point, with no "
+                    f"guarding lock"))
+    return findings
+
+
+def run(index):
+    findings = []
+    table = _collect_locks(index)
+    direct, closure = _closure_acquires(index, table)
+
+    edges = []
+    for func in index.funcs.values():
+        _walk_with_held(index, table, closure, func, edges)
+
+    # -- MXA103: non-reentrant self-reacquire
+    seen_self = set()
+    for held, lid, func, lineno, how in edges:
+        if held == lid and table.kinds.get(lid) == "Lock":
+            anchor = f"{func.key[1]}:{_lock_name(lid)}"
+            if anchor in seen_self:
+                continue
+            seen_self.add(anchor)
+            findings.append(Finding(
+                "MXA103", func.module.relpath, lineno, anchor,
+                f"non-reentrant Lock {_lock_name(lid)} may be "
+                f"re-acquired while held ({how}) — self-deadlock"))
+
+    # -- MXA101: inversion cycles over the canonical lock graph
+    adj = {}
+    edge_info = {}
+    for held, lid, func, lineno, how in edges:
+        if held == lid:
+            continue
+        adj.setdefault(held, set()).add(lid)
+        edge_info.setdefault((held, lid), (func, lineno, how))
+    for cycle in _cycles(adj):
+        names = [_lock_name(l) for l in cycle]
+        anchor = "->".join(sorted(names))
+        func, lineno, how = edge_info[(cycle[0], cycle[1])]
+        findings.append(Finding(
+            "MXA101", func.module.relpath, lineno, anchor,
+            f"lock-order cycle: {' -> '.join(names + [names[0]])} "
+            f"(first edge via {how}); two paths acquire these locks "
+            f"in opposite orders"))
+
+    # -- MXA102: unguarded shared-global mutation from thread entries
+    roots = _thread_roots(index)
+    findings.extend(_unguarded_global_mutations(
+        index, table, index.reachable(roots)))
+    return findings
+
+
+def _cycles(adj):
+    """Distinct simple cycles via SCC decomposition (one finding per
+    strongly connected component of >1 node, reported as one cycle
+    through it)."""
+    sccs = _tarjan(adj)
+    out = []
+    for scc in sccs:
+        if len(scc) < 2:
+            continue
+        # walk one cycle inside the SCC for the report
+        scc_set = set(scc)
+        start = scc[0]
+        path, seen = [start], {start}
+        node = start
+        while True:
+            nxt = next((n for n in sorted(adj.get(node, ()))
+                        if n in scc_set and n not in seen), None)
+            if nxt is None:
+                nxt = next(n for n in sorted(adj.get(node, ()))
+                           if n in scc_set)
+                out.append(path[path.index(nxt):])
+                break
+            path.append(nxt)
+            seen.add(nxt)
+            node = nxt
+    return out
+
+
+def _tarjan(adj):
+    index_counter = [0]
+    stack, lowlink, num, on_stack = [], {}, {}, set()
+    result = []
+
+    def strongconnect(v):
+        work = [(v, iter(sorted(adj.get(v, ()))))]
+        num[v] = lowlink[v] = index_counter[0]
+        index_counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in num:
+                    num[w] = lowlink[w] = index_counter[0]
+                    index_counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    lowlink[node] = min(lowlink[node], num[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                lowlink[parent] = min(lowlink[parent], lowlink[node])
+            if lowlink[node] == num[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                result.append(sorted(comp))
+
+    for v in list(adj):
+        if v not in num:
+            strongconnect(v)
+    return result

@@ -1,0 +1,322 @@
+"""Runtime lock-order checker: the dynamic complement of the static
+MXA101 pass (which can only order what it can resolve).
+
+``enable()`` patches ``threading.Lock``/``threading.RLock`` with
+factories returning checked wrappers; every wrapper records, per
+thread, the stack of locks currently held and folds each (held ->
+acquired) pair into a global order graph keyed by the lock's CREATION
+SITE (file:line, or module.attr for locks wrapped in place by
+``wrap_existing``).  Acquiring B while holding A when a B->...->A path
+already exists is an observed inversion — the interleaving that
+deadlocks exists even if this run got lucky — and raises
+:class:`LockInversionError` (or just records it with
+``raise_on_inversion=False``).
+
+Usage (``make chaos-smoke`` and the slow serve/pipeline stress tests)::
+
+    from mxnet_tpu.analysis import runtime as lock_order
+    lock_order.enable()          # wrap locks created from here on
+    lock_order.wrap_existing()   # rebind module-global locks in place
+    ... exercise the concurrent paths ...
+    lock_order.assert_clean()
+
+Scope: locks created after ``enable()`` (plus module globals rebound by
+``wrap_existing``).  Locks captured into closures/attributes before
+that are invisible — the static pass covers import-time structure.
+Same-site pairs (two instances from one allocation site) are skipped:
+instance-level ordering within a homogeneous pool is a protocol the
+graph cannot judge.  ``MXTPU_LOCK_CHECK=1`` lets ``maybe_enable()``
+turn the checker on without code changes.
+"""
+from __future__ import annotations
+
+import sys
+import threading
+import traceback
+
+from ..base import getenv
+
+_orig_Lock = threading.Lock
+_orig_RLock = threading.RLock
+
+_mu = _orig_Lock()          # guards the order graph + inversion log
+_succ = {}                  # site -> set(site): observed held->acquired
+_edge_where = {}            # (a, b) -> "thread/file:line" first witness
+_inversions = []
+_enabled = False
+_raise = True
+_tls = threading.local()
+_counts = {"wrapped": 0, "acquires": 0}   # liveness telemetry
+
+
+class LockInversionError(RuntimeError):
+    """Two threads were observed acquiring the same locks in opposite
+    orders — a latent deadlock."""
+
+
+def _held_stack():
+    st = getattr(_tls, "held", None)
+    if st is None:
+        st = _tls.held = []
+    return st
+
+
+def _caller_site():
+    for frame in traceback.extract_stack()[-8:][::-1]:
+        fn = frame.filename
+        if "analysis/runtime" in fn.replace("\\", "/") or \
+                fn.endswith("threading.py"):
+            continue
+        return f"{fn}:{frame.lineno}"
+    return "<unknown>"
+
+
+def _path_exists(src, dst):
+    # BFS under _mu; the graph is tiny (one node per allocation site)
+    if src == dst:
+        return True
+    seen, stack = {src}, [src]
+    while stack:
+        n = stack.pop()
+        for m in _succ.get(n, ()):
+            if m == dst:
+                return True
+            if m not in seen:
+                seen.add(m)
+                stack.append(m)
+    return False
+
+
+def _note_acquire(lock):
+    if not _enabled:
+        return
+    held = _held_stack()
+    site = lock._site
+    inversion = None
+    with _mu:
+        _counts["acquires"] += 1
+        for prior in held:
+            psite = prior._site
+            if psite == site:
+                continue   # same-site pool ordering: not judged here
+            if (psite, site) not in _edge_where:
+                if _path_exists(site, psite):
+                    inversion = {
+                        "acquiring": site, "while_holding": psite,
+                        "thread": threading.current_thread().name,
+                        "at": _caller_site(),
+                        "reverse_first_seen": _edge_where.get(
+                            (site, psite)),
+                    }
+                    _inversions.append(inversion)
+                _succ.setdefault(psite, set()).add(site)
+                _edge_where[(psite, site)] = (
+                    f"{threading.current_thread().name} "
+                    f"@ {_caller_site()}")
+    held.append(lock)
+    if inversion is not None and _raise:
+        raise LockInversionError(
+            f"lock-order inversion: acquiring {site} while holding "
+            f"{inversion['while_holding']} at {inversion['at']}, but the "
+            f"opposite order was first seen at "
+            f"{inversion['reverse_first_seen']}")
+
+
+def _note_release(lock):
+    held = getattr(_tls, "held", None)
+    if not held:
+        return
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] is lock:
+            del held[i]
+            return
+
+
+class _CheckedLock:
+    """Order-checking wrapper around a threading.Lock/RLock, API-
+    compatible enough for with-blocks, Condition(lock), and manual
+    acquire/release."""
+
+    __slots__ = ("_inner", "_site")
+
+    def __init__(self, inner, site):
+        self._inner = inner
+        self._site = site
+        _counts["wrapped"] += 1
+
+    def acquire(self, blocking=True, timeout=-1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            try:
+                _note_acquire(self)
+            except LockInversionError:
+                # unwind: the caller never observed a successful
+                # acquire, so the lock must not stay held
+                _note_release(self)
+                self._inner.release()
+                raise
+        return ok
+
+    def release(self):
+        _note_release(self)
+        self._inner.release()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def locked(self):
+        f = getattr(self._inner, "locked", None)
+        return f() if f is not None else False
+
+    # Condition(lock) compatibility: delegate the private protocol when
+    # the inner lock provides it, keeping the held-stack symmetric
+    def _is_owned(self):
+        f = getattr(self._inner, "_is_owned", None)
+        if f is not None:
+            return f()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        _note_release(self)
+        f = getattr(self._inner, "_release_save", None)
+        if f is not None:
+            return f()
+        self._inner.release()
+        return None
+
+    def _acquire_restore(self, state):
+        f = getattr(self._inner, "_acquire_restore", None)
+        if f is not None:
+            f(state)
+        else:
+            self._inner.acquire()
+        _note_acquire(self)
+
+    def __repr__(self):
+        return f"<CheckedLock {self._site} wrapping {self._inner!r}>"
+
+
+def _lock_factory():
+    return _CheckedLock(_orig_Lock(), _caller_site())
+
+
+def _rlock_factory():
+    return _CheckedLock(_orig_RLock(), _caller_site())
+
+
+def enable(raise_on_inversion=True):
+    """Start wrapping newly created locks; returns True if this call
+    turned the checker on (False = already enabled)."""
+    global _enabled, _raise
+    _raise = bool(raise_on_inversion)
+    if _enabled:
+        return False
+    _enabled = True
+    threading.Lock = _lock_factory
+    threading.RLock = _rlock_factory
+    return True
+
+
+def disable():
+    """Restore the original factories.  Already-wrapped locks keep
+    working but stop recording."""
+    global _enabled
+    _enabled = False
+    threading.Lock = _orig_Lock
+    threading.RLock = _orig_RLock
+
+
+def maybe_enable():
+    """enable() iff MXTPU_LOCK_CHECK is set (docs/ENV_VARS.md)."""
+    if getenv("LOCK_CHECK", False, bool):
+        return enable()
+    return False
+
+
+def wrap_existing(prefix="mxnet_tpu"):
+    """Rebind module-global Lock/RLock objects under `prefix` to
+    checked wrappers (named module.attr).  Only effective for locks the
+    owning module reads back through the global name — which is the
+    repo convention (`with _events_lock:` etc.).  Call at a quiescent
+    point: a lock held while being rebound would split its identity."""
+    if not _enabled:
+        return 0
+    lock_types = (type(_orig_Lock()), type(_orig_RLock()))
+    n = 0
+    for modname, mod in list(sys.modules.items()):
+        if mod is None or not (modname == prefix
+                               or modname.startswith(prefix + ".")):
+            continue
+        if modname.endswith("analysis.runtime"):
+            continue
+        for attr, val in list(vars(mod).items()):
+            if isinstance(val, lock_types):
+                setattr(mod, attr, _CheckedLock(val, f"{modname}.{attr}"))
+                n += 1
+    return n
+
+
+def unwrap_existing(prefix="mxnet_tpu"):
+    """Undo :func:`wrap_existing`: rebind every module-global
+    _CheckedLock under `prefix` back to its raw inner lock, so a test
+    that enabled the checker leaves pristine module state behind."""
+    n = 0
+    for modname, mod in list(sys.modules.items()):
+        if mod is None or not (modname == prefix
+                               or modname.startswith(prefix + ".")):
+            continue
+        for attr, val in list(vars(mod).items()):
+            if isinstance(val, _CheckedLock):
+                setattr(mod, attr, val._inner)
+                n += 1
+    return n
+
+
+def inversions():
+    with _mu:
+        return [dict(i) for i in _inversions]
+
+
+def stats():
+    """`sites`/`edges` describe observed NESTED pairs only (a lock
+    never held together with another contributes nothing); use
+    `locks_wrapped`/`acquires` as the did-the-checker-see-anything
+    liveness signal."""
+    with _mu:
+        sites = set(_succ)
+        for targets in _succ.values():
+            sites.update(targets)
+        return {"sites": len(sites),
+                "edges": sum(len(v) for v in _succ.values()),
+                "inversions": len(_inversions),
+                "locks_wrapped": _counts["wrapped"],
+                "acquires": _counts["acquires"]}
+
+
+def reset():
+    """Forget the observed order graph, inversion log, and liveness
+    counters (held-stack bookkeeping is left alone — it tracks live
+    state)."""
+    with _mu:
+        _succ.clear()
+        _edge_where.clear()
+        del _inversions[:]
+        _counts["wrapped"] = 0
+        _counts["acquires"] = 0
+
+
+def assert_clean():
+    """Raise AssertionError listing every observed inversion."""
+    inv = inversions()
+    if inv:
+        lines = [f"  acquiring {i['acquiring']} while holding "
+                 f"{i['while_holding']} ({i['thread']} @ {i['at']}; "
+                 f"reverse order first seen {i['reverse_first_seen']})"
+                 for i in inv]
+        raise AssertionError(
+            "lock-order inversions observed:\n" + "\n".join(lines))

@@ -1,0 +1,233 @@
+"""Pass family 2: trace-safety (MXA2xx).
+
+The whole-step SPMD goal (ROADMAP item 4) needs the jit-reachable
+surface to stay traceable: no implicit host syncs, no Python control
+flow on traced values, no unhashable jit signatures.
+
+Roots:
+- *traced* — functions that run UNDER ``jax.jit``: anything passed to
+  ``_imperative.get_jitted``/``jax.jit``, kernels matching the
+  ``_k_*``/``_fk_*`` naming convention, and the CachedOp graph fn.
+  Their package-internal callees are traced too.
+- *hot path* — host-side dispatch loops (config ``hotpath_roots``,
+  default ``serve.ModelServer._run_batch``) where a device sync is a
+  latency cliff rather than a trace error.
+
+MXA201  host sync inside traced code — ``.asnumpy()`` / ``.item()`` /
+        ``.wait_to_read()`` anywhere in the traced closure, or
+        ``float()/int()/bool()`` applied to a positional parameter of a
+        convention-named kernel (forces concretization; breaks under
+        jit, recompiles or syncs outside it).
+MXA202  Python control flow on a traced value — ``if``/``while`` whose
+        condition uses a traced positional parameter directly (not via
+        ``len()``/``isinstance()``/``.shape``-style static accessors).
+        Only checked in convention-named kernels (``_k_*``/``_fk_*``),
+        where the calling convention pins positional params as traced
+        arrays and keyword-only params as static attrs (closed via
+        ``functools.partial`` before jit); helpers the kernels call
+        routinely take static scalars positionally, so value-flow
+        checks there would drown in false positives.
+MXA203  unhashable jit signature — a ``get_jitted(fn, attrs)`` call
+        whose attrs-dict literal contains a list/set/dict value (the
+        executable-cache key would raise or, worse, never hit).
+MXA204  host sync on a serving/step hot path — ``.asnumpy()`` etc. in
+        a hot-path root or its callees; intentional readbacks belong in
+        the baseline with a justification.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding
+
+_SYNC_METHODS = {"asnumpy", "item", "wait_to_read"}
+_CONCRETIZERS = {"float", "int", "bool", "complex"}
+_STATIC_GUARDS = {"len", "isinstance", "hasattr", "getattr", "type"}
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size"}
+
+
+def _traced_roots(index):
+    roots = set()
+    cfg = index.cfg
+    for key, func in index.funcs.items():
+        name = func.name
+        if name.startswith(cfg.traced_prefixes) or name in cfg.traced_names:
+            roots.add(key)
+        # nested defs matching the convention count as part of the
+        # enclosing function (the call graph absorbs them), so a
+        # matching nested kernel makes its definer a root too
+        for node in ast.walk(func.node):
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node is not func.node
+                    and (node.name.startswith(cfg.traced_prefixes)
+                         or node.name in cfg.traced_names)):
+                roots.add(key)
+    # anything passed to get_jitted / jax.jit by name
+    for key, func in index.funcs.items():
+        for node in ast.walk(func.node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            is_get_jitted = (
+                (isinstance(f, ast.Name) and f.id == "get_jitted")
+                or (isinstance(f, ast.Attribute) and f.attr == "get_jitted")
+                or (isinstance(f, ast.Attribute) and f.attr == "jit"
+                    and isinstance(f.value, ast.Name)
+                    and func.module.ext_aliases.get(f.value.id) == "jax"))
+            if is_get_jitted and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Call):
+                    arg = arg.func   # get_jitted(wrapper(kernel), ...)
+                roots.update(index.resolve_call(func, arg))
+    return roots
+
+
+def _positional_params(node):
+    args = node.args
+    names = [a.arg for a in args.posonlyargs + args.args]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    return {n for n in names if n not in ("self", "cls")}
+
+
+def _derives_from(expr, params):
+    """True when `expr` plainly carries a traced param's value: the
+    param itself, arithmetic over it, or an index into it."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id in params:
+            return True
+    return False
+
+
+def _check_function(index, func, params, codes, findings):
+    """codes = (sync_code, flow_code) — flow_code None when value-flow
+    checks are unsound (helpers, hot paths)."""
+    sync_code, flow_code = codes
+    where = "traced" if sync_code == "MXA201" else "hot-path"
+    mod = func.module
+    qual = func.key[1]
+    for node in ast.walk(func.node):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in _SYNC_METHODS:
+                findings.append(Finding(
+                    sync_code, mod.relpath, node.lineno,
+                    f"{qual}:{f.attr}",
+                    f".{f.attr}() in {qual} forces a device->host sync "
+                    f"({where} code)"))
+            elif (flow_code and isinstance(f, ast.Name)
+                  and f.id in _CONCRETIZERS and node.args
+                  and _derives_from(node.args[0], params)):
+                findings.append(Finding(
+                    sync_code, mod.relpath, node.lineno,
+                    f"{qual}:{f.id}",
+                    f"{f.id}() on traced value in {qual} concretizes "
+                    f"the tracer (host sync / TracerConversionError)"))
+        elif flow_code and isinstance(node, (ast.If, ast.While)):
+            if _traced_condition(node.test, params):
+                findings.append(Finding(
+                    flow_code, mod.relpath, node.lineno,
+                    f"{qual}:{'if' if isinstance(node, ast.If) else 'while'}"
+                    f"@{node.test.lineno}",
+                    f"Python {'if' if isinstance(node, ast.If) else 'while'}"
+                    f" on a traced value in {qual} — control flow must be "
+                    f"lax.cond/while_loop or a static attribute"))
+
+
+def _traced_condition(test, params):
+    """A condition is traced when a bare traced param's VALUE feeds it
+    outside the static accessors (len/isinstance/.shape/is-None)."""
+    hits = []
+
+    def walk(node, static):
+        if isinstance(node, ast.Call):
+            f = node.func
+            callee_static = (isinstance(f, ast.Name)
+                             and f.id in _STATIC_GUARDS)
+            for child in ast.iter_child_nodes(node):
+                walk(child, static or callee_static)
+            return
+        if isinstance(node, ast.Attribute):
+            attr_static = node.attr in _STATIC_ATTRS
+            walk(node.value, static or attr_static)
+            return
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None` are static presence checks
+            if (len(node.ops) == 1
+                    and isinstance(node.ops[0], (ast.Is, ast.IsNot))):
+                return
+            for child in ast.iter_child_nodes(node):
+                walk(child, static)
+            return
+        if isinstance(node, ast.Name):
+            if node.id in params and not static:
+                hits.append(node)
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child, static)
+
+    walk(test, False)
+    return bool(hits)
+
+
+def _unhashable_attrs(index, findings):
+    for key, func in index.funcs.items():
+        for node in ast.walk(func.node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = f.id if isinstance(f, ast.Name) else \
+                (f.attr if isinstance(f, ast.Attribute) else None)
+            if name != "get_jitted" or len(node.args) < 2:
+                continue
+            attrs = node.args[1]
+            if not isinstance(attrs, ast.Dict):
+                continue
+            for k, v in zip(attrs.keys, attrs.values):
+                bad = None
+                if isinstance(v, (ast.List, ast.Set, ast.Dict, ast.ListComp,
+                                  ast.SetComp, ast.DictComp)):
+                    bad = type(v).__name__
+                elif (isinstance(v, ast.Call) and isinstance(v.func, ast.Name)
+                      and v.func.id in ("list", "set", "dict")):
+                    bad = v.func.id + "()"
+                if bad:
+                    kname = getattr(k, "value", "<attr>")
+                    findings.append(Finding(
+                        "MXA203", func.module.relpath, v.lineno,
+                        f"{key[1]}:{kname}",
+                        f"get_jitted attrs[{kname!r}] is a {bad} — "
+                        f"unhashable jit-signature value; use a tuple"))
+
+
+def _is_convention_kernel(cfg, func):
+    return (func.name.startswith(cfg.traced_prefixes)
+            or func.name in cfg.traced_names)
+
+
+def run(index):
+    findings = []
+    cfg = index.cfg
+    traced_roots = _traced_roots(index)
+    traced = index.reachable(traced_roots)
+    for key in sorted(traced):
+        func = index.funcs[key]
+        if _is_convention_kernel(cfg, func):
+            # positional params are traced arrays by construction:
+            # value-flow checks are sound here
+            params = _positional_params(func.node)
+            _check_function(index, func, params, ("MXA201", "MXA202"),
+                            findings)
+        else:
+            # helpers/closures: only the unambiguous sync methods
+            _check_function(index, func, set(), ("MXA201", None),
+                            findings)
+
+    hot_roots = {tuple(r) for r in index.cfg.hotpath_roots}
+    hot = index.reachable(hot_roots) - traced
+    for key in sorted(hot):
+        func = index.funcs[key]
+        _check_function(index, func, set(), ("MXA204", None), findings)
+
+    _unhashable_attrs(index, findings)
+    return findings

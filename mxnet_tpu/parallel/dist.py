@@ -123,13 +123,16 @@ def init(coordinator_address=None, num_processes=None, process_id=None):
         return
     import jax
 
-    coordinator_address = coordinator_address or os.environ.get(
-        "MXTPU_COORDINATOR") or os.environ.get("DMLC_PS_ROOT_URI")
+    # base.getenv gives the MXTPU_/MXNET_ spellings; the raw DMLC_*
+    # reads are the launcher wire protocol (docs/ENV_VARS.md) on purpose
+    coordinator_address = (coordinator_address
+                           or getenv("COORDINATOR")
+                           or os.environ.get("DMLC_PS_ROOT_URI"))
     if coordinator_address and num_processes is None:
-        num_processes = int(os.environ.get(
-            "MXTPU_NUM_WORKER", os.environ.get("DMLC_NUM_WORKER", "1")))
-        process_id = int(os.environ.get(
-            "MXTPU_WORKER_ID", os.environ.get("DMLC_WORKER_ID", "0")))
+        num_processes = getenv(
+            "NUM_WORKER", int(os.environ.get("DMLC_NUM_WORKER", "1")), int)
+        process_id = getenv(
+            "WORKER_ID", int(os.environ.get("DMLC_WORKER_ID", "0")), int)
         port = os.environ.get("DMLC_PS_ROOT_PORT")
         if port and ":" not in coordinator_address:
             coordinator_address = f"{coordinator_address}:{port}"

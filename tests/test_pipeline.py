@@ -445,7 +445,26 @@ def test_concurrent_prefetch_and_reload_stress(tmp_path):
     """Checkpoint a live, deep-prefetching pipeline every few batches
     while consuming it from the main thread, then restore from the LAST
     checkpoint and verify the tail sequence — state capture must
-    quiesce the async lanes without corrupting the live stream."""
+    quiesce the async lanes without corrupting the live stream.  Runs
+    under the runtime lock-order checker: the prefetch/map/checkpoint
+    lock nest must show zero observed inversions."""
+    from mxnet_tpu.analysis import runtime as lock_order
+
+    lock_order.reset()
+    # record-don't-raise: a raise inside a prefetch/checkpoint worker
+    # would strand the consumer instead of reporting at the end
+    assert lock_order.enable(raise_on_inversion=False), \
+        "lock-order checker was already on"
+    lock_order.wrap_existing()
+    try:
+        _prefetch_reload_stress_body(tmp_path)
+    finally:
+        lock_order.disable()
+        lock_order.unwrap_existing()
+    assert lock_order.inversions() == []
+
+
+def _prefetch_reload_stress_body(tmp_path):
     data = _varlen_samples(120, lengths=(4,), seed=3)
 
     def build():

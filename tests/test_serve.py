@@ -377,8 +377,27 @@ def test_profiler_surfaces_graph_cache_counters():
 @pytest.mark.slow
 def test_serve_stress_concurrent_submitters():
     """Many concurrent submitters + a mid-stream hot reload: every
-    accepted request resolves, the stats invariant holds, and the
-    compile surface stays closed."""
+    accepted request resolves, the stats invariant holds, the compile
+    surface stays closed, and the runtime lock-order checker observes
+    zero inversions across the batcher/stats/exec-lock nest."""
+    from mxnet_tpu.analysis import runtime as lock_order
+
+    lock_order.reset()
+    # record-don't-raise: a raise inside the batcher thread would
+    # strand the submitters' futures and hang the test
+    assert lock_order.enable(raise_on_inversion=False), \
+        "lock-order checker was already on"
+    lock_order.wrap_existing()
+    try:
+        _serve_stress_body()
+    finally:
+        lock_order.disable()
+        lock_order.unwrap_existing()
+    assert lock_order.inversions() == []
+    assert lock_order.stats()["acquires"] > 0
+
+
+def _serve_stress_body():
     srv = serve.ModelServer(_make_net(), _spec((1, 2, 4, 8), (4, 8)),
                             max_queue=512, linger_ms=2.0)
     srv.start()

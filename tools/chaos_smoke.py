@@ -33,7 +33,19 @@ import numpy as np  # noqa: E402
 import mxnet_tpu as mx  # noqa: E402
 from mxnet_tpu import autograd, checkpoint, engine, gluon  # noqa: E402
 from mxnet_tpu import pipeline, profiler, resilience  # noqa: E402
+from mxnet_tpu.analysis import runtime as lock_order  # noqa: E402
 from mxnet_tpu.gluon import nn  # noqa: E402
+
+# 6: the whole chaos rehearsal runs under the runtime lock-order
+# checker (docs/static-analysis.md): every lock created from here on
+# is order-tracked per thread, module-global locks are rebound in
+# place, and one observed inversion anywhere (batcher, checkpoint
+# readback, supervisor watchdog, prefetch lanes) fails the gate.
+# Record-don't-raise: an inversion raised inside a library background
+# thread would kill that worker mid-protocol and turn the report into
+# a hang; assert_clean() at the end surfaces everything observed.
+lock_order.enable(raise_on_inversion=False)
+N_WRAPPED = lock_order.wrap_existing()
 
 FEAT, BS, N = 4, 4, 48
 KILL_STEP, TRANSIENT_HIT = 3, 8
@@ -151,12 +163,21 @@ def main():
     dt = time.perf_counter() - t0
     assert dt < 2.0, f"disarmed fault point cost {dt:.3f}s / 200k fires"
 
+    # 6: zero lock-order inversions observed across both supervised
+    # runs (kill/restart, transient retry, async checkpoint capture)
+    lock_order.assert_clean()
+    lk = lock_order.stats()
+    assert lk["acquires"] > 0, "lock-order checker saw no acquisitions"
+
     print(f"CHAOS_SMOKE_OK steps={len(losses_ref)} "
           f"restarts={section['restarts']} "
           f"retries={section['retries']} "
           f"time_lost_ms={section['time_lost_ms']:.1f} "
           f"final_loss={losses_ref[max(losses_ref)]:.4f} "
-          f"disarmed_overhead_ns={dt / 200_000 * 1e9:.0f}")
+          f"disarmed_overhead_ns={dt / 200_000 * 1e9:.0f} "
+          f"lock_sites={lk['sites']} lock_edges={lk['edges']} "
+          f"lock_inversions={lk['inversions']} "
+          f"wrapped_module_locks={N_WRAPPED}")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,99 @@
+"""mxtpu-analyze CLI — `make analyze` (a `make verify` prerequisite).
+
+Runs the mxnet_tpu.analysis pass families over the repo, applies the
+checked-in baseline (tools/analysis_baseline.json), and fails on any
+NON-baselined finding.  See docs/static-analysis.md.
+
+  python tools/mxtpu_analyze.py            # human table, exit 1 on new
+  python tools/mxtpu_analyze.py --json     # machine-readable (CI)
+  python tools/mxtpu_analyze.py --passes locks,invariants
+  python tools/mxtpu_analyze.py --no-baseline   # raw findings
+
+Exit codes: 0 clean (modulo baseline), 1 new findings, 2 usage/crash.
+The run also enforces its own latency budget: --max-seconds (default
+30) fails the gate if the analyzer itself gets slow enough to drag
+`make verify`.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_BASELINE = os.path.join("tools", "analysis_baseline.json")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="mxtpu_analyze")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output for CI")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help=f"suppression file (default {DEFAULT_BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report every finding")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated subset (locks,trace,"
+                         "determinism,invariants)")
+    ap.add_argument("--root", default=REPO)
+    ap.add_argument("--max-seconds", type=float, default=30.0,
+                    help="fail if the analyzer itself exceeds this "
+                         "budget (0 disables)")
+    args = ap.parse_args(argv)
+
+    t0 = time.perf_counter()
+    from mxnet_tpu import analysis
+
+    passes = args.passes.split(",") if args.passes else None
+    baseline_path = None if args.no_baseline else \
+        os.path.join(args.root, args.baseline)
+    try:
+        result = analysis.analyze(args.root, passes=passes,
+                                  baseline_path=baseline_path)
+    except Exception as e:  # noqa: BLE001 — a broken analyzer must not
+        # masquerade as a clean repo
+        print(f"mxtpu-analyze: internal error: {e}", file=sys.stderr)
+        raise SystemExit(2)
+    runtime_s = time.perf_counter() - t0
+
+    new, suppressed, unused = (result["new"], result["suppressed"],
+                               result["unused"])
+    if args.json:
+        from mxnet_tpu.analysis import load_baseline
+
+        just = load_baseline(baseline_path) if baseline_path else {}
+        print(json.dumps({
+            "new": [f.to_dict() for f in new],
+            "suppressed": [dict(f.to_dict(),
+                                justification=just.get(f.key, ""))
+                           for f in suppressed],
+            "unused_suppressions": unused,
+            "counts": {"new": len(new), "suppressed": len(suppressed),
+                       "unused_suppressions": len(unused)},
+            "runtime_s": round(runtime_s, 3),
+        }, indent=2))
+    else:
+        if new:
+            print(f"{'CODE':<8}{'LOCATION':<44}MESSAGE")
+            print("-" * 100)
+            for f in new:
+                loc = f"{f.path}:{f.line}"
+                print(f"{f.code:<8}{loc:<44}{f.message}")
+                print(f"{'':<8}{'':<44}key: {f.key}")
+        for k in unused:
+            print(f"warning: stale baseline suppression (no longer "
+                  f"fires): {k}")
+        print(f"mxtpu-analyze: {len(new)} new finding(s), "
+              f"{len(suppressed)} baselined, {len(unused)} stale "
+              f"suppression(s), {runtime_s:.2f}s")
+    if args.max_seconds and runtime_s > args.max_seconds:
+        print(f"mxtpu-analyze: runtime {runtime_s:.1f}s exceeds the "
+              f"{args.max_seconds:.0f}s budget", file=sys.stderr)
+        raise SystemExit(1)
+    raise SystemExit(1 if new else 0)
+
+
+if __name__ == "__main__":
+    main()
