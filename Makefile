@@ -70,6 +70,15 @@ pipeline-smoke:
 chaos-smoke:
 	env PYTHONPATH=. python tools/chaos_smoke.py
 
+# observability gate: one traced train+serve run emits spans from all
+# five subsystems into valid Chrome trace-event JSON, an injected
+# watchdog fire leaves a loadable flight-recorder dump, /metrics
+# serves Prometheus text agreeing with profiler.dumps(), and the
+# disarmed telemetry hooks cost ~nothing — see tools/trace_smoke.py /
+# docs/observability.md
+trace-smoke:
+	env PYTHONPATH=. python tools/trace_smoke.py
+
 # static-analysis gate: the mxtpu-analyze pass families (lock-order
 # races, trace-safety, determinism, repo invariants) must run clean
 # modulo the justified baseline, within the ~30s latency budget — see
@@ -79,7 +88,7 @@ analyze:
 
 # the ROADMAP tier-1 gate, verbatim ($$ = make-escaped shell $)
 verify: SHELL := /bin/bash
-verify: analyze serve-smoke step-fusion-smoke pipeline-smoke chaos-smoke
+verify: analyze serve-smoke step-fusion-smoke pipeline-smoke chaos-smoke trace-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
-.PHONY: all clean test verify analyze serve-smoke step-fusion-smoke pipeline-smoke chaos-smoke
+.PHONY: all clean test verify analyze serve-smoke step-fusion-smoke pipeline-smoke chaos-smoke trace-smoke

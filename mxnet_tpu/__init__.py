@@ -61,6 +61,7 @@ _LAZY = {
     "model": ".model",
     "name": ".name",
     "serve": ".serve",
+    "telemetry": ".telemetry",
     "executor": ".executor",
     "libinfo": ".libinfo",
     "log": ".log",
@@ -86,3 +87,12 @@ def __getattr__(name):
 
 def waitall():
     engine.waitall()
+
+
+# telemetry env opt-ins (docs/observability.md): arming MXTPU_TRACE /
+# MXTPU_METRICS_PORT / MXTPU_FLIGHT_RECORDER needs the telemetry
+# package imported, so opt in eagerly only when one of them is set —
+# the default import stays light
+if (_getenv("TRACE") or _getenv("METRICS_PORT") is not None
+        or _getenv("FLIGHT_RECORDER") is not None):
+    from . import telemetry  # noqa: F401  (arms itself at import)

@@ -13,7 +13,8 @@ MXA3xx      determinism of the seeded-replay surface (wallclock or
             global RNGs where bit-identical resume is promised)
             — :mod:`.determinism`
 MXA4xx      repo invariants (base.getenv + ENV_VARS.md, profiler
-            window-scoped resets, fault-point catalog) — :mod:`.invariants`
+            section registry + window-scoped resets, fault-point
+            catalog, telemetry span/metric catalog) — :mod:`.invariants`
 ==========  ==============================================================
 
 Entry points: ``tools/mxtpu_analyze.py`` (= ``make analyze``, wired
@@ -39,7 +40,7 @@ PASS_CODES = {
     "locks": ("MXA101", "MXA102", "MXA103"),
     "trace": ("MXA201", "MXA202", "MXA203", "MXA204"),
     "determinism": ("MXA301", "MXA302"),
-    "invariants": ("MXA401", "MXA402", "MXA403", "MXA404"),
+    "invariants": ("MXA401", "MXA402", "MXA403", "MXA404", "MXA405"),
 }
 
 

@@ -109,6 +109,16 @@ class AnalysisConfig:
     traced_names: tuple = ("_cached_graph_fn",)
     getenv_fns: tuple = ("getenv",)
     fault_point_fns: tuple = ("fault_point",)
+    # telemetry catalog (MXA403/MXA405): how sections register, which
+    # helpers the output paths iterate them through, where span/metric
+    # names must be documented, and which call names define them
+    section_register_fns: tuple = ("register_section",)
+    section_iter_fns: tuple = ("_section_data", "_section_tables")
+    observability_doc: str = "docs/observability.md"
+    span_site_fns: tuple = ("op_scope", "span_begin", "instant",
+                            "request_begin")
+    metric_def_fns: tuple = ("counter", "gauge", "histogram")
+    metric_name_prefix: str = "mxtpu_"
     # modules allowed to touch os.environ directly (the config tier)
     env_exempt_modules: tuple = ("base",)
     # raw env names allowed outside base.getenv (launcher wire protocol,

@@ -12,16 +12,26 @@ MXA401  raw environment read — ``os.environ``/``os.getenv`` outside
 MXA402  undocumented env knob — a ``base.getenv("NAME")`` read whose
         ``MXTPU_NAME`` spelling (or a raw read whose literal name) does
         not appear in docs/ENV_VARS.md.
-MXA403  profiler section without window-scoped reset — a
-        ``_*_counters(reset)`` section provider in the profiler module
-        that ignores its ``reset`` flag, or that ``dumps()`` /
-        ``_aggregate_table()`` call without forwarding ``reset`` (the
-        "reset dump must scope EVERY section" rule PRs 2-5 each
-        re-fixed).
+MXA403  profiler section registry violation — a ``_*_counters``
+        provider in the profiler module that is not registered via
+        ``register_section`` (the registry is what ``dumps()`` and
+        ``_aggregate_table()`` iterate, so an unregistered section
+        silently vanishes from both output paths), a registered
+        provider that ignores its ``reset`` flag, or an output path
+        calling a provider / the registry iterator without forwarding
+        ``reset`` (the "reset dump must scope EVERY section" rule PRs
+        2-5 each re-fixed by hand before the registry existed).
 MXA404  uncataloged fault point — an ``engine.fault_point("site")``
         whose site name is missing from the docs/resilience.md catalog
         (chaos plans target sites by name; an uncataloged site is
         untestable by reading the docs).
+MXA405  uncataloged telemetry name — a registered profiler section, a
+        literal span site (``op_scope``/``span_begin``/``instant``/
+        ``request_begin``), or a literal ``mxtpu_*`` metric name that
+        does not appear in docs/observability.md (dashboards and trace
+        queries target these names; an uncataloged one is invisible to
+        anyone reading the docs — the fault-point rule, applied to
+        observability).
 """
 from __future__ import annotations
 
@@ -144,17 +154,60 @@ def _env_findings(index, findings):
 # -- profiler window scoping ------------------------------------------------
 
 
+def _fname(call_func):
+    if isinstance(call_func, ast.Name):
+        return call_func.id
+    if isinstance(call_func, ast.Attribute):
+        return call_func.attr
+    return None
+
+
+def _passes_reset(node):
+    return any(isinstance(a, ast.Name) and a.id == "reset"
+               for a in list(node.args)
+               + [kw.value for kw in node.keywords])
+
+
 def _profiler_findings(index, findings):
     cfg = index.cfg
     mod = index.modules.get(cfg.profiler_module)
     if mod is None:
         return
-    providers = {}
+    # provider functions by the naming convention ...
+    pattern_providers = {}
     for key, func in index.funcs.items():
         if func.module is mod and func.cls is None and \
                 re.fullmatch(r"_[a-z0-9_]+_counters", func.name):
-            providers[func.name] = func
-    for name, func in sorted(providers.items()):
+            pattern_providers[func.name] = func
+    # ... and what the section registry actually holds:
+    # register_section("name", provider_fn) calls in the module
+    registered = {}   # local provider name -> (section name, call node)
+    for node in ast.walk(mod.tree):
+        if (isinstance(node, ast.Call)
+                and _fname(node.func) in cfg.section_register_fns
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Name)):
+            registered[node.args[1].id] = (_literal(node.args[0]), node)
+
+    # membership: a conventionally-named provider that never reaches
+    # the registry silently vanishes from BOTH output paths
+    for name, func in sorted(pattern_providers.items()):
+        if name not in registered:
+            findings.append(Finding(
+                "MXA403", mod.relpath, func.node.lineno, name,
+                f"profiler section provider {name} is not registered "
+                f"via register_section — dumps()/_aggregate_table() "
+                f"iterate the registry, so this section would silently "
+                f"vanish from both output paths"))
+
+    # reset scoping: every provider (registered or convention-named)
+    # must take reset and zero its counters under `if reset:`
+    checkable = dict(pattern_providers)
+    for name in registered:
+        func = index.funcs.get((mod.modname, name))
+        if func is not None:
+            checkable.setdefault(name, func)
+    for name, func in sorted(checkable.items()):
         argnames = [a.arg for a in func.node.args.args]
         if "reset" not in argnames:
             findings.append(Finding(
@@ -178,32 +231,45 @@ def _profiler_findings(index, findings):
                 f"profiler section provider {name} never resets its "
                 f"counters under `if reset:` — dumps(reset=True) would "
                 f"mix window events with forever-cumulative counts"))
-    # both output paths must forward reset to every provider
+
+    # both output paths must forward reset — whether they call a
+    # provider directly (legacy style) or iterate the registry through
+    # a section_iter_fns helper
     for caller_name in ("dumps", "_aggregate_table"):
         caller = index.funcs.get((mod.modname, caller_name))
         if caller is None:
             continue
-        called = {}
+        touched = False
         for node in ast.walk(caller.node):
-            if isinstance(node, ast.Call) and \
-                    isinstance(node.func, ast.Name) and \
-                    node.func.id in providers:
-                passes_reset = any(
-                    isinstance(a, ast.Name) and a.id == "reset"
-                    for a in list(node.args)
-                    + [kw.value for kw in node.keywords])
-                called[node.func.id] = (node, passes_reset)
-        for name in sorted(providers):
-            if name not in called:
-                continue   # a path may legitimately skip a section
-            node, ok = called[name]
-            if not ok:
-                findings.append(Finding(
-                    "MXA403", mod.relpath, node.lineno,
-                    f"{caller_name}:{name}",
-                    f"{caller_name}() calls {name} without forwarding "
-                    f"reset — this output path would not window-scope "
-                    f"the section"))
+            if not isinstance(node, ast.Call):
+                continue
+            fn = _fname(node.func)
+            if fn in checkable:
+                touched = True
+                if not _passes_reset(node):
+                    findings.append(Finding(
+                        "MXA403", mod.relpath, node.lineno,
+                        f"{caller_name}:{fn}",
+                        f"{caller_name}() calls {fn} without forwarding "
+                        f"reset — this output path would not "
+                        f"window-scope the section"))
+            elif fn in cfg.section_iter_fns:
+                touched = True
+                if not _passes_reset(node):
+                    findings.append(Finding(
+                        "MXA403", mod.relpath, node.lineno,
+                        f"{caller_name}:{fn}",
+                        f"{caller_name}() iterates the section "
+                        f"registry via {fn} without forwarding reset — "
+                        f"this output path would not window-scope ANY "
+                        f"section"))
+        if not touched and (registered or pattern_providers):
+            findings.append(Finding(
+                "MXA403", mod.relpath, caller.node.lineno,
+                f"{caller_name}:<no-sections>",
+                f"{caller_name}() neither iterates the section "
+                f"registry nor calls a provider — counter sections "
+                f"are missing from this output path"))
 
 
 # -- fault-point catalog ----------------------------------------------------
@@ -234,9 +300,49 @@ def _fault_point_findings(index, findings):
                     f"by name"))
 
 
+# -- telemetry catalog ------------------------------------------------------
+
+
+def _telemetry_catalog_findings(index, findings):
+    """MXA405: registered section names, literal span sites, and
+    literal ``mxtpu_*`` metric names must appear in the observability
+    doc — dashboards, scrape configs, and Perfetto queries target
+    telemetry by name, so an undocumented name is unfindable."""
+    cfg = index.cfg
+    doc = index.doc_text(cfg.observability_doc) or ""
+
+    def _check(mod, node, kind, name):
+        if name in doc:
+            return
+        sym = index.enclosing(mod, node.lineno)
+        findings.append(Finding(
+            "MXA405", mod.relpath, node.lineno, f"{sym}:{name}",
+            f"{kind} '{name}' is not cataloged in "
+            f"{cfg.observability_doc} — telemetry consumers target "
+            f"these names by reading the docs"))
+
+    for _name, mod in sorted(index.modules.items()):
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = _fname(node.func)
+            lit = _literal(node.args[0])
+            if lit is None:
+                continue   # dynamic names (f-string buckets) are
+                # documented as families, not checked per-site
+            if fn in cfg.section_register_fns:
+                _check(mod, node, "profiler section", lit)
+            elif fn in cfg.span_site_fns:
+                _check(mod, node, "span site", lit)
+            elif fn in cfg.metric_def_fns and \
+                    lit.startswith(cfg.metric_name_prefix):
+                _check(mod, node, "metric", lit)
+
+
 def run(index):
     findings = []
     _env_findings(index, findings)
     _profiler_findings(index, findings)
     _fault_point_findings(index, findings)
+    _telemetry_catalog_findings(index, findings)
     return findings

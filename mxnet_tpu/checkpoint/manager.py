@@ -676,6 +676,20 @@ class CheckpointManager:
                     kwargs.setdefault("sync", True)
                     self.save(**kwargs)
             finally:
+                # post-mortem timeline next to the final checkpoint
+                # (no-op unless the flight-recorder ring is armed).
+                # The WHOLE block is guarded: a failure here — e.g. the
+                # signal landing mid-way through the telemetry
+                # package's own first import — must never skip the
+                # handler chaining below (swallowing a termination
+                # request is the one unacceptable outcome)
+                try:
+                    from ..telemetry import flight as _flight
+
+                    _flight.dump_if_enabled("sigterm",
+                                            directory=self.directory)
+                except Exception:  # noqa: BLE001 — advisory only
+                    pass
                 prev = self._prev_handler
                 if callable(prev):
                     prev(sig, frame)

@@ -117,8 +117,9 @@ class Trainer:
                 "with update_on_kvstore; use update_on_kvstore=False")
         self._optimizer.rescale_grad = self._scale / batch_size
         self._dispatches = self._buckets = self._params_fused = 0
-        self._allreduce_grads()
-        self._update(ignore_stale_grad)
+        with _profiler.op_scope("trainer.step", cat="trainer"):
+            self._allreduce_grads()
+            self._update(ignore_stale_grad)
         _step_stats["steps"] += 1
         _step_stats["dispatches"] += self._dispatches
         _step_stats["buckets_built"] += self._buckets
@@ -266,10 +267,11 @@ class Trainer:
             for ctx in ctxs[1:]:
                 per_ctx.setdefault(ctx, []).append((p, ctx, src))
         for ctx, entries in per_ctx.items():
-            outs = _engine.batched_put([s._data for _, _, s in entries],
-                                       ctx.jax_device())
-            for (p, c, _), new in zip(entries, outs):
-                p._data[c]._data = new
+            with _profiler.op_scope("broadcast", cat="trainer"):
+                outs = _engine.batched_put(
+                    [s._data for _, _, s in entries], ctx.jax_device())
+                for (p, c, _), new in zip(entries, outs):
+                    p._data[c]._data = new
             self._dispatches += 1
 
     # -- state io (ref: trainer.save_states/load_states) --------------------

@@ -162,6 +162,7 @@ def num_workers():
 
 _world_mesh_cache = None
 _allreduce_jit_cache = {}
+_gather_jit_cache = {}
 
 
 def _world_mesh():
@@ -228,6 +229,96 @@ def allreduce(value):
     return _wrap(track(out))
 
 
+def _allgather_rows(mesh, axis_size, my_index, row, _local_rows=None):
+    """Gather one fixed-shape numpy row per rank into an (axis_size,
+    *row.shape) array visible on every rank.
+
+    Each rank contributes its row as one shard of a global array on
+    ``mesh``'s leading axis; a jitted identity with a replicated output
+    sharding makes XLA emit the cross-process all-gather over DCN/ICI.
+    ``_local_rows`` is the single-process test seam: on the virtual
+    multichip mesh every shard is addressable locally, so the
+    dryrun_multichip suite supplies all ranks' rows at once and drives
+    the exact gather/replication path a real multi-process job runs.
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    axis = mesh.axis_names[0]
+    shape = (row if _local_rows is None else _local_rows[0]).shape
+    gshape = (axis_size,) + tuple(shape)
+    sharded = NamedSharding(mesh, PartitionSpec(axis))
+    if _local_rows is None:
+        shards = [jax.device_put(jnp.asarray(row)[None],
+                                 mesh.devices.flat[my_index])]
+    else:
+        shards = [jax.device_put(jnp.asarray(r)[None], d)
+                  for r, d in zip(_local_rows, mesh.devices.flat)]
+    garr = jax.make_array_from_single_device_arrays(gshape, sharded,
+                                                    shards)
+    # cache the jitted gather like _allreduce_jit_cache: jit keys on
+    # the function OBJECT, so a fresh lambda per call would retrace on
+    # every periodic aggregate() tick
+    key = (mesh, gshape, str(garr.dtype))
+    fn = _gather_jit_cache.get(key)
+    if fn is None:
+        repl = NamedSharding(mesh, PartitionSpec())
+        fn = jax.jit(lambda a: a, out_shardings=repl)
+        _gather_jit_cache[key] = fn
+    out = fn(garr)
+    return np.asarray(_bounded(lambda: out.addressable_data(0),
+                               f"allgather of {gshape}"))
+
+
+def _allgather_bytes_impl(mesh, axis_size, my_index, data,
+                          _all_payloads=None):
+    """Variable-length byte allgather: exchange lengths first (so every
+    rank pads to the same max), then the padded uint8 payload rows."""
+    import numpy as np
+
+    if _all_payloads is None:
+        lens = _allgather_rows(mesh, axis_size, my_index,
+                               np.array([len(data)], np.int32))
+    else:
+        lens = _allgather_rows(
+            mesh, axis_size, my_index, None,
+            _local_rows=[np.array([len(p)], np.int32)
+                         for p in _all_payloads])
+    max_len = max(int(lens.max()), 1)
+
+    def _pad(payload):
+        row = np.zeros(max_len, np.uint8)
+        row[:len(payload)] = np.frombuffer(payload, np.uint8)
+        return row
+
+    if _all_payloads is None:
+        rows = _allgather_rows(mesh, axis_size, my_index, _pad(data))
+    else:
+        rows = _allgather_rows(mesh, axis_size, my_index, None,
+                               _local_rows=[_pad(p)
+                                            for p in _all_payloads])
+    return [rows[i, :int(lens[i, 0])].tobytes()
+            for i in range(axis_size)]
+
+
+def allgather_bytes(data):
+    """Every rank's byte payload, in rank order — the snapshot
+    exchange behind ``telemetry.aggregate()`` (per-rank profiler
+    sections allgathered so rank 0's monitor sees the whole job).
+    Single-process: identity.
+    """
+    import jax
+
+    data = bytes(data)
+    if jax.process_count() <= 1:
+        return [data]
+    return _allgather_bytes_impl(_world_mesh(), jax.process_count(),
+                                 jax.process_index(), data)
+
+
 def reinit():
     """Tear down and re-create the process group — the supervisor's
     peer-death recovery attempt.  Only succeeds when every SURVIVING
@@ -243,6 +334,7 @@ def reinit():
         pass
     _world_mesh_cache = None
     _allreduce_jit_cache.clear()
+    _gather_jit_cache.clear()
     _initialized = False
     init()
 

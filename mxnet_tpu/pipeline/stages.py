@@ -33,6 +33,7 @@ from .. import engine, profiler
 from ..base import MXNetError, getenv
 from ..ndarray import ndarray as _nd
 from ..ndarray.ndarray import NDArray
+from ..telemetry import tracer as _tracer
 from . import stats as _stats
 
 # sentinel a prefetch pull-job returns instead of raising StopIteration
@@ -262,11 +263,16 @@ class ShuffleStage(Stage):
         self._exhausted = False
 
     def __next__(self):
-        while not self._exhausted and len(self._ring) < self._size:
-            try:
-                self._ring.append(next(self._up))
-            except StopIteration:
-                self._exhausted = True
+        _tracer.span_begin("pipeline.shuffle.fill", "dataPipeline")
+        try:
+            while not self._exhausted and len(self._ring) < self._size:
+                try:
+                    self._ring.append(next(self._up))
+                except StopIteration:
+                    self._exhausted = True
+        finally:
+            _tracer.span_end("pipeline.shuffle.fill", "dataPipeline",
+                             ring=len(self._ring))
         if not self._ring:
             raise StopIteration
         j = int(self._rng.randint(len(self._ring)))
@@ -813,8 +819,15 @@ class Pipeline:
         return self
 
     def __next__(self):
+        # the wait-on-input span IS the input-bound signal in a trace:
+        # long pipeline.wait slices on the consumer lane mean the chip
+        # is starving (same number wait_ms aggregates)
+        _tracer.span_begin("pipeline.wait", "dataPipeline")
         t0 = time.perf_counter()
-        item = next(self._tail)
+        try:
+            item = next(self._tail)
+        finally:
+            _tracer.span_end("pipeline.wait", "dataPipeline")
         _stats.add("wait_ms", (time.perf_counter() - t0) * 1e3)
         _stats.add("batches", 1)
         return item

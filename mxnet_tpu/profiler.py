@@ -15,6 +15,7 @@ import threading
 import time
 
 from .base import getenv
+from .telemetry import tracer as _tracer
 
 _state = threading.local()
 _config = {
@@ -167,12 +168,17 @@ class _OpScope:
             with _scope_lock:
                 _open_scopes.setdefault(threading.get_ident(),
                                         []).append(self.name)
+        # telemetry span hook: the disarmed binding is a ~ns no-op
+        # (engine.fault_point pattern); armed, every op scope is a
+        # span in the exported trace / flight-recorder ring
+        _tracer.span_begin(self.name, self.cat)
         self.t0 = time.perf_counter() * 1e6
         return self
 
     def __exit__(self, *a):
         record_op(self.name, self.t0, time.perf_counter() * 1e6,
                   cat=self.cat)
+        _tracer.span_end(self.name, self.cat)
         if _scope_track:
             with _scope_lock:
                 stack = _open_scopes.get(threading.get_ident())
@@ -252,6 +258,133 @@ def _resilience_counters(reset=False):
     return stats
 
 
+def _telemetry_counters(reset=False):
+    """Telemetry-subsystem counters (spans/instants/requests recorded,
+    drops, flight dumps, scrapes, aggregations) — window-scoped under
+    reset=True exactly like every other section."""
+    stats = _tracer.telemetry_stats()
+    if reset:
+        _tracer.reset_telemetry_stats()
+    return stats
+
+
+# ---------------------------------------------------------------------------
+# Section registry: every counter section a subsystem contributes to
+# dumps()/the aggregate table is one (provider, table renderer) entry
+# here.  PRs 2-5 each hand-wired a provider call into BOTH output
+# paths and re-fixed the reset forwarding by hand; now both paths
+# iterate this registry and the MXA403 invariant pass checks
+# membership + reset scoping mechanically.
+
+
+_sections = []   # [(name, provider, table_fn)] in registration order
+
+
+def register_section(name, provider, table=None):
+    """Register a counter section.
+
+    ``provider(reset=False)`` returns the section's stats dict (or
+    None while its subsystem is not loaded) and MUST zero its counters
+    under ``reset=True`` — every section is window-scoped, so a reset
+    dump never mixes per-window events with forever-cumulative counts.
+    ``table(stats)`` (optional) returns the section's lines for
+    ``dumps(format="table")``.  Re-registering a name replaces it.
+    """
+    for i, (n, _p, _t) in enumerate(_sections):
+        if n == name:
+            _sections[i] = (name, provider, table)
+            return
+    _sections.append((name, provider, table))
+
+
+def unregister_section(name):
+    """Drop a registered section (tests / unloading subsystems)."""
+    _sections[:] = [s for s in _sections if s[0] != name]
+
+
+def section_names():
+    return [n for n, _p, _t in _sections]
+
+
+def sections(reset=False):
+    """Public snapshot of every loaded section: ``{name: stats}`` —
+    the dict ``dumps()`` embeds and the /metrics collector exports."""
+    return _section_data(reset)
+
+
+def _section_data(reset=False):
+    out = {}
+    for name, provider, _table in list(_sections):
+        stats = provider(reset)
+        if stats is not None:
+            out[name] = stats
+    return out
+
+
+def _section_tables(reset=False):
+    lines = []
+    for _name, provider, table in list(_sections):
+        stats = provider(reset)
+        if stats is None or table is None:
+            continue
+        lines.append("")
+        lines.extend(table(stats))
+    return lines
+
+
+def _rows_table(title, rows):
+    """Standard section renderer: a title plus label/value rows."""
+    def render(stats):
+        out = [title + ":"]
+        for label, key in rows:
+            out.append(f"{label:<40}{stats[key]:>12}")
+        return out
+    return render
+
+
+def _resilience_table(stats):
+    out = ["Resilience (supervisor):"]
+    for label, key in (("restarts", "restarts"),
+                       ("fallback restores", "fallback_restores"),
+                       ("watchdog fires", "watchdog_fires"),
+                       ("time lost (ms)", "time_lost_ms")):
+        out.append(f"{label:<40}{stats[key]:>12}")
+    for cls in sorted(stats["retries"]):
+        out.append(f"{'retries[' + cls + ']':<40}"
+                   f"{stats['retries'][cls]:>12}")
+    return out
+
+
+register_section("cachedGraph", _graph_cache_counters, _rows_table(
+    "Compiled-Graph Cache (CachedOp)",
+    (("graph compiles (new signature)", "compiles"),
+     ("graph reuses (cache hit)", "reuses"))))
+register_section("trainerStep", _trainer_step_counters, _rows_table(
+    "Trainer Step Fusion",
+    (("steps", "steps"),
+     ("params fused", "params_fused"),
+     ("allreduce buckets built", "buckets_built"),
+     ("dispatches per step", "dispatches_per_step"))))
+register_section("dataPipeline", _data_pipeline_counters, _rows_table(
+    "Data Pipeline",
+    (("batches delivered", "batches"),
+     ("host build (ms)", "host_build_ms"),
+     ("h2d staging (ms)", "h2d_ms"),
+     ("step wait-on-input (ms)", "wait_ms"),
+     ("prefetch hits", "prefetch_hits"),
+     ("prefetch misses", "prefetch_misses"))))
+register_section("resilience", _resilience_counters, _resilience_table)
+register_section("telemetry", _telemetry_counters, _rows_table(
+    "Telemetry (tracer / flight recorder / metrics)",
+    (("spans recorded", "spans"),
+     ("instant events", "instants"),
+     ("request spans opened", "requests"),
+     ("events dropped (lane cap)", "dropped"),
+     ("flight-recorder dumps", "flight_dumps"),
+     ("/metrics scrapes", "scrapes"),
+     ("aggregate() calls", "aggregations"))))
+
+
 def dumps(reset=False, format="json"):
     """Return the trace (ref: mx.profiler.dumps).
 
@@ -274,18 +407,9 @@ def dumps(reset=False, format="json"):
             data["memoryPeaks"] = dict(_mem_peak)
         if reset:
             _events.clear()
-    graph = _graph_cache_counters(reset)
-    if graph is not None:
-        data["cachedGraph"] = graph
-    steps = _trainer_step_counters(reset)
-    if steps is not None:
-        data["trainerStep"] = steps
-    pipe = _data_pipeline_counters(reset)
-    if pipe is not None:
-        data["dataPipeline"] = pipe
-    res = _resilience_counters(reset)
-    if res is not None:
-        data["resilience"] = res
+    # every registered counter section, reset forwarded so a reset
+    # dump window-scopes ALL of them (MXA403 checks this mechanically)
+    data.update(_section_data(reset))
     return json.dumps(data)
 
 
@@ -323,46 +447,7 @@ def _aggregate_table(reset=False):
             lines.append(f"{key:<40}{val / 1e6:>14.3f} MB")
     # counter sections are window-scoped under reset=True exactly like
     # the event table above (and like the JSON format path)
-    graph = _graph_cache_counters(reset)
-    if graph is not None:
-        lines.append("")
-        lines.append("Compiled-Graph Cache (CachedOp):")
-        lines.append(f"{'graph compiles (new signature)':<40}"
-                     f"{graph['compiles']:>12}")
-        lines.append(f"{'graph reuses (cache hit)':<40}"
-                     f"{graph['reuses']:>12}")
-    steps = _trainer_step_counters(reset)
-    if steps is not None:
-        lines.append("")
-        lines.append("Trainer Step Fusion:")
-        for label, key in (("steps", "steps"),
-                           ("params fused", "params_fused"),
-                           ("allreduce buckets built", "buckets_built"),
-                           ("dispatches per step", "dispatches_per_step")):
-            lines.append(f"{label:<40}{steps[key]:>12}")
-    pipe = _data_pipeline_counters(reset)
-    if pipe is not None:
-        lines.append("")
-        lines.append("Data Pipeline:")
-        for label, key in (("batches delivered", "batches"),
-                           ("host build (ms)", "host_build_ms"),
-                           ("h2d staging (ms)", "h2d_ms"),
-                           ("step wait-on-input (ms)", "wait_ms"),
-                           ("prefetch hits", "prefetch_hits"),
-                           ("prefetch misses", "prefetch_misses")):
-            lines.append(f"{label:<40}{pipe[key]:>12}")
-    res = _resilience_counters(reset)
-    if res is not None:
-        lines.append("")
-        lines.append("Resilience (supervisor):")
-        for label, key in (("restarts", "restarts"),
-                           ("fallback restores", "fallback_restores"),
-                           ("watchdog fires", "watchdog_fires"),
-                           ("time lost (ms)", "time_lost_ms")):
-            lines.append(f"{label:<40}{res[key]:>12}")
-        for cls in sorted(res["retries"]):
-            lines.append(f"{'retries[' + cls + ']':<40}"
-                         f"{res['retries'][cls]:>12}")
+    lines.extend(_section_tables(reset))
     return "\n".join(lines)
 
 

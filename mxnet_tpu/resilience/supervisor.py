@@ -78,6 +78,7 @@ import time
 from .. import engine, profiler
 from ..base import MXNetError, getenv
 from ..log import get_logger
+from ..telemetry import flight as _flight, tracer as _tracer
 from . import stats as _stats
 from .faults import TransientFault
 from .retry import RetryPolicy
@@ -251,6 +252,21 @@ class Supervisor:
         restarts = 0
         transient_failures = 0
         last_fail_step = None
+        # flight recorder rides along for the whole supervised job
+        # (unless MXTPU_FLIGHT_RECORDER=off): any crash below leaves a
+        # loadable timeline next to the checkpoints
+        flight_token = _flight.auto_enable(
+            directory=self.manager.directory
+            if self.manager is not None else None)
+        try:
+            return self._run_supervised(train_fn, ctx, restarts,
+                                        transient_failures,
+                                        last_fail_step, is_main)
+        finally:
+            _flight.auto_disable(flight_token)
+
+    def _run_supervised(self, train_fn, ctx, restarts,
+                        transient_failures, last_fail_step, is_main):
         while True:
             ctx.attempt = restarts + transient_failures
             self._watchdog_diag = None
@@ -274,6 +290,12 @@ class Supervisor:
             except BaseException as e:  # noqa: BLE001 — classified below
                 kind = classify(e)
                 if kind == "fatal":
+                    # post-mortem before the re-raise: the ring holds
+                    # the job's last seconds
+                    _flight.dump_if_enabled(
+                        "fatal", extra={"error": str(e)[:500],
+                                        "type": type(e).__name__,
+                                        "last_step": self._last_step})
                     raise
                 exc = e
             finally:
@@ -354,6 +376,10 @@ class Supervisor:
             _stats.add_retry(kind)
             _stats.add("time_lost_ms",
                        (time.monotonic() - t_fail) * 1e3)
+            _tracer.instant("resilience.retry", cat="resilience",
+                            kind=kind, last_step=self._last_step
+                            if self._last_step is not None else -1,
+                            error=str(exc)[:200])
 
     # -- preemption chain ----------------------------------------------------
 
@@ -464,6 +490,13 @@ class Supervisor:
                 continue
             diag = self._diagnose(idle)
             _stats.add("watchdog_fires")
+            _tracer.instant("resilience.watchdog", cat="resilience",
+                            idle_s=round(idle, 3))
+            # the post-mortem: dump the ring BEFORE interrupting the
+            # training thread, while the stall is still in progress
+            # (active scopes name the stuck phase)
+            _flight.dump_if_enabled("watchdog",
+                                    extra={"diagnostic": diag})
             logger.error(diag)
             if stop.is_set():  # train_fn finished while we diagnosed
                 return

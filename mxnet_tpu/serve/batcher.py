@@ -33,7 +33,8 @@ class DeadlineExceededError(MXNetError):
 
 
 class _Request:
-    __slots__ = ("example", "length", "future", "deadline", "enqueued_at")
+    __slots__ = ("example", "length", "future", "deadline", "enqueued_at",
+                 "trace_id")
 
     def __init__(self, example, length, future, deadline_ms=None):
         self.example = example
@@ -42,6 +43,7 @@ class _Request:
         self.enqueued_at = time.monotonic()
         self.deadline = (self.enqueued_at + deadline_ms / 1e3
                          if deadline_ms is not None else None)
+        self.trace_id = None          # telemetry async-span id (or None)
 
     def expired(self, now=None):
         return (self.deadline is not None

@@ -38,6 +38,7 @@ import numpy as np
 from .. import profiler
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray, array as _nd_array
+from ..telemetry import tracer as _tracer
 from .batcher import (Batcher, DeadlineExceededError, _Request,
                       ServerClosedError, ServerOverloadedError)
 from .buckets import BucketSpec
@@ -84,6 +85,7 @@ class ModelServer:
         self._abort = False
         self._worker = None
         self._warmup_compiles = 0
+        self._metrics_collector = None
         if isinstance(checkpoint, str):
             from ..checkpoint import CheckpointManager
 
@@ -111,6 +113,12 @@ class ModelServer:
         self._warmup_compiles = self._graph_stats().get("compiles", 0)
         self._started = True
         self._closing = False
+        if self._metrics_collector is None:
+            # export stats() on the /metrics endpoint (weakly held:
+            # a dropped server leaves the scrape automatically)
+            from ..telemetry import metrics as _metrics
+
+            self._metrics_collector = _metrics.register_server(self)
         self._worker = threading.Thread(target=self._worker_loop,
                                         name="mxtpu-serve-batcher",
                                         daemon=True)
@@ -177,6 +185,8 @@ class ModelServer:
                     req.future.set_exception(
                         ServerClosedError("server shut down"))
                 self._stats.incr("cancelled")
+                _tracer.request_end("serve.request", req.trace_id,
+                                    cat="serve", outcome="cancelled")
 
     # -- request path -------------------------------------------------------
 
@@ -192,6 +202,13 @@ class ModelServer:
         example = np.asarray(example, dtype=self._spec.dtype)
         length = self._spec.validate(example)
         req = _Request(example, length, Future(), deadline_ms=deadline_ms)
+        # request-shape attrs ride on the span: the autotuner's
+        # observed-traffic histogram (ROADMAP item 5) reads them back
+        # out of exported traces
+        req.trace_id = _tracer.request_begin(
+            "serve.request", cat="serve", length=length if length
+            is not None else -1, shape=str(example.shape),
+            deadline_ms=deadline_ms if deadline_ms is not None else -1)
         # count before put(): once queued, the batcher may serve the
         # request immediately, and "submitted" must never trail "served"
         self._stats.incr("submitted")
@@ -201,6 +218,8 @@ class ModelServer:
             self._stats.incr("submitted", -1)
             if isinstance(e, ServerOverloadedError):
                 self._stats.incr("rejected_overload")
+            _tracer.request_end("serve.request", req.trace_id,
+                                cat="serve", outcome="rejected")
             raise
         return req.future
 
@@ -217,6 +236,8 @@ class ModelServer:
                 on_pop=self._take_in_flight)
             for req in expired:
                 self._stats.incr("expired_deadline")
+                _tracer.request_end("serve.request", req.trace_id,
+                                    cat="serve", outcome="expired")
                 if req.future.set_running_or_notify_cancel():
                     req.future.set_exception(DeadlineExceededError(
                         "deadline passed while queued"))
@@ -235,42 +256,59 @@ class ModelServer:
     def _run_batch(self, group):
         spec = self._spec
         pending = list(group)   # not yet resolved, for the failure path
+        t_exec = time.monotonic()   # queue-vs-compute attribution split
         try:
+            for req in group:
+                _tracer.request_instant("serve.dequeue", req.trace_id,
+                                        cat="serve")
             max_len = max((r.length for r in group), default=None) \
                 if spec.var_axis is not None else None
             batch, length = spec.pick(len(group), max_len)
             key = spec.key(batch, length)
-            padded = spec.pad_batch([r.example for r in group],
-                                    batch, length)
+            with profiler.op_scope("serve.pad", cat="serve"):
+                padded = spec.pad_batch([r.example for r in group],
+                                        batch, length)
             with profiler.op_scope(f"serve.batch.{key}", cat="serve"):
                 out = self._net(_nd_array(padded, ctx=self._ctx))
-            outs = list(out) if isinstance(out, (list, tuple)) else [out]
-            # one synchronous readback per output: the d2h wait is the
-            # request's real completion time, so latency includes it
-            host = [o.asnumpy() if isinstance(o, NDArray) else
-                    np.asarray(o) for o in outs]
+                outs = list(out) if isinstance(out, (list, tuple)) \
+                    else [out]
+                # one synchronous readback per output: the d2h wait is
+                # the request's real completion time, so latency
+                # includes it
+                host = [o.asnumpy() if isinstance(o, NDArray) else
+                        np.asarray(o) for o in outs]
             self._stats.record_batch(
                 key, n_real=len(group), n_rows=batch,
                 real_elems=sum(int(np.prod(r.example.shape))
                                for r in group),
                 padded_elems=batch * int(np.prod(padded.shape[1:])))
             now = time.monotonic()
-            for i, req in enumerate(group):
-                res = [self._unpad_row(o[i], length, req.length)
-                       for o in host]
-                pending.remove(req)
-                self._finish(req)
-                self._stats.incr("served")
-                self._stats.record_latency((now - req.enqueued_at) * 1e3)
-                if req.future.set_running_or_notify_cancel():
-                    req.future.set_result(res[0] if len(res) == 1
-                                          else tuple(res))
+            with profiler.op_scope("serve.split", cat="serve"):
+                for i, req in enumerate(group):
+                    res = [self._unpad_row(o[i], length, req.length)
+                           for o in host]
+                    pending.remove(req)
+                    self._finish(req)
+                    self._stats.incr("served")
+                    self._stats.record_latency(
+                        (now - req.enqueued_at) * 1e3)
+                    _tracer.request_end(
+                        "serve.request", req.trace_id, cat="serve",
+                        outcome="served", bucket=key,
+                        queue_ms=round((t_exec - req.enqueued_at) * 1e3,
+                                       3),
+                        compute_ms=round((now - t_exec) * 1e3, 3))
+                    if req.future.set_running_or_notify_cancel():
+                        req.future.set_result(res[0] if len(res) == 1
+                                              else tuple(res))
         except Exception as e:  # noqa: BLE001 — EVERY failure is
             # forwarded to the affected callers; the batcher thread must
             # survive (a dead worker strands all queued futures forever)
             for req in pending:
                 self._finish(req)
                 self._stats.incr("failed")
+                _tracer.request_end("serve.request", req.trace_id,
+                                    cat="serve", outcome="failed")
                 if req.future.set_running_or_notify_cancel():
                     req.future.set_exception(e)
 
@@ -318,7 +356,7 @@ class ModelServer:
             return dict(op.stats)
         return {}
 
-    def stats(self):
+    def stats(self, reset=False):
         """Snapshot of every serving counter.
 
         Invariants (asserted by ``make serve-smoke``)::
@@ -332,6 +370,14 @@ class ModelServer:
         transiently off by requests mid-handoff: the queue, the
         in-flight gauge, and the counters are not read under one global
         lock, so alert on the drained value, not per-poll deltas.
+
+        ``reset=True`` atomically starts a new accounting window
+        (counters, fill/pad ratios, bucket hits, the latency ring AND
+        its histogram) — the same window-scoping contract as
+        ``profiler.dumps(reset=True)``; gauges (queue depth, in-flight,
+        graph compile counters) read live and are unaffected.  The
+        ``latency.histogram`` readout carries cumulative Prometheus
+        ``le`` buckets — what the ``/metrics`` endpoint exports.
         """
         g = self._graph_stats()
         graph = {
@@ -342,4 +388,5 @@ class ModelServer:
         }
         return self._stats.snapshot(
             queue_depth=len(self._batcher), in_flight=self._in_flight,
+            reset=reset,
             extra={"graph": graph, "buckets": repr(self._spec)})

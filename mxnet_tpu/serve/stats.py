@@ -1,13 +1,23 @@
-"""Serving observability: counters + latency percentiles.
+"""Serving observability: counters + latency percentiles + histogram.
 
 One :class:`ServerStats` instance rides inside each ``ModelServer``;
 every mutation happens under one lock so a snapshot is internally
 consistent (the ``served == submitted - rejected - pending`` invariant
 ``make serve-smoke`` asserts would otherwise race).
 
-Latencies land in a bounded ring (newest ``capacity`` samples) — serving
-percentiles care about the recent window, and an unbounded list would
-grow forever under production traffic.
+Latencies land twice:
+
+- a bounded ring (newest ``capacity`` samples) for the percentile
+  points — serving percentiles care about the recent window, and an
+  unbounded list would grow forever under production traffic;
+- cumulative histogram buckets (Prometheus ``le`` convention) for the
+  ``/metrics`` endpoint, where the scraper computes quantiles over
+  scrape intervals itself.
+
+``reset()`` window-scopes everything, matching the profiler sections'
+``dumps(reset=True)`` semantics — ``ModelServer.stats(reset=True)``
+reads one window and starts the next, instead of the old
+process-lifetime-only accumulation.
 """
 from __future__ import annotations
 
@@ -15,24 +25,54 @@ import threading
 
 import numpy as np
 
+# submit→resolve latency bucket bounds, ms — ONE definition shared
+# with the registry's default histogram so the serve export and any
+# explicitly created latency histogram always agree
+from ..telemetry.metrics import DEFAULT_BUCKETS_MS
+
 
 class LatencyWindow:
-    """Fixed-capacity ring of latency samples with percentile readout."""
+    """Fixed-capacity ring of latency samples with percentile readout,
+    plus cumulative histogram buckets for the metrics endpoint."""
 
-    def __init__(self, capacity=4096):
+    def __init__(self, capacity=4096, buckets=DEFAULT_BUCKETS_MS):
         self._buf = np.zeros(int(capacity), dtype=np.float64)
         self._capacity = int(capacity)
-        self._n = 0  # total ever recorded
+        self._n = 0  # total recorded since the last reset
+        self._bounds = tuple(float(b) for b in buckets)
+        if self._bounds[-1] != float("inf"):
+            self._bounds += (float("inf"),)
+        self._bucket_counts = [0] * len(self._bounds)
+        self._sum = 0.0
 
     def record(self, value):
         self._buf[self._n % self._capacity] = value
         self._n += 1
+        self._sum += float(value)
+        for i, le in enumerate(self._bounds):
+            if value <= le:
+                self._bucket_counts[i] += 1
+                break
+
+    def reset(self):
+        self._n = 0
+        self._sum = 0.0
+        self._bucket_counts = [0] * len(self._bounds)
 
     def snapshot(self):
         n = min(self._n, self._capacity)
+        # histogram buckets are emitted CUMULATIVE (count of samples
+        # <= le), the Prometheus exposition convention
+        cum, acc = [], 0
+        for le, c in zip(self._bounds, self._bucket_counts):
+            acc += c
+            cum.append([le, acc])
+        hist = {"buckets": cum, "sum_ms": round(self._sum, 3),
+                "count": self._n}
         if n == 0:
             return {"count": 0, "p50_ms": None, "p95_ms": None,
-                    "p99_ms": None, "mean_ms": None, "max_ms": None}
+                    "p99_ms": None, "mean_ms": None, "max_ms": None,
+                    "histogram": hist}
         window = self._buf[:n]
         p50, p95, p99 = np.percentile(window, (50, 95, 99))
         return {
@@ -42,6 +82,7 @@ class LatencyWindow:
             "p99_ms": round(float(p99), 3),
             "mean_ms": round(float(window.mean()), 3),
             "max_ms": round(float(window.max()), 3),
+            "histogram": hist,
         }
 
 
@@ -92,9 +133,26 @@ class ServerStats:
         with self._lock:
             self.latency.record(ms)
 
+    def _reset_locked(self):
+        for k in self._c:
+            self._c[k] = 0
+        self._fill_real = self._fill_rows = 0
+        self._pad_real = self._pad_padded = 0
+        self._bucket_hits = {}
+        self.latency.reset()
+
+    def reset(self):
+        """Start a new accounting window: zero every counter, fill/pad
+        accumulator, bucket-hit map, and the latency ring/histogram —
+        the same semantics as ``profiler.dumps(reset=True)``.  Gauges
+        (queue depth, in-flight) are read live and unaffected."""
+        with self._lock:
+            self._reset_locked()
+
     # -- readout ------------------------------------------------------------
 
-    def snapshot(self, queue_depth=0, in_flight=0, extra=None):
+    def snapshot(self, queue_depth=0, in_flight=0, extra=None,
+                 reset=False):
         with self._lock:
             snap = dict(self._c)
             snap["queue_depth"] = int(queue_depth)
@@ -107,6 +165,11 @@ class ServerStats:
                 if self._pad_real else None)
             snap["bucket_hits"] = dict(self._bucket_hits)
             snap["latency"] = self.latency.snapshot()
+            if reset:
+                # read-and-rewind is atomic: a sample landing between
+                # the snapshot and the zeroing can't vanish from both
+                # windows
+                self._reset_locked()
         if extra:
             snap.update(extra)
         return snap

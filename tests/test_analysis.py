@@ -180,6 +180,23 @@ def test_host_sync_in_kernel_callee(tmp_path):
     assert findings[0].symbol == "_helper:item"
 
 
+def test_telemetry_hook_inside_kernel_is_trace_unsafe(tmp_path):
+    """A telemetry hook that reads a traced value back to host inside
+    a jitted kernel is exactly the host-sync hazard MXA201 exists for
+    — recording span attrs must never force a device sync."""
+    findings = _run(tmp_path, {"k.py": (
+        "def _k_loss(x, tracer):\n"
+        "    tracer.instant('pipeline.wait', val=x.asnumpy())\n"
+        "    return x * 2\n"
+        "def _k_clean(x, tracer):\n"
+        "    tracer.instant('pipeline.wait', n=x.shape[0])\n"
+        "    return x * 2\n")}, passes=["trace"])
+    assert "MXA201" in _codes(findings)
+    syms = {f.symbol.split(":")[0] for f in findings
+            if f.code == "MXA201"}
+    assert syms == {"_k_loss"}
+
+
 def test_concretizer_and_control_flow_on_traced_param(tmp_path):
     findings = _run(tmp_path, {"k.py": (
         "def _k_conc(x):\n"
@@ -296,7 +313,15 @@ def test_env_lints(tmp_path):
 
 
 def test_profiler_window_scope_lint(tmp_path):
+    """Registry-era MXA403: an unregistered provider, a provider that
+    ignores reset, and an output path not forwarding reset into the
+    registry iterator each fire; the clean shapes stay silent."""
     findings = _run(tmp_path, {"profiler.py": (
+        "_sections = []\n"
+        "def register_section(name, provider, table=None):\n"
+        "    _sections.append((name, provider, table))\n"
+        "def _section_data(reset=False):\n"
+        "    return {n: p(reset) for n, p, _t in _sections}\n"
         "def _good_counters(reset=False):\n"
         "    stats = {'n': 1}\n"
         "    if reset:\n"
@@ -306,14 +331,44 @@ def test_profiler_window_scope_lint(tmp_path):
         "    pass\n"
         "def _bad_counters(reset=False):\n"
         "    return {'n': 2}\n"
+        "def _orphan_counters(reset=False):\n"
+        "    stats = {'n': 3}\n"
+        "    if reset:\n"
+        "        _reset_good()\n"
+        "    return stats\n"
+        "register_section('goodSection', _good_counters)\n"
+        "register_section('badSection', _bad_counters)\n"
         "def dumps(reset=False):\n"
-        "    return (_good_counters(reset), _bad_counters(reset))\n"
+        "    return _section_data(reset)\n"
         "def _aggregate_table(reset=False):\n"
-        "    return (_good_counters(reset), _bad_counters(True))\n")},
+        "    return (_section_data(True), _good_counters(reset))\n")},
+        docs={"observability.md": "goodSection badSection\n"},
         passes=["invariants"])
-    assert _codes(findings) == ["MXA403", "MXA403"]
+    assert _codes(findings) == ["MXA403", "MXA403", "MXA403"]
     syms = sorted(f.symbol for f in findings)
-    assert syms == ["_aggregate_table:_bad_counters", "_bad_counters"]
+    assert syms == ["_aggregate_table:_section_data", "_bad_counters",
+                    "_orphan_counters"]
+
+
+def test_profiler_output_path_without_sections_flagged(tmp_path):
+    """dumps() that neither iterates the registry nor calls a provider
+    has silently lost every counter section."""
+    findings = _run(tmp_path, {"profiler.py": (
+        "def register_section(name, provider, table=None):\n"
+        "    pass\n"
+        "def _good_counters(reset=False):\n"
+        "    if reset:\n"
+        "        _reset_good()\n"
+        "    return {}\n"
+        "def _reset_good():\n"
+        "    pass\n"
+        "register_section('goodSection', _good_counters)\n"
+        "def dumps(reset=False):\n"
+        "    return '{}'\n")},
+        docs={"observability.md": "goodSection\n"},
+        passes=["invariants"])
+    assert _codes(findings) == ["MXA403"]
+    assert findings[0].symbol == "dumps:<no-sections>"
 
 
 def test_fault_point_catalog_lint(tmp_path):
@@ -327,6 +382,53 @@ def test_fault_point_catalog_lint(tmp_path):
     findings = _run(tmp_path, files, docs=docs, passes=["invariants"])
     assert _codes(findings) == ["MXA404"]
     assert findings[0].symbol == "go:unknown.site"
+
+
+def test_telemetry_catalog_lint(tmp_path):
+    """MXA405: literal span sites and mxtpu_* metric names must be in
+    the observability doc; dynamic names and unprefixed metrics are
+    out of scope."""
+    files = {"t.py": (
+        "def op_scope(name, cat='op'):\n"
+        "    return None\n"
+        "def go(reg, tracer, key):\n"
+        "    op_scope('known.span')\n"
+        "    op_scope('unknown.span')\n"
+        "    op_scope(f'dynamic.{key}')\n"
+        "    tracer.instant('resilience.retry')\n"
+        "    tracer.request_begin('lost.request')\n"
+        "    reg.counter('mxtpu_known_total')\n"
+        "    reg.counter('mxtpu_unknown_total')\n"
+        "    reg.gauge('unprefixed_name')\n")}
+    docs = {"observability.md": (
+        "| `known.span` | `resilience.retry` | `mxtpu_known_total` |\n")}
+    findings = _run(tmp_path, files, docs=docs, passes=["invariants"])
+    assert _codes(findings) == ["MXA405", "MXA405", "MXA405"]
+    syms = sorted(f.symbol for f in findings)
+    assert syms == ["go:lost.request", "go:mxtpu_unknown_total",
+                    "go:unknown.span"]
+
+
+def test_section_registration_catalog_lint(tmp_path):
+    files = {"profiler.py": (
+        "def register_section(name, provider, table=None):\n"
+        "    pass\n"
+        "def _known_counters(reset=False):\n"
+        "    if reset:\n"
+        "        _reset()\n"
+        "    return {}\n"
+        "def _reset():\n"
+        "    pass\n"
+        "def dumps(reset=False):\n"
+        "    return _section_data(reset)\n"
+        "def _section_data(reset=False):\n"
+        "    return {}\n"
+        "register_section('knownSection', _known_counters)\n"
+        "register_section('unknownSection', _known_counters)\n")}
+    docs = {"observability.md": "the `knownSection` section\n"}
+    findings = _run(tmp_path, files, docs=docs, passes=["invariants"])
+    assert _codes(findings) == ["MXA405"]
+    assert findings[0].symbol == "<module>:unknownSection"
 
 
 # ---------------------------------------------------------------------------

@@ -431,3 +431,83 @@ def _serve_stress_body():
     assert s["in_flight"] == 0 and s["queue_depth"] == 0
     assert s["graph"]["post_warmup_compiles"] == 0
     assert s["batches"] < s["served"]  # real coalescing under load
+
+
+# ---------------------------------------------------------------------------
+# telemetry: window-scoped stats + histogram + request spans
+
+
+def test_stats_window_reset_histogram_and_request_spans(tmp_path):
+    """ISSUE 8 satellites: stats(reset=True) window-scopes the serving
+    counters like every profiler section (the latency ring was
+    process-lifetime before), the latency readout carries cumulative
+    Prometheus-style buckets, and a traced burst leaves balanced
+    serve.request async spans with queue/compute attribution."""
+    import json
+
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serve.stats import LatencyWindow
+
+    # LatencyWindow histogram mechanics in isolation
+    w = LatencyWindow(capacity=8, buckets=(1.0, 10.0))
+    for v in (0.5, 5.0, 5.0, 100.0):
+        w.record(v)
+    snap = w.snapshot()
+    assert snap["histogram"]["buckets"] == [[1.0, 1], [10.0, 3],
+                                            [float("inf"), 4]]
+    assert snap["histogram"]["count"] == 4
+    assert snap["histogram"]["sum_ms"] == 110.5
+    w.reset()
+    assert w.snapshot()["count"] == 0
+    assert w.snapshot()["histogram"]["buckets"][-1][1] == 0
+
+    srv = serve.ModelServer(_make_net(), _spec(), max_queue=64,
+                            linger_ms=1.0)
+    srv.start()
+    rng = np.random.RandomState(4)
+    trace_path = str(tmp_path / "serve.trace.json")
+    with telemetry.trace(trace_path):
+        futs = [srv.submit(x) for x in _requests(10, rng)]
+        for f in futs:
+            f.result(timeout=120)
+
+    # request spans: one balanced b/e pair per request, attribution on
+    # the close event, batch-phase spans present
+    events = json.load(open(trace_path))["traceEvents"]
+    begins = [e for e in events if e["ph"] == "b"
+              and e["name"] == "serve.request"]
+    ends = [e for e in events if e["ph"] == "e"
+            and e["name"] == "serve.request"]
+    assert len(begins) == len(ends) == 10
+    assert {e["id"] for e in begins} == {e["id"] for e in ends}
+    assert all("length" in e["args"] for e in begins)
+    for e in ends:
+        assert e["args"]["outcome"] == "served"
+        assert e["args"]["queue_ms"] >= 0
+        assert e["args"]["compute_ms"] > 0
+        assert e["args"]["bucket"] in {_spec().key(b, l)
+                                       for b in (1, 2, 4)
+                                       for l in (4, 8)}
+    names = {e["name"] for e in events}
+    assert {"serve.pad", "serve.split"} <= names
+    assert any(n.startswith("serve.batch.") for n in names)
+
+    # window reset: read-and-rewind, gauges stay live
+    s = srv.stats(reset=True)
+    assert s["served"] == 10
+    hist = s["latency"]["histogram"]
+    assert hist["count"] == 10
+    assert hist["buckets"][-1][1] == 10      # cumulative +Inf == count
+    assert s["latency"]["p99_ms"] is not None
+    s2 = srv.stats()
+    assert s2["served"] == s2["submitted"] == s2["batches"] == 0
+    assert s2["latency"]["count"] == 0
+    assert s2["latency"]["histogram"]["count"] == 0
+    assert s2["bucket_hits"] == {}
+    assert s2["graph"]["compiles"] > 0       # gauges unaffected
+    # the next window books fresh traffic on the warmed server
+    srv.submit(_requests(1, rng)[0]).result(timeout=120)
+    srv.drain()
+    s3 = srv.stats()
+    assert s3["served"] == s3["submitted"] == 1
+    assert s3["graph"]["post_warmup_compiles"] == 0
