@@ -56,6 +56,13 @@ serve-smoke:
 step-fusion-smoke:
 	env PYTHONPATH=. python tools/step_fusion_smoke.py
 
+# whole-step gate: 50 compiled whole steps at ONE device dispatch each
+# (global dispatch counter), zero post-warmup compiles under LR decay,
+# and 5-step whole-step/fused/sequential bit parity — see
+# tools/whole_step_smoke.py / docs/performance.md
+whole-step-smoke:
+	env PYTHONPATH=. python tools/whole_step_smoke.py
+
 # input-pipeline gate: prefetch overlap engaged, zero post-warmup
 # compiles over mixed lengths, bit-identical mid-epoch resume — see
 # tools/pipeline_smoke.py / docs/data.md
@@ -88,7 +95,7 @@ analyze:
 
 # the ROADMAP tier-1 gate, verbatim ($$ = make-escaped shell $)
 verify: SHELL := /bin/bash
-verify: analyze serve-smoke step-fusion-smoke pipeline-smoke chaos-smoke trace-smoke
+verify: analyze serve-smoke step-fusion-smoke whole-step-smoke pipeline-smoke chaos-smoke trace-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
-.PHONY: all clean test verify analyze serve-smoke step-fusion-smoke pipeline-smoke chaos-smoke trace-smoke
+.PHONY: all clean test verify analyze serve-smoke step-fusion-smoke whole-step-smoke pipeline-smoke chaos-smoke trace-smoke

@@ -32,6 +32,28 @@ _jit_cache = {}
 # (fn, attrs_key) -> jitted vjp-apply callable used by autograd.backward.
 _vjp_cache = {}
 
+# Device-dispatch accounting: every program submission the imperative
+# tier makes — eager invoke(), the engine's flat-buffer staging calls,
+# fused optimizer updates, compiled whole-step executions — bumps this
+# counter.  It is the HONEST denominator behind dispatches-per-step
+# gates (tools/whole_step_smoke.py): a whole-step loop whose delta
+# exceeds one per step is leaking eager work, no matter what the
+# trainer's self-reported stats say.  One integer increment per op
+# (~tens of ns against the ~2us eager floor).
+_dispatch_count = 0
+
+
+def count_dispatch(n=1):
+    """Record ``n`` device program submissions (callers that execute
+    cached executables without going through :func:`invoke`)."""
+    global _dispatch_count
+    _dispatch_count += n
+
+
+def device_dispatch_count():
+    """Total device program submissions so far (see _dispatch_count)."""
+    return _dispatch_count
+
 
 def _attrs_key(kwargs):
     try:
@@ -125,6 +147,8 @@ def invoke(fn, *args, jit_compile=True, nondiff=False, **kwargs):
     """
     autograd, profiler, NDArray, _wrap = _lazy or _resolve_lazy()
 
+    global _dispatch_count
+    _dispatch_count += 1
     raws = [x._data if isinstance(x, NDArray) else x for x in args]
 
     if jit_compile and not profiler._running:

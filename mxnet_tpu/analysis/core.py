@@ -105,8 +105,10 @@ class AnalysisConfig:
     hotpath_roots: tuple = (("serve.server", "ModelServer._run_batch"),)
     # naming convention for jit-traced kernels
     traced_prefixes: tuple = ("_k_", "_fk_")
-    # extra traced roots by exact function name (nested defs included)
-    traced_names: tuple = ("_cached_graph_fn",)
+    # extra traced roots by exact function name (nested defs included):
+    # the CachedOp graph fn and the whole-step trainer closure — host
+    # syncs anywhere inside either are lint errors (MXA201)
+    traced_names: tuple = ("_cached_graph_fn", "_whole_step_fn")
     getenv_fns: tuple = ("getenv",)
     fault_point_fns: tuple = ("fault_point",)
     # telemetry catalog (MXA403/MXA405): how sections register, which

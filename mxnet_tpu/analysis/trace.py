@@ -7,7 +7,8 @@ flow on traced values, no unhashable jit signatures.
 Roots:
 - *traced* — functions that run UNDER ``jax.jit``: anything passed to
   ``_imperative.get_jitted``/``jax.jit``, kernels matching the
-  ``_k_*``/``_fk_*`` naming convention, and the CachedOp graph fn.
+  ``_k_*``/``_fk_*`` naming convention, the CachedOp graph fn, and
+  the whole-step trainer closure (``_whole_step_fn``).
   Their package-internal callees are traced too.
 - *hot path* — host-side dispatch loops (config ``hotpath_roots``,
   default ``serve.ModelServer._run_batch``) where a device sync is a

@@ -333,6 +333,7 @@ def flatten_arrays(jarrs):
     buffer with a single cached-executable dispatch."""
     from . import _imperative
 
+    _imperative.count_dispatch()
     return track(_imperative.get_jitted(_k_flatten, {})(list(jarrs)))
 
 
@@ -341,6 +342,7 @@ def unflatten_array(flat, shapes):
     per-tensor slices reshaped to ``shapes``."""
     from . import _imperative
 
+    _imperative.count_dispatch()
     outs = _imperative.get_jitted(
         _k_unflatten, {"shapes": tuple(tuple(int(s) for s in shp)
                                        for shp in shapes)})(flat)

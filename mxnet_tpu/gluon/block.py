@@ -278,6 +278,47 @@ def reset_cached_graph_stats():
         _graph_stats["reuses"] = 0
 
 
+def traced_apply(block, param_raws, input_raws, key, train=True):
+    """Run ``block.forward`` under graph capture: every Parameter's
+    traced stand-in is bound to the matching entry of ``param_raws``
+    (ordered like ``block._ordered_params()``), the trace RNG key is
+    pushed, and the eager op wrappers re-trace the forward into whatever
+    jax transformation is active (jit, vjp, shard_map, eval_shape).
+
+    Returns ``(out, aux)`` where ``out`` is the forward's return tree
+    (NDArray leaves wrapping tracer buffers) and ``aux`` is a list of
+    ``(param_name, new_raw)`` for parameters whose wrapper buffers were
+    replaced in place during the forward (BatchNorm moving stats).
+
+    This is the ONE capture body shared by the CachedOp graph fn and
+    the whole-step trainer closure — forward semantics under trace have
+    a single source.
+    """
+    params = [p for _, p in block._ordered_params()]
+    wrappers = [_wrap(r) for r in param_raws]
+    inputs = [_wrap(r) for r in input_raws]
+    old_traced = [p._traced_value for p in params]
+    prev_active = getattr(_tracing, "active", False)
+    _tracing.active = True
+    tok = _random.push_trace_key(key)
+    try:
+        for p, w in zip(params, wrappers):
+            p._traced_value = w
+        with autograd.pause(train_mode=train):
+            out = block.forward(*inputs)
+    finally:
+        _random.pop_trace_key(tok)
+        _tracing.active = prev_active
+        for p, old in zip(params, old_traced):
+            p._traced_value = old
+    aux = []
+    for (name, _p), w, r in zip(block._ordered_params(), wrappers,
+                                param_raws):
+        if w._data is not r:
+            aux.append((name, w._data))
+    return out, aux
+
+
 class CachedOp:
     """Compiles a HybridBlock's forward to one XLA computation.
 
@@ -315,25 +356,8 @@ class CachedOp:
         cached = self
 
         def _cached_graph_fn(key, *arrays, _n_params):
-            params = [p for _, p in block._ordered_params()]
-            param_raws = arrays[:_n_params]
-            input_raws = arrays[_n_params:]
-            wrappers = [_wrap(r) for r in param_raws]
-            inputs = [_wrap(r) for r in input_raws]
-            old_traced = [p._traced_value for p in params]
-            prev_active = getattr(_tracing, "active", False)
-            _tracing.active = True
-            tok = _random.push_trace_key(key)
-            try:
-                for p, w in zip(params, wrappers):
-                    p._traced_value = w
-                with autograd.pause(train_mode=train):
-                    out = block.forward(*inputs)
-            finally:
-                _random.pop_trace_key(tok)
-                _tracing.active = prev_active
-                for p, old in zip(params, old_traced):
-                    p._traced_value = old
+            out, aux = traced_apply(block, arrays[:_n_params],
+                                    arrays[_n_params:], key, train=train)
             import jax
 
             # arbitrary nesting (e.g. RNN layers return (out, [h, c])):
@@ -341,16 +365,8 @@ class CachedOp:
             leaves, treedef = jax.tree_util.tree_flatten(
                 out, is_leaf=lambda x: isinstance(x, NDArray))
             outs = [o for o in leaves if isinstance(o, NDArray)]
-            # aux side effects (BatchNorm moving stats): wrapper buffers
-            # replaced in place during forward
-            aux_names, aux_raws = [], []
-            for (name, p), w, r in zip(block._ordered_params(), wrappers,
-                                       param_raws):
-                if w._data is not r:
-                    aux_names.append(name)
-                    aux_raws.append(w._data)
-            cached._meta[train] = (len(outs), aux_names, treedef)
-            return tuple(o._data for o in outs) + tuple(aux_raws)
+            cached._meta[train] = (len(outs), [n for n, _ in aux], treedef)
+            return tuple(o._data for o in outs) + tuple(r for _, r in aux)
 
         return _cached_graph_fn
 

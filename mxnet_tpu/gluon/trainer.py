@@ -20,15 +20,21 @@ from .parameter import Parameter, ParameterDict
 # profiler.dumps(), window-scoped under reset=True like cachedGraph)
 
 _step_stats = {"steps": 0, "params_fused": 0, "buckets_built": 0,
-               "dispatches": 0}
+               "dispatches": 0, "whole_step_steps": 0,
+               "whole_step_compiles": 0, "whole_step_fallbacks": 0}
 
 
 def trainer_step_stats():
     """Aggregate Trainer.step() fusion counters since the last reset:
     steps, params_fused (params that rode a multi-tensor update call),
     buckets_built (flat allreduce buckets), dispatches (device
-    submissions: update kernels + collectives + replica transfers), and
-    the derived dispatches_per_step."""
+    submissions: update kernels + collectives + replica transfers; a
+    compiled whole step counts as ONE), the derived dispatches_per_step,
+    and the whole-step path's own counters — whole_step_steps (steps
+    that ran as one compiled executable), whole_step_compiles (fresh
+    executable signatures; stable after warmup is the no-recompile
+    gate), whole_step_fallbacks (whole_step() calls that bypassed to
+    the eager fused path)."""
     s = dict(_step_stats)
     s["dispatches_per_step"] = (round(s["dispatches"] / s["steps"], 2)
                                 if s["steps"] else 0.0)
@@ -43,7 +49,7 @@ def reset_trainer_step_stats():
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
-                 update_on_kvstore=None):
+                 update_on_kvstore=None, whole_step=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -63,6 +69,15 @@ class Trainer:
         self._states = [None] * len(self._params)
         self._kv_initialized = False
         self._contexts = None
+        # whole-step compilation (ROADMAP item 4): opt-in via the ctor
+        # arg or MXTPU_WHOLE_STEP; None defers to the env knob so a
+        # deployment can flip the path without code changes
+        if whole_step is None:
+            from ..base import getenv
+
+            whole_step = getenv("WHOLE_STEP", False, bool)
+        self._whole_step = bool(whole_step)
+        self._whole_step_compiler = None
         # per-step fusion accounting (published into _step_stats by step)
         self._dispatches = 0
         self._buckets = 0
@@ -130,6 +145,121 @@ class Trainer:
         MXNET_OPTIMIZER_AGGREGATION_SIZE=1) restores the sequential
         one-dispatch-per-parameter behavior exactly."""
         return getattr(self._optimizer, "aggregate_num", 1) > 1
+
+    # -- whole-step compilation (ROADMAP item 4) ----------------------------
+
+    @property
+    def whole_step_enabled(self):
+        return self._whole_step
+
+    def whole_step(self, block, loss_fn, x, y=None, batch_size=None):
+        """One FULL training step — forward, loss, backward, gradient
+        allreduce, optimizer update, weight rebind — for the given
+        hybridizable ``block``.
+
+        With whole-step compilation enabled (``Trainer(...,
+        whole_step=True)`` or ``MXTPU_WHOLE_STEP=1``) the entire step
+        runs as ONE compiled XLA executable with donated weight/state
+        buffers (~1 device dispatch per post-warmup step, allreduce
+        overlapped with backward by XLA); disabled — or for any bypass
+        configuration the PR-3 fusion already recognizes (sparse, AMP
+        overflow handling, ``update_on_kvstore``, compression,
+        ``dist_async``) — the same call runs the eager
+        forward/backward + fused ``step()`` pipeline, bit-identically.
+        Bypasses under an enabled knob are LOUD (one warning per
+        reason + the ``whole_step_fallbacks`` counter).
+
+        ``loss_fn(out, y)`` (or ``loss_fn(out)`` when ``y`` is None)
+        maps the block output to a loss NDArray of any shape; gradients
+        are those of its SUM (exactly ``loss.backward()``'s all-ones
+        seed) and the summed scalar loss is returned.  ``x`` may be one
+        array or a tuple for multi-input blocks; with multiple replica
+        contexts the leading batch axis is split contiguously across
+        them (compiled: the SPMD mesh shard; eager: per-context
+        slices).  Pass STABLE ``block``/``loss_fn`` objects — the
+        compiled executable is cached per identity, so a fresh lambda
+        per call retraces every step.  ``batch_size`` defaults to the
+        leading dim of ``x`` and feeds ``rescale_grad`` exactly like
+        ``step()``."""
+        inputs = tuple(x) if isinstance(x, (list, tuple)) else (x,)
+        if batch_size is None:
+            batch_size = int(inputs[0].shape[0])
+        self._init_kvstore()
+        if self._whole_step:
+            from . import whole_step as _ws
+
+            if self._whole_step_compiler is None:
+                self._whole_step_compiler = _ws.WholeStepCompiler(self)
+            self._optimizer.rescale_grad = self._scale / batch_size
+            try:
+                with _profiler.op_scope("whole_step", cat="trainer"):
+                    loss, wstats = self._whole_step_compiler.step(
+                        block, loss_fn, inputs, y)
+            except _ws.Bypass as b:
+                self._whole_step_compiler.warn_fallback(b.reason)
+                _step_stats["whole_step_fallbacks"] += 1
+            else:
+                _step_stats["steps"] += 1
+                _step_stats["dispatches"] += 1
+                _step_stats["params_fused"] += len(self._params)
+                _step_stats["buckets_built"] += wstats["buckets"]
+                _step_stats["whole_step_steps"] += 1
+                _step_stats["whole_step_compiles"] += wstats["compiles"]
+                return loss
+        return self._eager_whole_step(block, loss_fn, inputs, y,
+                                      batch_size)
+
+    def _eager_whole_step(self, block, loss_fn, inputs, y, batch_size):
+        """The uncompiled twin of :meth:`whole_step`: eager forward +
+        autograd backward + the PR-3 fused ``step()``.  Splits the
+        global batch across the parameter replicas' contexts exactly
+        like the compiled path's mesh sharding (contiguous equal dim-0
+        chunks in context order), so the two paths see the same
+        per-replica batches."""
+        from .. import autograd as _autograd
+        from ..ndarray import ndarray as _nd_mod
+        from ..ndarray.ndarray import NDArray
+
+        ctxs = (self._params[0].list_ctx() if self._params
+                else [inputs[0].context if isinstance(inputs[0], NDArray)
+                      else None])
+
+        def _as_ctx(v, ctx):
+            if isinstance(v, NDArray):
+                return v.as_in_context(ctx) if ctx is not None else v
+            return _nd_mod.array(v, ctx=ctx)
+
+        losses = []
+        if len(ctxs) > 1:
+            n = len(ctxs)
+            b = int(inputs[0].shape[0])
+            if b % n:
+                raise MXNetError(
+                    f"whole_step batch {b} is not divisible across "
+                    f"{n} replica contexts")
+            shard = b // n
+            with _autograd.record():
+                for r, ctx in enumerate(ctxs):
+                    sl = slice(r * shard, (r + 1) * shard)
+                    xs = tuple(_as_ctx(v[sl], ctx) for v in inputs)
+                    out = block(*xs)
+                    l = loss_fn(out, _as_ctx(y[sl], ctx)) \
+                        if y is not None else loss_fn(out)
+                    losses.append(l.sum())
+            _autograd.backward(losses)
+        else:
+            ctx = ctxs[0]
+            with _autograd.record():
+                out = block(*(_as_ctx(v, ctx) for v in inputs))
+                l = loss_fn(out, _as_ctx(y, ctx)) if y is not None \
+                    else loss_fn(out)
+                losses.append(l.sum())
+            losses[0].backward()
+        self.step(batch_size)
+        total = losses[0]
+        for l in losses[1:]:
+            total = total + l.as_in_context(total.context)
+        return total
 
     def allreduce_grads(self):
         self._init_kvstore()

@@ -303,6 +303,27 @@ class KVStore:
                 o._data = pieces_for(next(iter(o._data.devices())))[i]
         stats["buckets"] += 1
 
+    # -- whole-step (traced) form ------------------------------------------
+
+    def traced_pushpull(self, g_raws, axis_name):
+        """The multi-key ``pushpull`` lowered INTO a compiled step
+        (ROADMAP item 4): called while tracing the whole-step closure,
+        it returns the cross-replica-summed gradients as traced buffers
+        with the reduction expressed as in-program collectives, so XLA
+        schedules it (overlapped with backward) instead of Python
+        stitching eager collectives between dispatches.
+
+        Fusion-ineligible stores (compression, server-side optimizer,
+        dist_async) must not reach here — the whole-step compiler
+        bypasses to the eager path first, mirroring
+        ``_fusion_eligible``."""
+        if not self._fusion_eligible():
+            raise MXNetError(
+                "traced_pushpull on a fusion-ineligible kvstore "
+                "(compression / update_on_kvstore / dist_async); the "
+                "whole-step compiler must bypass to the eager path")
+        return traced_bucket_allreduce(g_raws, axis_name)
+
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the requested rows (ref: KVStoreLocal::PullRowSparse).
 
@@ -523,6 +544,66 @@ def create(name="local"):
     if name not in _VALID:
         raise MXNetError(f"unknown kvstore type {name!r}; valid: {_VALID}")
     return KVStore(name)
+
+
+# ---------------------------------------------------------------------------
+# Whole-step (traced) gradient reduction — the in-program twin of the
+# eager flat-bucket pushpull above.
+
+
+def traced_bucket_allreduce(g_raws, axis_name):
+    """In-program twin of the eager flat-bucket reduction
+    (``_pushpull_fused``): pack same-dtype gradients into size-capped
+    flat buckets (``MXTPU_KVSTORE_BUCKET_MB``, the same knob), one
+    ``lax.psum`` over ``axis_name`` per bucket, unpack into per-tensor
+    views.  Runs only under a trace (shard_map over the replica/world
+    mesh); with ``axis_name=None`` (single replica, nothing to sum) it
+    is the identity, mirroring the eager path's rebind-only case.
+
+    The pack/unpack kernels are the engine's shared flat-buffer staging
+    kernels (``_k_flatten``/``_k_unflatten``), so the comm-fusion tier
+    has one implementation eager and traced."""
+    if axis_name is None:
+        return list(g_raws)
+    from . import engine
+    from .base import getenv
+
+    cap = max(int(getenv("KVSTORE_BUCKET_MB", 32.0, float) * (1 << 20)), 1)
+    # one bucket stream per dtype, members in arrival order (the same
+    # grouping fingerprint the eager path uses, minus the slot layout —
+    # inside SPMD there is exactly one slot per shard)
+    groups = {}
+    order = []  # (group_key, index within group) per input position
+    for g in g_raws:
+        k = str(g.dtype)
+        groups.setdefault(k, []).append(g)
+        order.append((k, len(groups[k]) - 1))
+    reduced = {}
+    for k, members in groups.items():
+        outs, bucket, size = [], [], 0
+        for g in members:
+            nbytes = g.size * g.dtype.itemsize
+            if bucket and size + nbytes > cap:
+                outs.extend(_psum_bucket(bucket, axis_name, engine))
+                bucket, size = [], 0
+            bucket.append(g)
+            size += nbytes
+        if bucket:
+            outs.extend(_psum_bucket(bucket, axis_name, engine))
+        reduced[k] = outs
+    return [reduced[k][i] for k, i in order]
+
+
+def _psum_bucket(bucket, axis_name, engine):
+    """ONE in-program collective for every gradient in ``bucket``."""
+    shapes = [tuple(int(d) for d in g.shape) for g in bucket]
+    if len(bucket) == 1:
+        # a lone tensor (e.g. bigger than the cap) gains nothing from
+        # pack/unpack — reduce it directly, like the eager single case
+        return [jax.lax.psum(bucket[0], axis_name)]
+    flat = engine._k_flatten(list(bucket))
+    red = jax.lax.psum(flat, axis_name)
+    return list(engine._k_unflatten(red, shapes=tuple(shapes)))
 
 
 # ---------------------------------------------------------------------------

@@ -402,6 +402,9 @@ def _fused_apply(kernel, static, chunk, svals):
                 _nondonate_warmed.add(sig)
         jitted = get_jitted(_multi_wrapper(kernel), {"static": static},
                             donate_argnums=donate)
+        from ._imperative import count_dispatch
+
+        count_dispatch()
         new_ws, new_sts = jitted(ws, gs, sts, list(scalars))
         for a in new_ws:
             engine.track(a)
@@ -601,9 +604,103 @@ class Optimizer:
                 stats["params_fused"] += len(chunk)
         return stats
 
+    # -- whole-step (traced) path -------------------------------------------
+
+    def whole_step_plan(self, indices, weights, states):
+        """Host-side grouping for the TRACED whole-step update: the same
+        (kernel, dtype, static-attrs, scalar-values) grouping and
+        ``aggregate_num`` chunking that ``fused_update`` dispatches,
+        precomputed so the whole-step closure can apply each chunk's
+        ``_fk_*`` kernel over concatenated flat buffers INSIDE one
+        compiled program (update math keeps its single source).
+
+        Returns ``(plan, svals, None)`` on success — ``plan`` is a
+        hashable tuple of ``(kernel, static, n_states, np_dtype, idxs)``
+        chunks (``idxs`` index into the given param order) and ``svals``
+        the per-chunk traced-scalar value tuples — or ``(None, None,
+        reason)`` when any param has no fused form (those
+        configurations bypass to the eager paths).
+
+        Validation runs BEFORE any step-count tick, so a bypassed plan
+        has no side effects; a successful plan ticks ``_update_count``
+        for every param exactly like ``fused_update`` (state snapshots
+        stay interchangeable across the paths).
+        """
+        entries = list(zip(indices, weights, states))
+        specs = []
+        for i, w, st in entries:
+            spec = self._fused_spec(i)
+            sts = [] if st is None else (
+                [st] if isinstance(st, NDArray) else list(st))
+            if spec is None:
+                return None, None, (
+                    f"optimizer {type(self).__name__} has no fused "
+                    f"kernel for param {i}")
+            if self.multi_precision and w.dtype == np.float16:
+                return None, None, \
+                    "multi-precision fp16 master-weight params"
+            if not np.issubdtype(np.dtype(w.dtype), np.floating):
+                return None, None, f"non-float param {i} ({w.dtype})"
+            if (len(sts) != spec[1]
+                    or any(s is None or s.dtype != w.dtype
+                           or s.shape != w.shape for s in sts)):
+                return None, None, (
+                    f"param {i} state layout does not match its fused "
+                    f"kernel")
+            specs.append((spec, sts))
+        groups = {}
+        for pos, ((i, w, _st), (spec, sts)) in enumerate(zip(entries,
+                                                             specs)):
+            kernel, _, scalar_names, static = spec
+            # tick BEFORE reading lr/t, exactly like fused_update
+            self._update_count(i)
+            t = self._index_update_count[i]
+            svals = tuple(
+                self._get_lr(i) if n == "lr" else float(t)
+                for n in scalar_names
+            ) + (self._get_wd(i), float(self.rescale_grad))
+            key = (kernel, str(w.dtype), static, svals, len(sts))
+            groups.setdefault(key, []).append(pos)
+        agg = max(1, int(self.aggregate_num))
+        plan, svals_out = [], []
+        for (kernel, dt, static, svals, n_states), members in \
+                groups.items():
+            for c0 in range(0, len(members), agg):
+                plan.append((kernel, static, n_states, dt,
+                             tuple(members[c0:c0 + agg])))
+                svals_out.append(svals)
+        return tuple(plan), svals_out, None
+
     @staticmethod
     def _scalar(v, like):
         return _wrap(jnp.asarray(v, dtype=like.dtype))
+
+
+def apply_whole_step_plan(plan, w_raws, g_raws, st_raws, sval_raws):
+    """Pure/traced twin of ``fused_update``'s dispatch loop: run every
+    chunk of ``plan`` through its fused multi-tensor kernel (the same
+    ``_multi_wrapper(kernel)`` body the eager path jits) over the given
+    raw buffers.  Scalar hyperparams arrive as traced 1-D arrays
+    (``sval_raws``, one per chunk, already cast to the chunk dtype) so
+    LR schedules never retrace the step.  Returns ``(new_w_raws,
+    new_st_raws)`` aligned with the inputs — bit-identical to the eager
+    fused dispatches on the same values, because every op is the same
+    elementwise kernel over the same flat concatenation."""
+    new_ws = list(w_raws)
+    new_sts = [list(st) for st in st_raws]
+    for (kernel, static, n_states, _dt, idxs), sv in zip(plan, sval_raws):
+        ws = [w_raws[j] for j in idxs]
+        gs = [g_raws[j] for j in idxs]
+        cols = [[st_raws[j][slot] for j in idxs]
+                for slot in range(n_states)]
+        scalars = [sv[k] for k in range(int(sv.shape[0]))]
+        outs_w, outs_cols = _multi_wrapper(kernel)(ws, gs, cols, scalars,
+                                                   static=static)
+        for jj, j in enumerate(idxs):
+            new_ws[j] = outs_w[jj]
+            for slot in range(n_states):
+                new_sts[j][slot] = outs_cols[slot][jj]
+    return new_ws, [tuple(st) for st in new_sts]
 
 
 @register("sgd")

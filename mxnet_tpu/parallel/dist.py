@@ -183,6 +183,15 @@ def _world_mesh():
     return _world_mesh_cache
 
 
+def world_mesh():
+    """Public accessor for the one-device-per-process 'world' mesh.
+    The whole-step trainer compiles its cross-process gradient psum on
+    this mesh when running under a dist kvstore — the same mesh the
+    eager :func:`allreduce` jits against, so eager and compiled steps
+    reduce over identical device sets."""
+    return _world_mesh()
+
+
 def allreduce(value):
     """Sum an NDArray across processes — an IN-GRAPH XLA collective on a
     process-spanning mesh (ref: KVStoreDist push+pull pair → DCN

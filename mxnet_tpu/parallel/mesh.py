@@ -40,6 +40,17 @@ def replicated(mesh):
     return NamedSharding(mesh, PartitionSpec())
 
 
+def replica_mesh(devices, axis="dp"):
+    """One-axis mesh over an explicit replica device list — the
+    whole-step trainer's SPMD form of the eager per-context replica
+    set (each gluon Parameter context becomes one shard of the batch
+    axis; the kvstore allreduce becomes an in-program psum over
+    ``axis``)."""
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(list(devices)), (axis,))
+
+
 def data_axes(mesh):
     """The mesh axes the batch dim shards over.  A mesh axis named
     'dcn' is the cross-slice/process data axis (ref: ps-lite workers ×

@@ -364,7 +364,10 @@ register_section("trainerStep", _trainer_step_counters, _rows_table(
     (("steps", "steps"),
      ("params fused", "params_fused"),
      ("allreduce buckets built", "buckets_built"),
-     ("dispatches per step", "dispatches_per_step"))))
+     ("dispatches per step", "dispatches_per_step"),
+     ("whole-step compiled steps", "whole_step_steps"),
+     ("whole-step compiles", "whole_step_compiles"),
+     ("whole-step fallbacks", "whole_step_fallbacks"))))
 register_section("dataPipeline", _data_pipeline_counters, _rows_table(
     "Data Pipeline",
     (("batches delivered", "batches"),

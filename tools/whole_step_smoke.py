@@ -1,0 +1,115 @@
+"""Whole-step compilation gate for `make verify` (docs/performance.md).
+
+50 whole-step Trainer steps on a multi-param model under a DECAYING LR
+schedule must execute as ONE device program submission each (measured
+by the global dispatch counter — any eager op leaking into the loop
+fails the gate) with ZERO post-warmup XLA compiles, the compiled path
+must actually engage (whole_step_steps == steps, zero fallbacks), and
+a 5-step whole-step vs fused vs sequential A/B/C must leave BIT-
+identical weights.  Runs on the CPU backend so the gate is
+deterministic and fast on any host.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the gate A/B/Cs whole-step vs fused vs aggregate_num=1 — exported
+# aggregation/whole-step env knobs would collapse the arms
+for _var in ("MXNET_OPTIMIZER_AGGREGATION_SIZE",
+             "MXTPU_OPTIMIZER_AGGREGATION_SIZE",
+             "MXTPU_WHOLE_STEP", "MXNET_WHOLE_STEP"):
+    os.environ.pop(_var, None)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import _imperative, gluon, lr_scheduler, nd  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+from mxnet_tpu.gluon import trainer as trainer_mod  # noqa: E402
+
+N_LAYERS, UNITS, WARMUP, STEPS = 15, 16, 5, 50
+
+
+def loss_fn(out, y):
+    return (out - y) ** 2
+
+
+def build(whole_step, aggregate_num=None):
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    for _ in range(N_LAYERS):
+        # tanh keeps a 15-layer stack numerically bounded: the parity
+        # gate compares weights with array_equal, and a diverged run's
+        # NaNs compare unequal to themselves
+        net.add(nn.Dense(UNITS, in_units=UNITS, activation="tanh"))
+    net.initialize(mx.init.Xavier())
+    kwargs = {"learning_rate": 0.1, "momentum": 0.9,
+              "lr_scheduler": lr_scheduler.FactorScheduler(
+                  step=5, factor=0.95, base_lr=0.1)}
+    if aggregate_num is not None:
+        kwargs["aggregate_num"] = aggregate_num
+    trainer = gluon.Trainer(net.collect_params(), "sgd", kwargs,
+                            whole_step=whole_step)
+    x = np.random.rand(4, UNITS).astype(np.float32)
+    y = np.random.rand(4, UNITS).astype(np.float32)
+    return net, trainer, x, y
+
+
+def main():
+    net, trainer, x, y = build(True)
+    for _ in range(WARMUP):
+        trainer.whole_step(net, loss_fn, x, y)
+    nd.waitall()
+    lr0 = trainer.learning_rate
+    trainer_mod.reset_trainer_step_stats()
+    c0 = _imperative.compiled_executable_count()
+    d0 = _imperative.device_dispatch_count()
+    for _ in range(STEPS):
+        trainer.whole_step(net, loss_fn, x, y)
+    nd.waitall()
+    compiles = _imperative.compiled_executable_count() - c0
+    dispatches = _imperative.device_dispatch_count() - d0
+    stats = trainer_mod.trainer_step_stats()
+    assert compiles == 0, \
+        f"whole step recompiled: {compiles} new executables in " \
+        f"{STEPS} post-warmup steps (lr schedule must ride as a " \
+        "traced scalar)"
+    assert dispatches == STEPS, \
+        f"{dispatches} device dispatches for {STEPS} whole steps — " \
+        "eager work is leaking into the compiled step loop"
+    assert stats["whole_step_steps"] == STEPS and \
+        stats["whole_step_fallbacks"] == 0, \
+        f"whole-step path did not engage: {stats}"
+    assert stats["whole_step_compiles"] == 0, \
+        f"executable signature churn post-warmup: {stats}"
+    assert trainer.learning_rate < lr0, \
+        f"LR schedule did not decay ({lr0} -> {trainer.learning_rate})"
+
+    # 5-step bit parity: whole-step vs fused vs aggregate_num=1
+    results = {}
+    for arm, (ws, agg) in (("whole", (True, None)),
+                           ("fused", (False, None)),
+                           ("seq", (False, 1))):
+        net_a, tr_a, x_a, y_a = build(ws, aggregate_num=agg)
+        for _ in range(5):
+            tr_a.whole_step(net_a, loss_fn, x_a, y_a)
+        results[arm] = [p.data().asnumpy()
+                        for p in net_a.collect_params().values()]
+    for arm in ("fused", "seq"):
+        for a, b in zip(results["whole"], results[arm]):
+            if not np.array_equal(a, b):
+                raise AssertionError(
+                    f"whole-step/{arm} weight divergence")
+
+    print(f"WHOLE_STEP_SMOKE_OK steps={STEPS} "
+          f"post_warmup_compiles={compiles} "
+          f"dispatches_per_step={dispatches / STEPS:.2f} "
+          f"whole_step_steps={stats['whole_step_steps']} "
+          f"lr {lr0:.4f}->{trainer.learning_rate:.4f}")
+
+
+if __name__ == "__main__":
+    main()
