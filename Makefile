@@ -63,6 +63,14 @@ step-fusion-smoke:
 whole-step-smoke:
 	env PYTHONPATH=. python tools/whole_step_smoke.py
 
+# ZeRO-1 gate: 50 sharded whole steps on the virtual 8-device mesh at
+# ONE counted dispatch each, zero post-warmup compiles under LR decay,
+# 5-step sharded/unsharded bit parity, and per-replica optimizer-state
+# bytes < unsharded/2 — see tools/zero_shard_smoke.py /
+# docs/performance.md
+zero-smoke:
+	env PYTHONPATH=. python tools/zero_shard_smoke.py
+
 # input-pipeline gate: prefetch overlap engaged, zero post-warmup
 # compiles over mixed lengths, bit-identical mid-epoch resume — see
 # tools/pipeline_smoke.py / docs/data.md
@@ -95,7 +103,7 @@ analyze:
 
 # the ROADMAP tier-1 gate, verbatim ($$ = make-escaped shell $)
 verify: SHELL := /bin/bash
-verify: analyze serve-smoke step-fusion-smoke whole-step-smoke pipeline-smoke chaos-smoke trace-smoke
+verify: analyze serve-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke trace-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
-.PHONY: all clean test verify analyze serve-smoke step-fusion-smoke whole-step-smoke pipeline-smoke chaos-smoke trace-smoke
+.PHONY: all clean test verify analyze serve-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke trace-smoke

@@ -459,8 +459,29 @@ def _leaf_trainer_step(platform):
     for forward + backward + allreduce + update.  Reports per-arm step
     latency, dispatches per step (the global device-dispatch counter,
     not self-reported stats), and post-warmup compiles, plus the
-    no-recompile check across a decaying LR schedule."""
+    no-recompile check across a decaying LR schedule.
+
+    A FOURTH arm (whole-step + ZeRO-1, ``zero_shard=True``) runs the
+    same model on an 8-replica mesh (virtual on CPU) and records the
+    MEASURED per-replica optimizer-state bytes next to an unsharded
+    whole-step run on the same mesh — the 1/world_size memory claim
+    as a benchmark number, not a docstring."""
+    if platform == "cpu":
+        # the ZeRO arm needs a replica mesh: 8 virtual CPU devices,
+        # requested BEFORE the leaf's first jax import (this leaf runs
+        # in its own subprocess; arms A-C still build on device 0,
+        # unchanged)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
     jax = _leaf_setup(platform)
+    if platform == "cpu":
+        try:
+            jax.config.update("jax_num_cpu_devices", 8)
+        except Exception:  # noqa: BLE001 — older jax: XLA_FLAGS rules
+            pass
 
     import numpy as np
 
@@ -477,13 +498,17 @@ def _leaf_trainer_step(platform):
     # run in their own subprocess, so popping is side-effect free)
     for _var in ("MXNET_OPTIMIZER_AGGREGATION_SIZE",
                  "MXTPU_OPTIMIZER_AGGREGATION_SIZE",
-                 "MXTPU_WHOLE_STEP", "MXNET_WHOLE_STEP"):
+                 "MXTPU_WHOLE_STEP", "MXNET_WHOLE_STEP",
+                 "MXTPU_ZERO_SHARD", "MXNET_ZERO_SHARD"):
         os.environ.pop(_var, None)
 
     def loss_fn(out, y):
         return (out - y) ** 2
 
-    def measure(whole_step, aggregate_num):
+    def measure(whole_step, aggregate_num, zero_shard=False, ctx=None,
+                arm_iters=None, arm_windows=None):
+        arm_iters = arm_iters or iters
+        arm_windows = arm_windows or windows
         mx.random.seed(0)
         np.random.seed(0)
         net = nn.HybridSequential()
@@ -491,7 +516,7 @@ def _leaf_trainer_step(platform):
             # tanh bounds the deep linear stack so no arm diverges over
             # the measurement window
             net.add(nn.Dense(units, in_units=units, activation="tanh"))
-        net.initialize(mx.init.Xavier())
+        net.initialize(mx.init.Xavier(), ctx=ctx)
         sched = lr_scheduler.FactorScheduler(step=5, factor=0.97,
                                              base_lr=0.1)
         kwargs = {"learning_rate": 0.1, "momentum": 0.9,
@@ -499,7 +524,8 @@ def _leaf_trainer_step(platform):
         if aggregate_num is not None:
             kwargs["aggregate_num"] = aggregate_num
         trainer = gluon.Trainer(net.collect_params(), "sgd", kwargs,
-                                whole_step=whole_step)
+                                whole_step=whole_step,
+                                zero_shard=zero_shard)
         x = np.random.rand(8, units).astype(np.float32)
         y = np.random.rand(8, units).astype(np.float32)
         for _ in range(5):
@@ -509,25 +535,51 @@ def _leaf_trainer_step(platform):
         c0 = _imperative.compiled_executable_count()
         d0 = _imperative.device_dispatch_count()
         best = None
-        for _ in range(windows):
+        for _ in range(arm_windows):
             t0 = time.perf_counter()
-            for _ in range(iters):
+            for _ in range(arm_iters):
                 trainer.whole_step(net, loss_fn, x, y)
             nd.waitall()
-            dt = (time.perf_counter() - t0) / iters
+            dt = (time.perf_counter() - t0) / arm_iters
             best = dt if best is None or dt < best else best
         stats = trainer_mod.trainer_step_stats()
         compiles = _imperative.compiled_executable_count() - c0
         disp = round((_imperative.device_dispatch_count() - d0)
                      / max(stats["steps"], 1), 2)
-        return best, stats, compiles, disp
+        return best, stats, compiles, disp, trainer
 
     n_params = 2 * n_layers
-    seq_s, seq_stats, seq_compiles, seq_disp = measure(False, 1)
-    fused_s, fused_stats, fused_compiles, fused_disp = measure(False,
-                                                               None)
-    whole_s, whole_stats, whole_compiles, whole_disp = measure(True,
-                                                               None)
+    seq_s, seq_stats, seq_compiles, seq_disp, _ = measure(False, 1)
+    fused_s, fused_stats, fused_compiles, fused_disp, _ = measure(
+        False, None)
+    whole_s, whole_stats, whole_compiles, whole_disp, _ = measure(
+        True, None)
+
+    # arm D: whole-step + ZeRO-1 on the replica mesh, next to an
+    # unsharded whole-step run on the SAME mesh for the state-bytes
+    # ratio (fewer iters — this arm prices memory, not latency)
+    zero_arm = None
+    mesh_ctxs = [mx.xla(i) for i in range(len(jax.devices()))]
+    if len(mesh_ctxs) > 1:
+        ubase_s, _us, _uc, _ud, utr = measure(
+            True, None, ctx=mesh_ctxs, arm_iters=10, arm_windows=2)
+        zero_s, zero_stats, zero_compiles, zero_disp, ztr = measure(
+            True, None, zero_shard=True, ctx=mesh_ctxs,
+            arm_iters=10, arm_windows=2)
+        ubytes = utr.optimizer_state_bytes()["per_replica"]
+        zbytes = ztr.optimizer_state_bytes()["per_replica"]
+        zero_arm = {
+            "ms_per_step": round(zero_s * 1e3, 3),
+            "unsharded_mesh_ms_per_step": round(ubase_s * 1e3, 3),
+            "dispatches_per_step": zero_disp,
+            "post_warmup_compiles": zero_compiles,
+            "zero_steps": zero_stats["zero_steps"],
+            "fallbacks": zero_stats["zero_fallbacks"],
+            "world_size": len(mesh_ctxs),
+            "state_bytes_per_replica": zbytes,
+            "state_bytes_per_replica_unsharded": ubytes,
+            "state_shrink_ratio": round(zbytes / max(ubytes, 1), 4),
+        }
 
     dev = jax.devices()[0]
     print(json.dumps({
@@ -555,6 +607,7 @@ def _leaf_trainer_step(platform):
                 "whole_step_steps": whole_stats["whole_step_steps"],
                 "fallbacks": whole_stats["whole_step_fallbacks"],
             },
+            "whole_step_zero": zero_arm,
         },
         "speedup_whole_vs_fused": round(fused_s / whole_s, 4),
         "speedup_whole_vs_sequential": round(seq_s / whole_s, 4),

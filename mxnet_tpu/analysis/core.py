@@ -106,9 +106,11 @@ class AnalysisConfig:
     # naming convention for jit-traced kernels
     traced_prefixes: tuple = ("_k_", "_fk_")
     # extra traced roots by exact function name (nested defs included):
-    # the CachedOp graph fn and the whole-step trainer closure — host
-    # syncs anywhere inside either are lint errors (MXA201)
-    traced_names: tuple = ("_cached_graph_fn", "_whole_step_fn")
+    # the CachedOp graph fn, the whole-step trainer closure, and the
+    # ZeRO-1 sharded update it lowers into — host syncs anywhere inside
+    # any of them are lint errors (MXA201)
+    traced_names: tuple = ("_cached_graph_fn", "_whole_step_fn",
+                           "apply_zero_step_plan")
     getenv_fns: tuple = ("getenv",)
     fault_point_fns: tuple = ("fault_point",)
     # telemetry catalog (MXA403/MXA405): how sections register, which

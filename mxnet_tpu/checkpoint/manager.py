@@ -546,10 +546,18 @@ class CheckpointManager:
         saved_procs = manifest.get("num_processes", 1)
         if saved_procs != _num_processes():
             raise MXNetError(
-                f"{mpath}: checkpoint was saved by {saved_procs} "
-                f"process(es) but this job runs {_num_processes()}; "
-                "per-rank shards do not re-partition across world "
-                "sizes — restore with the original topology")
+                f"{mpath}: world-size mismatch — checkpoint was saved "
+                f"by a {saved_procs}-process job but this job runs "
+                f"{_num_processes()} process(es). Per-rank parameter/"
+                "pipeline shards do not re-partition across world "
+                "sizes yet (elastic resharding is ROADMAP item 2); "
+                "restore with the original topology. ZeRO-1 sharded "
+                "optimizer state alone CAN cross world sizes: every "
+                "trainer-shard<r>.states file is gathered on restore "
+                "into canonical per-param states "
+                "(Trainer.load_states_dict gather path, see "
+                "docs/checkpointing.md), so a job restarted at the "
+                "saved world size may flip zero_shard freely")
         rank = _rank()
         with profiler.op_scope("checkpoint.restore", cat="checkpoint"):
             loaded = self._restore_params(d, rank, params)
@@ -641,7 +649,49 @@ class CheckpointManager:
                 f"{rank} (was it saved without trainer=?)")
         with open(tfile, "rb") as f:
             blob = pickle.load(f)
+        self._merge_zero_shards(d, blob, own=f"trainer-shard{rank}.states")
         trainer.load_states_dict(blob, source=tfile)
+
+    @staticmethod
+    def _merge_zero_shards(d, blob, own=None):
+        """Gather-on-restore for ZeRO-1 optimizer state: a multi-process
+        sharded save leaves each rank's 1/world state shards in its own
+        ``trainer-shard<r>.states``; when this rank's blob does not
+        cover the full shard world, pull the missing ranks' shards from
+        their sibling files so ``Trainer.load_states_dict`` can gather
+        them into canonical per-param states (a sharded run restarts
+        unsharded and vice versa).  Single-process saves already carry
+        every rank's shards and skip this scan."""
+        zero = blob.get("zero") if isinstance(blob, dict) else None
+        if not zero:
+            return
+        world = int(zero["world"])
+        have = {int(r) for r in zero["shards"]}
+        if have == set(range(world)):
+            return
+        rx = re.compile(r"^trainer-shard(\d+)\.states$")
+        for name in sorted(os.listdir(d)):
+            if have == set(range(world)):
+                break  # every rank gathered: skip the remaining blobs
+            m = rx.match(name)
+            if m is None or name == own:
+                continue
+            with open(os.path.join(d, name), "rb") as f:
+                peer = pickle.load(f)
+            pz = peer.get("zero") if isinstance(peer, dict) else None
+            if not pz:
+                continue
+            for r, chunks in pz["shards"].items():
+                if int(r) not in have:
+                    zero["shards"][r] = chunks
+                    have.add(int(r))
+        missing = set(range(world)) - have
+        if missing:
+            raise MXNetError(
+                f"{d}: ZeRO-1 optimizer-state shards for rank(s) "
+                f"{sorted(missing)} of {world} are missing — the "
+                "sharded save did not complete on every rank; restore "
+                "an earlier step")
 
     # -- preemption ---------------------------------------------------------
 

@@ -328,6 +328,48 @@ def _k_unflatten(flat, *, shapes):
     return tuple(outs)
 
 
+def _k_flatten_pad(ts, *, padded):
+    """ONE dispatch: many buffers -> one flat buffer zero-padded to
+    ``padded`` elements (the ZeRO-1 shard tier: flat buckets must be a
+    multiple of the world size so every rank's shard is equal-sized;
+    the pad region is zeros, which every ``_fk_*`` update kernel maps
+    to finite values and the unpack side never reads)."""
+    import jax.numpy as jnp
+
+    flat = _k_flatten(ts)
+    pad = int(padded) - flat.shape[0]
+    if pad <= 0:
+        return flat
+    return jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+
+
+def flatten_pad(jarrs, padded):
+    """Eager form of :func:`_k_flatten_pad`: pack raw same-dtype buffers
+    into one flat buffer padded with zeros to ``padded`` elements, as a
+    single cached-executable dispatch."""
+    from . import _imperative
+
+    _imperative.count_dispatch()
+    return track(_imperative.get_jitted(
+        _k_flatten_pad, {"padded": int(padded)})(list(jarrs)))
+
+
+def _k_slice1d(flat, *, start, size):
+    """ONE dispatch: a static [start, start+size) window of a flat
+    buffer (the ZeRO eager weight-shard extraction — one slice per
+    rank instead of materializing every rank's piece)."""
+    return flat[int(start):int(start) + int(size)]
+
+
+def slice_flat(jarr, start, size):
+    """Eager cached-executable form of :func:`_k_slice1d`."""
+    from . import _imperative
+
+    _imperative.count_dispatch()
+    return track(_imperative.get_jitted(
+        _k_slice1d, {"start": int(start), "size": int(size)})(jarr))
+
+
 def flatten_arrays(jarrs):
     """Pack raw jax buffers (same device, same dtype) into one flat
     buffer with a single cached-executable dispatch."""

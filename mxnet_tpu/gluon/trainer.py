@@ -21,7 +21,8 @@ from .parameter import Parameter, ParameterDict
 
 _step_stats = {"steps": 0, "params_fused": 0, "buckets_built": 0,
                "dispatches": 0, "whole_step_steps": 0,
-               "whole_step_compiles": 0, "whole_step_fallbacks": 0}
+               "whole_step_compiles": 0, "whole_step_fallbacks": 0,
+               "zero_steps": 0, "zero_fallbacks": 0}
 
 
 def trainer_step_stats():
@@ -34,7 +35,10 @@ def trainer_step_stats():
     that ran as one compiled executable), whole_step_compiles (fresh
     executable signatures; stable after warmup is the no-recompile
     gate), whole_step_fallbacks (whole_step() calls that bypassed to
-    the eager fused path)."""
+    the eager fused path), and the ZeRO-1 counters — zero_steps (steps
+    whose weight update ran cross-replica-sharded) and zero_fallbacks
+    (zero_shard steps that ran unsharded for an ineligible
+    configuration)."""
     s = dict(_step_stats)
     s["dispatches_per_step"] = (round(s["dispatches"] / s["steps"], 2)
                                 if s["steps"] else 0.0)
@@ -49,7 +53,8 @@ def reset_trainer_step_stats():
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
-                 update_on_kvstore=None, whole_step=None):
+                 update_on_kvstore=None, whole_step=None,
+                 zero_shard=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -78,6 +83,19 @@ class Trainer:
             whole_step = getenv("WHOLE_STEP", False, bool)
         self._whole_step = bool(whole_step)
         self._whole_step_compiler = None
+        # ZeRO-1 cross-replica weight-update sharding (arXiv 2004.13336):
+        # reduce-scatter grads, update only this rank's shard, allgather
+        # weights — optimizer state shrinks to 1/world_size per replica.
+        # Opt-in via the ctor arg or MXTPU_ZERO_SHARD; None defers to
+        # the env knob like whole_step
+        if zero_shard is None:
+            from ..base import getenv
+
+            zero_shard = getenv("ZERO_SHARD", False, bool)
+        self._zero_shard = bool(zero_shard)
+        self._zero_states = {}   # chunk pos -> {rank: tuple(shard NDArrays)}
+        self._zero_layout = None  # (per-chunk layout tuple, world)
+        self._zero_warned = set()
         # per-step fusion accounting (published into _step_stats by step)
         self._dispatches = 0
         self._buckets = 0
@@ -132,19 +150,292 @@ class Trainer:
                 "with update_on_kvstore; use update_on_kvstore=False")
         self._optimizer.rescale_grad = self._scale / batch_size
         self._dispatches = self._buckets = self._params_fused = 0
+        ran_zero = False
         with _profiler.op_scope("trainer.step", cat="trainer"):
-            self._allreduce_grads()
-            self._update(ignore_stale_grad)
+            if self._zero_shard:
+                ran_zero = self._try_zero_step()
+            if not ran_zero:
+                self._allreduce_grads()
+                self._update(ignore_stale_grad)
         _step_stats["steps"] += 1
         _step_stats["dispatches"] += self._dispatches
         _step_stats["buckets_built"] += self._buckets
         _step_stats["params_fused"] += self._params_fused
+        if ran_zero:
+            _step_stats["zero_steps"] += 1
 
     def _fusion_enabled(self):
         """The fused step is ON by default; aggregate_num=1 (or
         MXNET_OPTIMIZER_AGGREGATION_SIZE=1) restores the sequential
         one-dispatch-per-parameter behavior exactly."""
         return getattr(self._optimizer, "aggregate_num", 1) > 1
+
+    # -- ZeRO-1 sharded weight update (eager tier) --------------------------
+
+    def _zero_fallback(self, reason):
+        """Loud, once-per-reason notice that a zero_shard step ran the
+        unsharded path; returns False for the _try_zero_step caller."""
+        if reason not in self._zero_warned:
+            self._zero_warned.add(reason)
+            from ..log import get_logger
+
+            get_logger("mxnet_tpu.trainer").warning(
+                "ZeRO-1 sharded update bypassed -> unsharded path: %s",
+                reason)
+        _step_stats["zero_fallbacks"] += 1
+        return False
+
+    def _zero_ineligible_reason(self, ctxs):
+        """The eager sharded step's bypass matrix (checked BEFORE the
+        plan ticks anything) — every case the fused step already
+        recognizes, plus the eager-tier-only dist restriction."""
+        if not self._fusion_enabled():
+            return "aggregate_num == 1 (sequential step requested)"
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        if scaler is not None and scaler.enabled:
+            return "amp dynamic loss scaling (the overflow skip is a " \
+                "host-side decision)"
+        if self._update_on_kvstore and self._kvstore is not None:
+            return "update_on_kvstore=True (server-side optimizer)"
+        if self._kvstore is None:
+            return "no kvstore to reduce over"
+        if self._kvstore._compression is not None:
+            return "gradient compression (per-key error feedback)"
+        if self._kvstore._is_dist():
+            return "dist kvstore (the eager sharded step is single-" \
+                "process; multi-process ZeRO rides the whole-step path)"
+        ctxs0 = tuple(ctxs)
+        for p in self._params:
+            if getattr(p, "grad_stype", "default") != "default":
+                return f"sparse-grad parameter {p.name}"
+            if getattr(p, "stype", "default") != "default":
+                return f"sparse parameter {p.name}"
+            if p.grad_req == "add":
+                return f"grad_req='add' on {p.name}"
+            if tuple(p.list_ctx()) != ctxs0:
+                return "parameters span different context sets"
+        return None
+
+    def _try_zero_step(self):
+        """Run one ZeRO-1 sharded eager step; returns True when the
+        sharded path engaged (False = run the unsharded step instead —
+        with a single replica sharding is the identity, silently)."""
+        ctxs = list(self._contexts or [])
+        if len(ctxs) <= 1:
+            return False  # world size 1: nothing to shard
+        reason = self._zero_ineligible_reason(ctxs)
+        if reason is not None:
+            return self._zero_fallback(reason)
+        ctx0 = ctxs[0]
+        plan, svals, reason = self._optimizer.whole_step_plan(
+            list(range(len(self._params))),
+            [p.data(ctx0) for p in self._params],
+            [None] * len(self._params), zero_world=len(ctxs))
+        if reason is not None:
+            return self._zero_fallback(reason)
+        self._ensure_zero_states(plan, len(ctxs),
+                                 dict(enumerate(ctxs)))
+        self._zero_eager_run(plan, svals, ctxs)
+        return True
+
+    def _zero_eager_run(self, plan, svals, ctxs):
+        """reduce-scatter -> shard update -> weight allgather, eagerly:
+        the bit-identical sharded twin of _allreduce_grads + _update +
+        _broadcast_updated (same pairwise-tree reduce order, same
+        ``_fk_*`` kernels over the same flat concatenation — only the
+        slice each replica updates, and therefore the optimizer state
+        each replica holds, shrinks to 1/world)."""
+        from .. import engine as _eng
+
+        n = len(ctxs)
+        devs = [c.jax_device() for c in ctxs]
+        stats = {"buckets": 0, "dispatches": 0}
+        g_shards, w_shards = [], []
+        with _profiler.op_scope("reduce_scatter", cat="trainer"):
+            for (_k, _s, _n_st, _dt, idxs, _total, padded) in plan:
+                vlists = [self._params[j].list_grad() for j in idxs]
+                g_shards.append(self._kvstore.zero_reduce_scatter(
+                    vlists, padded, devs, stats))
+                shard_n = padded // n
+                per_rank = []
+                for r, ctx in enumerate(ctxs):
+                    wflat = _eng.flatten_pad(
+                        [self._params[j].data(ctx)._data for j in idxs],
+                        padded)
+                    per_rank.append(_eng.slice_flat(
+                        wflat, r * shard_n, shard_n))
+                    stats["dispatches"] += 2
+                w_shards.append(per_rank)
+        new_w_shards = []
+        with _profiler.op_scope("fused_update", cat="trainer"):
+            for c, chunk in enumerate(plan):
+                per_rank = []
+                for r in range(n):
+                    new_w = self._optimizer.zero_fused_update(
+                        (chunk,), (svals[c],), [w_shards[c][r]],
+                        [g_shards[c][r]],
+                        [self._zero_states[c][r]])[0]
+                    per_rank.append(new_w)
+                    stats["dispatches"] += 1
+                new_w_shards.append(per_rank)
+        with _profiler.op_scope("allgather", cat="trainer"):
+            for c, (_k, _s, _n_st, _dt, idxs, _total, _padded) in \
+                    enumerate(plan):
+                shapes = [tuple(self._params[j].data(ctxs[0]).shape)
+                          for j in idxs]
+                outs = self._kvstore.zero_allgather(
+                    new_w_shards[c], shapes, devs, stats)
+                for r, ctx in enumerate(ctxs):
+                    for jj, j in enumerate(idxs):
+                        self._params[j]._data[ctx]._data = outs[r][jj]
+        self._dispatches += stats["dispatches"]
+        self._buckets += stats["buckets"]
+        # params_fused double-counts per rank above; normalize to the
+        # fused path's per-step meaning (each param fused once)
+        self._params_fused = len(self._params)
+
+    # -- ZeRO-1 state management (shared by eager and whole-step) -----------
+
+    def _zero_layout_of(self, plan, world):
+        return (tuple((c[2], c[3], c[4], c[5], c[6]) for c in plan),
+                int(world))
+
+    def _ensure_zero_states(self, plan, world, rank_ctx):
+        """Allocate (or adopt from full per-param states) the shard-
+        sized optimizer state for every plan chunk on every rank in
+        ``rank_ctx`` (rank -> context).  Existing full states (an
+        unsharded restart, or a load_states_dict) are flattened, zero-
+        padded and sliced — bit-identical adoption — then released, so
+        per-replica state memory drops to ~1/world."""
+        from ..ndarray import ndarray as _nd_mod
+        from ..ndarray.ndarray import NDArray as _ND
+
+        layout = self._zero_layout_of(plan, world)
+        if self._zero_layout is not None and self._zero_layout != layout \
+                and self._zero_states:
+            raise MXNetError(
+                "ZeRO-1 shard layout changed mid-run (params, "
+                "aggregate_num, MXTPU_KVSTORE_BUCKET_MB, hyperparameter "
+                "grouping or world size changed since the shards were "
+                "allocated); snapshot with states_dict() and reload "
+                "into a fresh Trainer")
+        self._zero_layout = layout
+        for c, (_k, _s, n_states, dt, idxs, total, padded) in \
+                enumerate(plan):
+            entry = dict(self._zero_states.get(c) or {})
+            missing = [r for r in rank_ctx if r not in entry]
+            if not missing:
+                continue
+            shard_n = padded // world
+            full_slots = None
+            if n_states and any(self._states[j] for j in idxs):
+                import numpy as _np
+
+                full_slots = []
+                for slot in range(n_states):
+                    parts = []
+                    for j in idxs:
+                        st = next(iter(self._states[j].values())) \
+                            if self._states[j] else None
+                        w = self._params[j]
+                        if st is None:
+                            parts.append(_np.zeros(
+                                int(_np.prod(w.shape)), dtype=dt))
+                            continue
+                        nd_ = st if isinstance(st, _ND) else st[slot]
+                        parts.append(nd_.asnumpy().reshape(-1))
+                    flat = _np.concatenate(parts) if parts else \
+                        _np.zeros(0, dtype=dt)
+                    pad = padded - flat.shape[0]
+                    if pad:
+                        flat = _np.concatenate(
+                            [flat, _np.zeros(pad, dtype=flat.dtype)])
+                    full_slots.append(flat)
+            for r in missing:
+                ctx = rank_ctx[r]
+                slots = []
+                for slot in range(n_states):
+                    if full_slots is None:
+                        slots.append(_nd_mod.zeros(
+                            (shard_n,), dtype=dt, ctx=ctx))
+                    else:
+                        slots.append(_nd_mod.array(
+                            full_slots[slot][r * shard_n:
+                                             (r + 1) * shard_n],
+                            dtype=dt, ctx=ctx))
+                entry[r] = tuple(slots)
+            self._zero_states[c] = entry
+            for j in idxs:
+                self._states[j] = None  # release the full copies
+
+    def _unshard_zero_states(self):
+        """Inverse of the :meth:`_ensure_zero_states` adoption: gather
+        the live shard state back into canonical per-param ``_states``
+        (pure reshaping — bit-exact) and drop the shards, so an
+        unsharded update path engaging after sharded steps continues
+        the SAME optimizer trajectory instead of silently recreating
+        zeroed state.  Raises when this process does not hold every
+        rank's shards (a multi-process 'world' job cannot fall back
+        unsharded mid-run)."""
+        if not self._zero_states:
+            return
+        self._load_zero_states(
+            self._zero_snapshot(),
+            source="<live ZeRO-1 shards: an unsharded update "
+            "path engaged after sharded steps>")
+
+    def _zero_snapshot(self):
+        """The ZeRO state-snapshot dict (world / chunks / per-rank
+        shards) — the ONE builder behind both ``states_dict()`` and the
+        unshard fallback, so the layout the checkpoint path writes and
+        the layout ``_load_zero_states`` gathers can never drift."""
+        layout, world = self._zero_layout
+        return {
+            "world": world,
+            "chunks": [
+                {"indices": list(idxs), "n_states": n_states,
+                 "dtype": str(dt), "total": total, "padded": padded,
+                 "shapes": [[int(d) for d in self._params[j].shape]
+                            for j in idxs]}
+                for (n_states, dt, idxs, total, padded) in layout],
+            "shards": {r: {c: list(entry[r])
+                           for c, entry in
+                           sorted(self._zero_states.items())
+                           if r in entry}
+                       for r in sorted({rr for e in
+                                        self._zero_states.values()
+                                        for rr in e})},
+        }
+
+    def optimizer_state_bytes(self):
+        """Measured optimizer-state footprint: ``{"per_replica": max
+        bytes any one replica holds, "total": bytes across replicas}``.
+        Sharded (ZeRO-1) runs report ~1/world per replica; unsharded
+        runs report the full state on every replica."""
+        if self._zero_states:
+            per_rank = {}
+            for entry in self._zero_states.values():
+                for r, slots in entry.items():
+                    per_rank[r] = per_rank.get(r, 0) + sum(
+                        int(s._data.nbytes) for s in slots)
+            vals = list(per_rank.values()) or [0]
+            return {"per_replica": max(vals), "total": sum(vals)}
+        total = 0
+
+        def _acc(s):
+            nonlocal total
+            if s is None:
+                return
+            if isinstance(s, tuple):
+                for x in s:
+                    _acc(x)
+                return
+            total += int(s._data.nbytes)
+
+        for st in self._states:
+            for s in (st or {}).values():
+                _acc(s)
+        return {"per_replica": total, "total": total}
 
     # -- whole-step compilation (ROADMAP item 4) ----------------------------
 
@@ -180,7 +471,15 @@ class Trainer:
         compiled executable is cached per identity, so a fresh lambda
         per call retraces every step.  ``batch_size`` defaults to the
         leading dim of ``x`` and feeds ``rescale_grad`` exactly like
-        ``step()``."""
+        ``step()``.
+
+        With ``Trainer(..., zero_shard=True)`` (or
+        ``MXTPU_ZERO_SHARD=1``) the compiled step's gradient reduction
+        becomes an in-program reduce-scatter, each replica updates only
+        its 1/world flat shard (optimizer state allocated at ~1/world
+        per replica), and updated weight shards allgather back —
+        bit-identical to the unsharded compiled step (see
+        docs/performance.md, "ZeRO-1")."""
         inputs = tuple(x) if isinstance(x, (list, tuple)) else (x,)
         if batch_size is None:
             batch_size = int(inputs[0].shape[0])
@@ -205,6 +504,8 @@ class Trainer:
                 _step_stats["buckets_built"] += wstats["buckets"]
                 _step_stats["whole_step_steps"] += 1
                 _step_stats["whole_step_compiles"] += wstats["compiles"]
+                if wstats.get("zero"):
+                    _step_stats["zero_steps"] += 1
                 return loss
         return self._eager_whole_step(block, loss_fn, inputs, y,
                                       batch_size)
@@ -320,6 +621,13 @@ class Trainer:
     def _update(self, ignore_stale_grad=False):
         if self._update_on_kvstore and self._kvstore is not None:
             return  # already updated during push
+        # live ZeRO shards + an unsharded update (a bypass fallback, a
+        # direct step() on one replica, the world-mesh local rank):
+        # gather the shards back into canonical states first — the SAME
+        # trajectory continues bit-exactly instead of a silently
+        # re-zeroed momentum (multi-process raises: a lone rank cannot
+        # gather its peers' shards)
+        self._unshard_zero_states()
         scaler = getattr(self, "_amp_loss_scaler", None)
         if scaler is not None and scaler.enabled:
             # dynamic loss scaling: on non-finite grads skip the update
@@ -436,10 +744,20 @@ class Trainer:
                         dict(self._optimizer._index_update_count)}
         blob = {i: {str(c): s for c, s in (st or {}).items()}
                 for i, st in enumerate(self._states)}
-        return {"version": self.STATES_FORMAT_VERSION, "states": blob,
-                "num_update": self._optimizer.num_update,
-                "index_update_count":
-                    dict(self._optimizer._index_update_count)}
+        out = {"version": self.STATES_FORMAT_VERSION, "states": blob,
+               "num_update": self._optimizer.num_update,
+               "index_update_count":
+                   dict(self._optimizer._index_update_count)}
+        if self._zero_states:
+            # ZeRO-1: the live optimizer state is per-rank flat shards
+            # (1/world each); snapshot THEM (device-resident leaves —
+            # the async checkpoint capture/readback applies unchanged)
+            # plus the layout needed to gather them back into canonical
+            # per-param states on load.  A multi-process job holds only
+            # its own rank's shards here; CheckpointManager merges the
+            # per-rank blobs on restore.
+            out["zero"] = self._zero_snapshot()
+        return out
 
     def load_states_dict(self, blob, source="<states blob>"):
         """Inverse of ``states_dict`` (leaves may be NDArray or numpy)."""
@@ -487,6 +805,18 @@ class Trainer:
         self._optimizer.num_update = blob["num_update"]
         self._optimizer._index_update_count = dict(
             blob["index_update_count"])
+        if blob.get("zero"):
+            # sharded snapshot: gather the flat shards back into
+            # canonical per-param states (pure reshaping — bit-exact),
+            # so a sharded run restarts unsharded and vice versa; a
+            # zero_shard target re-shards lazily on its first step
+            self._load_zero_states(blob["zero"], source)
+            return
+        # an UNSHARDED snapshot supersedes any live shards too — stale
+        # shard entries would otherwise win the next _ensure_zero_states
+        # check and the loaded states would sit unused
+        self._zero_states = {}
+        self._zero_layout = None
         for i, p in enumerate(self._params):
             saved = blob["states"].get(i, {})
             if not saved:
@@ -496,6 +826,65 @@ class Trainer:
             for j, ctx in enumerate(p.list_ctx()):
                 v = vals[j] if j < len(vals) else vals[0]
                 self._states[i][ctx] = _states_from_np(v)
+
+    def _load_zero_states(self, zero, source):
+        """Gather a ZeRO-1 state snapshot (per-rank flat shards) into
+        canonical per-param optimizer states at ctx0 — the gather-on-
+        restore path: concatenate the rank shards of every chunk, drop
+        the zero pad, and unflatten along the chunk's param layout.
+        Requires every rank's shards (a multi-process restore goes
+        through CheckpointManager, which merges the per-rank blobs)."""
+        import numpy as np
+
+        from ..ndarray import ndarray as _nd_mod
+        from ..ndarray.ndarray import NDArray as _ND
+
+        world = int(zero["world"])
+        have = {int(r) for r in zero["shards"]}
+        if have != set(range(world)):
+            raise MXNetError(
+                f"{source}: ZeRO-1 optimizer-state snapshot was sharded "
+                f"across {world} rank(s) but only rank(s) "
+                f"{sorted(have)} are present in this blob — restore "
+                "through CheckpointManager, which gathers every rank's "
+                "trainer-shard<r>.states from the checkpoint directory "
+                "(see docs/checkpointing.md)")
+        shards = {int(r): v for r, v in zero["shards"].items()}
+        ctx0 = self._params[0].list_ctx()[0] if self._params else None
+        for c, chunk in enumerate(zero["chunks"]):
+            n_states = int(chunk["n_states"])
+            idxs = [int(j) for j in chunk["indices"]]
+            shapes = [tuple(int(d) for d in s) for s in chunk["shapes"]]
+            if not n_states:
+                for j in idxs:
+                    self._states[j] = None
+                continue
+            slot_fulls = []
+            for slot in range(n_states):
+                parts = []
+                for r in range(world):
+                    rank_chunks = shards[r]
+                    sh = rank_chunks[c] if c in rank_chunks \
+                        else rank_chunks[str(c)]
+                    s = sh[slot]
+                    parts.append(s.asnumpy() if isinstance(s, _ND)
+                                 else np.asarray(s))
+                slot_fulls.append(
+                    np.concatenate(parts)[:int(chunk["total"])])
+            for jj, j in enumerate(idxs):
+                off = sum(int(np.prod(s)) for s in shapes[:jj])
+                n = int(np.prod(shapes[jj]))
+                per_slot = tuple(
+                    _nd_mod.array(
+                        slot_fulls[slot][off:off + n].reshape(
+                            shapes[jj]),
+                        dtype=chunk["dtype"], ctx=ctx0)
+                    for slot in range(n_states))
+                self._states[j] = {
+                    ctx0: per_slot[0] if n_states == 1 else per_slot}
+        # any live shards are superseded by the loaded snapshot
+        self._zero_states = {}
+        self._zero_layout = None
 
     def save_states(self, fname):
         self._init_kvstore()
@@ -512,6 +901,11 @@ class Trainer:
         payload["states"] = {
             i: {c: _states_to_np(s) for c, s in st.items()}
             for i, st in payload["states"].items()}
+        if payload.get("zero"):
+            payload["zero"]["shards"] = {
+                r: {c: [s.asnumpy() for s in slots]
+                    for c, slots in chunks.items()}
+                for r, chunks in payload["zero"]["shards"].items()}
         # atomic commit: a kill mid-dump must not truncate the previous
         # good states file under the published name
         with atomic_file(fname) as tmp:

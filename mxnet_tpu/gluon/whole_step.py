@@ -89,6 +89,10 @@ class WholeStepCompiler:
         self._gstate_views = None  # [tuple(raw)] per trainer param
         self._gothers = None      # [garr] per non-trainer block param
         self._gother_views = None  # [{ctx: raw}]
+        # ZeRO path: per-chunk state globals, sharded over the replica
+        # axis (chunk pos -> [garr per slot] / [{rank: raw} per slot])
+        self._zgstates = {}
+        self._zgstate_views = {}
 
     # -- public entry -------------------------------------------------------
 
@@ -109,10 +113,17 @@ class WholeStepCompiler:
         t = self.trainer
         self._check_bypass(block)
         mesh_info = self._mesh_info()
+        # ZeRO-1 (arXiv 2004.13336) engages on any real replica mesh;
+        # with a single replica and no cross-process reduction the
+        # sharding is the identity, so the unsharded program runs
+        zero_world = None
+        if t._zero_shard and mesh_info is not None:
+            zero_world = len(list(mesh_info[0].devices.flat))
         named = block._ordered_params()
         order = self._order_params(named)
         train_block_pos, other_params, other_block_pos = order
-        self._ensure_states()
+        if zero_world is None:
+            self._ensure_states()
         ctx0 = t._params[0].list_ctx()[0]
 
         # input signature / structure key (before ticking anything)
@@ -132,16 +143,21 @@ class WholeStepCompiler:
         plan, svals, reason = t._optimizer.whole_step_plan(
             list(range(len(t._params))),
             [p.data(ctx0) for p in t._params],
-            [self._state_entry(i) for i in range(len(t._params))])
+            ([None] * len(t._params) if zero_world is not None else
+             [self._state_entry(i) for i in range(len(t._params))]),
+            zero_world=zero_world)
         if reason is not None:
             raise Bypass(reason)
+        if zero_world is not None:
+            t._ensure_zero_states(plan, zero_world,
+                                  self._zero_rank_ctx(mesh_info))
 
         skey = (id(block), id(loss_fn), plan, has_y, len(inputs),
-                self._mesh_struct_key(mesh_info))
+                self._mesh_struct_key(mesh_info), zero_world)
         fn, meta = self._closures.get(skey, (None, None))
         if fn is None:
             fn, meta = self._build_closure(block, loss_fn, plan, order,
-                                           mesh_info, has_y)
+                                           mesh_info, has_y, zero_world)
             self._closures[skey] = (fn, meta)
             self._evict_stale_closures()
 
@@ -153,7 +169,9 @@ class WholeStepCompiler:
             args = self._single_args(block, inputs, y, other_params, ctx0)
         else:
             args = self._mesh_args(block, inputs, y, other_params,
-                                   mesh_info)
+                                   mesh_info,
+                                   zero_plan=(plan if zero_world
+                                              else None))
         train_ws, sts, other_ws, xs, y_raw = args
 
         # donation twin selection + compile accounting
@@ -190,11 +208,13 @@ class WholeStepCompiler:
                                     meta, named, ctx0)
                 loss_out = loss_raw
             else:
-                loss_out = self._rebind_mesh(new_ws, new_sts, other_params,
-                                             loss_raw)
+                loss_out = self._rebind_mesh(
+                    new_ws, new_sts, other_params, loss_raw,
+                    zero=zero_world is not None)
         _engine.track(loss_out)
         stats = {"compiles": compiles,
-                 "buckets": meta.get("buckets", 0)}
+                 "buckets": meta.get("buckets", 0),
+                 "zero": zero_world is not None}
         return _wrap(loss_out), stats
 
     # Closure-cache bound: each entry pins a compiled executable (and
@@ -335,7 +355,7 @@ class WholeStepCompiler:
     # -- closure ------------------------------------------------------------
 
     def _build_closure(self, block, loss_fn, plan, order, mesh_info,
-                       has_y):
+                       has_y, zero_world=None):
         train_block_pos, _other_params, other_block_pos = order
         n_block = len(block._ordered_params())
         axis_name = mesh_info[1] if mesh_info is not None else None
@@ -367,33 +387,51 @@ class WholeStepCompiler:
             loss, vjp_fn, aux = jax.vjp(_loss, list(train_ws),
                                         has_aux=True)
             (grads,) = vjp_fn(jnp.asarray(1.0, loss.dtype))
-            if axis_name is not None:
+            if zero_world is not None:
+                # ZeRO-1: no full allreduce — the per-chunk reduce-
+                # scatter inside apply_zero_step_plan IS the gradient
+                # reduction (kvstore.traced_reduce_scatter_flat), each
+                # rank updates only its 1/world flat shard, and the
+                # updated weight shards allgather back — all inside
+                # this one program
                 loss = jax.lax.psum(loss, axis_name)
-                if kvstore is not None:
-                    grads = kvstore.traced_pushpull(grads, axis_name)
-                else:
-                    grads = _kvstore_mod.traced_bucket_allreduce(
-                        grads, axis_name)
-            new_ws, new_sts = _opt.apply_whole_step_plan(
-                plan, list(train_ws), grads,
-                [list(s) for s in sts], list(svals))
+                new_ws, new_sts = _opt.apply_zero_step_plan(
+                    plan, list(train_ws), grads,
+                    [list(s) for s in sts], list(svals),
+                    zero_world, axis_name)
+            else:
+                if axis_name is not None:
+                    loss = jax.lax.psum(loss, axis_name)
+                    if kvstore is not None:
+                        grads = kvstore.traced_pushpull(grads, axis_name)
+                    else:
+                        grads = _kvstore_mod.traced_bucket_allreduce(
+                            grads, axis_name)
+                new_ws, new_sts = _opt.apply_whole_step_plan(
+                    plan, list(train_ws), grads,
+                    [list(s) for s in sts], list(svals))
             meta.setdefault("aux_names", tuple(n for n, _ in aux))
             return (loss, tuple(new_ws),
                     tuple(tuple(s) for s in new_sts),
                     tuple(r for _, r in aux))
 
         if mesh_info is not None:
-            meta["buckets"] = self._count_buckets(plan)
+            meta["buckets"] = (len(plan) if zero_world is not None
+                               else self._count_buckets(plan))
             from ..parallel import mesh as _mesh_mod
             from jax.sharding import PartitionSpec as P
 
             mesh, axis = mesh_info
             data = P(axis)
+            # zero: optimizer-state shards ride SHARDED over the
+            # replica axis (in and out), so each device allocates only
+            # its 1/world slice — the ZeRO-1 memory contract
+            sts_spec = P(axis) if zero_world is not None else P()
             fn = _mesh_mod.shard_map()(
                 _whole_step_fn, mesh=mesh,
-                in_specs=(P(), P(), P(), P(), data,
+                in_specs=(P(), P(), sts_spec, P(), data,
                           data if has_y else P(), P()),
-                out_specs=P())
+                out_specs=(P(), P(), sts_spec, P()))
             return fn, meta
         return _whole_step_fn, meta
 
@@ -511,7 +549,7 @@ class WholeStepCompiler:
         ``jnp.asarray(v, dtype)`` applies — bit-identical scalars."""
         import jax.numpy as jnp
 
-        _kernel, _static, _n_states, dt, _idxs = chunk
+        dt = chunk[3]  # (kernel, static, n_states, dt, idxs[, total, padded])
         return jnp.asarray(np.asarray(svals, dtype=np.dtype(dt)))
 
     def _single_args(self, block, inputs, y, other_params, ctx0):
@@ -555,7 +593,21 @@ class WholeStepCompiler:
 
     # -- mesh path ----------------------------------------------------------
 
-    def _mesh_args(self, block, inputs, y, other_params, mesh_info):
+    def _zero_rank_ctx(self, mesh_info):
+        """rank -> context map for the zero-state shards: on the 'dp'
+        mesh every replica context is a local rank (in mesh order); on
+        the 'world' mesh only this process's rank is local."""
+        t = self.trainer
+        _mesh, axis = mesh_info
+        ctxs = t._params[0].list_ctx()
+        if axis == "world":
+            from ..parallel import dist as _dist
+
+            return {_dist.rank(): ctxs[0]}
+        return dict(enumerate(ctxs))
+
+    def _mesh_args(self, block, inputs, y, other_params, mesh_info,
+                   zero_plan=None):
         from ..parallel import mesh as _mesh_mod
 
         mesh, axis = mesh_info
@@ -569,6 +621,8 @@ class WholeStepCompiler:
             self._gstate_views = [None] * len(t._params)
             self._gothers = [None] * len(other_params)
             self._gother_views = [None] * len(other_params)
+            self._zgstates = {}
+            self._zgstate_views = {}
         repl = _mesh_mod.replicated(mesh)
 
         def _fresh_param(p):
@@ -583,6 +637,8 @@ class WholeStepCompiler:
             if stale:
                 self._gparams[i] = _fresh_param(p)
                 self._bind_param_views(p, i)
+            if zero_plan is not None:
+                continue  # state lives in per-chunk shard globals
             st_nds = self._state_nds(i)
             sviews = self._gstate_views[i]
             sstale = sviews is None or len(sviews) != len(st_nds) or any(
@@ -617,9 +673,71 @@ class WholeStepCompiler:
         y_raw = self._stage_sharded(y, data_sh, mesh, axis) \
             if y is not None else None
         train_ws = tuple(self._gparams)
-        sts = tuple(self._gstates)
+        sts = (self._zero_mesh_states(mesh_info, zero_plan)
+               if zero_plan is not None else tuple(self._gstates))
         other_ws = tuple(self._gothers)
         return train_ws, sts, other_ws, xs, y_raw
+
+    def _zero_mesh_states(self, mesh_info, plan):
+        """Per-chunk global state arrays for the ZeRO path: each slot is
+        ONE (padded,) array sharded over the replica axis, assembled
+        from the per-rank shard NDArrays in ``trainer._zero_states`` —
+        so every device materializes only its 1/world slice.  Cached
+        with identity-checked shard views like the param globals
+        (load_states_dict or a fresh allocation rebuilds them)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        t = self.trainer
+        mesh, axis = mesh_info
+        sh = NamedSharding(mesh, P(axis))
+        out = []
+        for c, (_k, _s, n_states, _dt, _idxs, _total, padded) in \
+                enumerate(plan):
+            entry = t._zero_states[c]
+            ranks = sorted(entry)
+            cached_views = self._zgstate_views.get(c)
+            stale = cached_views is None or len(cached_views) != \
+                n_states or any(
+                    entry[r][slot]._data is not cached_views[slot].get(r)
+                    for slot in range(n_states) for r in ranks)
+            if stale:
+                garrs, views = [], []
+                for slot in range(n_states):
+                    shards = [entry[r][slot]._data for r in ranks]
+                    garrs.append(
+                        jax.make_array_from_single_device_arrays(
+                            (padded,), sh, shards))
+                    views.append({r: entry[r][slot]._data
+                                  for r in ranks})
+                self._zgstates[c] = garrs
+                self._zgstate_views[c] = views
+            out.append(tuple(self._zgstates[c]))
+        return tuple(out)
+
+    def _rebind_zero_states(self, new_sts):
+        """Inverse of :meth:`_zero_mesh_states`: rebind every local
+        rank's shard holder to its slice of the updated global state
+        arrays (inside the donation guard, like every other rebind)."""
+        t = self.trainer
+        for c, chunk_sts in enumerate(new_sts):
+            entry = t._zero_states[c]
+            garrs, views = [], []
+            for slot, garr in enumerate(chunk_sts):
+                garr = _engine.track(garr)
+                per_dev = {s.device: s.data
+                           for s in garr.addressable_shards}
+                vmap = {}
+                for r in sorted(entry):
+                    dev = entry[r][slot].context.jax_device()
+                    data = per_dev.get(dev)
+                    if data is not None:
+                        entry[r][slot]._data = data
+                        vmap[r] = data
+                garrs.append(garr)
+                views.append(vmap)
+            self._zgstates[c] = garrs
+            self._zgstate_views[c] = views
 
     def _stage_sharded(self, v, data_sh, mesh, axis):
         import jax
@@ -663,14 +781,19 @@ class WholeStepCompiler:
             views.append(view)
         self._gstate_views[i] = tuple(views)
 
-    def _rebind_mesh(self, new_ws, new_sts, other_params, loss_raw):
+    def _rebind_mesh(self, new_ws, new_sts, other_params, loss_raw,
+                     zero=False):
         t = self.trainer
         for i, p in enumerate(t._params):
             self._gparams[i] = _engine.track(new_ws[i])
             self._bind_param_views(p, i)
+            if zero:
+                continue  # state shards rebind per chunk below
             self._gstates[i] = tuple(_engine.track(s)
                                      for s in new_sts[i])
             self._bind_state_views(i)
+        if zero:
+            self._rebind_zero_states(new_sts)
         # loss: the replicated scalar's local shard (eager-friendly
         # single-device value)
         shard = loss_raw.addressable_shards[0]

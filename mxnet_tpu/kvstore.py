@@ -250,20 +250,7 @@ class KVStore:
         # pairwise tree reduce across slots — same pair order as
         # _reduce_sum, so the per-element sum order (and therefore the
         # bits) match the sequential per-key path exactly
-        while len(flats) > 1:
-            nxt = []
-            for i in range(0, len(flats) - 1, 2):
-                a, b = flats[i], flats[i + 1]
-                dev_a = next(iter(a.devices()))
-                if next(iter(b.devices())) != dev_a:
-                    b = jax.device_put(b, dev_a)
-                    stats["dispatches"] += 1
-                nxt.append(engine.track(jnp.add(a, b)))
-                stats["dispatches"] += 1
-            if len(flats) % 2:
-                nxt.append(flats[-1])
-            flats = nxt
-        reduced = flats[0]
+        reduced = _pairwise_tree_reduce(flats, stats, jnp, engine)
         target_dev = self._store[ks[0]].context.jax_device()
         if next(iter(reduced.devices())) != target_dev:
             reduced = engine.track(jax.device_put(reduced, target_dev))
@@ -323,6 +310,67 @@ class KVStore:
                 "(compression / update_on_kvstore / dist_async); the "
                 "whole-step compiler must bypass to the eager path")
         return traced_bucket_allreduce(g_raws, axis_name)
+
+    # -- ZeRO-1 eager multi-key forms (fused-but-not-whole-step tier) ------
+
+    def zero_reduce_scatter(self, vlists, padded, devices, stats):
+        """Eager reduce-scatter of one flat bucket (ZeRO-1, arXiv
+        2004.13336): ``vlists`` is a list of per-key NDArray slot lists
+        (one slot per replica device, same dtype), packed per slot into
+        ONE zero-padded flat buffer of ``padded`` elements; each rank
+        ``r`` then receives the cross-slot sum of flat chunk ``r`` on
+        ``devices[r]``.  The per-element add order is the same pairwise
+        tree ``_reduce_bucket`` uses, so a sharded eager step stays
+        bit-identical to the unsharded eager step.  Returns one raw
+        shard buffer per rank."""
+        import jax.numpy as jnp
+
+        from . import engine
+
+        if not self._fusion_eligible() or self._is_dist():
+            raise MXNetError(
+                "zero_reduce_scatter on an ineligible kvstore "
+                "(compression / update_on_kvstore / dist); the trainer "
+                "must bypass to the unsharded path")
+        n = len(devices)
+        shard_n = int(padded) // n
+        flats = [engine.flatten_pad([v[s]._data for v in vlists], padded)
+                 for s in range(n)]
+        pieces = [engine.unflatten_array(f, [(shard_n,)] * n)
+                  for f in flats]
+        stats["dispatches"] += 2 * n
+        shards = []
+        for r, dev in enumerate(devices):
+            parts = [pieces[s][r] for s in range(n)]
+            # the shared tree keeps the exact _reduce_bucket pair
+            # order, elementwise, so bits match the unsharded reduce
+            shard = _pairwise_tree_reduce(parts, stats, jnp, engine)
+            if next(iter(shard.devices())) != dev:
+                shard = engine.track(jax.device_put(shard, dev))
+                stats["dispatches"] += 1
+            shards.append(shard)
+        stats["buckets"] += 1
+        return shards
+
+    def zero_allgather(self, shard_raws, shapes, devices, stats):
+        """Eager allgather: every rank's updated weight shard lands on
+        every device, re-concatenated and unpacked into per-tensor
+        buffers of ``shapes`` (the zero pad tail is never read).
+        Returns ``{rank: [tensor raws]}``."""
+        from . import engine
+
+        out = {}
+        for r, dev in enumerate(devices):
+            moved = []
+            for s in shard_raws:
+                if next(iter(s.devices())) != dev:
+                    s = engine.track(jax.device_put(s, dev))
+                    stats["dispatches"] += 1
+                moved.append(s)
+            flat = engine.flatten_arrays(moved)
+            out[r] = engine.unflatten_array(flat, shapes)
+            stats["dispatches"] += 2
+        return out
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the requested rows (ref: KVStoreLocal::PullRowSparse).
@@ -480,6 +528,30 @@ class KVStore:
             self._updater.set_states(f.read())
 
 
+def _pairwise_tree_reduce(parts, stats, jnp, engine):
+    """Pairwise tree reduce over device slots IN SLOT ORDER — the ONE
+    definition of the eager reduction order.  Both the unsharded
+    flat-bucket allreduce (``_reduce_bucket``) and the ZeRO-1 eager
+    reduce-scatter (``zero_reduce_scatter``) run THIS loop, so their
+    per-element sum order (and therefore sharded/unsharded bit parity)
+    can never drift apart.  Operands are moved to the left operand's
+    device; every transfer and add is booked in ``stats``."""
+    while len(parts) > 1:
+        nxt = []
+        for i in range(0, len(parts) - 1, 2):
+            a, b = parts[i], parts[i + 1]
+            dev_a = next(iter(a.devices()))
+            if next(iter(b.devices())) != dev_a:
+                b = jax.device_put(b, dev_a)
+                stats["dispatches"] += 1
+            nxt.append(engine.track(jnp.add(a, b)))
+            stats["dispatches"] += 1
+        if len(parts) % 2:
+            nxt.append(parts[-1])
+        parts = nxt
+    return parts[0]
+
+
 def _key_index(k):
     try:
         return int(k)
@@ -604,6 +676,123 @@ def _psum_bucket(bucket, axis_name, engine):
     flat = engine._k_flatten(list(bucket))
     red = jax.lax.psum(flat, axis_name)
     return list(engine._k_unflatten(red, shapes=tuple(shapes)))
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1 traced collectives (arXiv 2004.13336 "Automatic Cross-Replica
+# Sharding of Weight Update in Data-Parallel Training"): the allreduce
+# above rewritten as reduce-scatter (each rank receives the sum of ONE
+# 1/world slice of the flat bucket) + allgather (updated slices
+# broadcast back) — equal collective bandwidth, but the optimizer
+# update and its state now touch only shard-sized buffers.  The
+# portable psum_scatter/all_gather idioms follow arXiv 2112.01075.
+
+
+def zero_padded_size(total, world):
+    """Flat-bucket element count rounded up to a multiple of ``world``
+    so every rank's shard is equal-sized.  The padding is part of the
+    bucket fingerprint (plan tuples / closure keys carry it), so two
+    layouts that differ only in pad never share an executable."""
+    world = max(int(world), 1)
+    return ((int(total) + world - 1) // world) * world
+
+
+def traced_reduce_scatter_flat(ts, padded, axis_name):
+    """ONE in-program collective: pack ``ts`` (same dtype) into a flat
+    buffer zero-padded to ``padded`` elements and ``lax.psum_scatter``
+    it over ``axis_name`` — this rank's equal-sized shard of the
+    cross-replica sum.  Bit-identical per element to ``lax.psum`` of
+    the same flat bucket (same reduction order over the axis)."""
+    from . import engine
+
+    flat = engine._k_flatten_pad(list(ts), padded=int(padded))
+    return jax.lax.psum_scatter(flat, axis_name, scatter_dimension=0,
+                                tiled=True)
+
+
+def traced_shard_slice(ts, padded, world, axis_name):
+    """This rank's shard of the flat concatenation of ``ts`` (the
+    weight-side twin of :func:`traced_reduce_scatter_flat`: weights are
+    replicated, so the shard is a local dynamic slice at
+    ``axis_index``, no collective)."""
+    from . import engine
+
+    flat = engine._k_flatten_pad(list(ts), padded=int(padded))
+    shard_n = int(padded) // int(world)
+    r = jax.lax.axis_index(axis_name)
+    return jax.lax.dynamic_slice(flat, (r * shard_n,), (shard_n,))
+
+
+def traced_allgather_flat(shard, shapes, axis_name):
+    """ONE in-program collective: gather every rank's shard back into
+    the full flat bucket and unpack into per-tensor views of
+    ``shapes`` (the zero-pad tail is never read)."""
+    from . import engine
+
+    full = jax.lax.all_gather(shard, axis_name, axis=0, tiled=True)
+    return list(engine._k_unflatten(
+        full, shapes=tuple(tuple(int(d) for d in s) for s in shapes)))
+
+
+def traced_bucket_reduce_scatter(g_raws, axis_name, world):
+    """In-program ZeRO twin of :func:`traced_bucket_allreduce`: pack
+    same-dtype gradients into size-capped flat buckets
+    (``MXTPU_KVSTORE_BUCKET_MB``, the same knob), pad each bucket to a
+    multiple of ``world`` (padding rides in the returned meta — the
+    bucket fingerprint), one ``lax.psum_scatter`` per bucket.  Returns
+    ``(shards, metas)`` with ``metas[i] = (positions, shapes, total,
+    padded)`` mapping bucket ``i`` back to the input order; feed the
+    updated shards to :func:`traced_bucket_allgather` to recover
+    per-tensor arrays."""
+    from .base import getenv
+
+    cap = max(int(getenv("KVSTORE_BUCKET_MB", 32.0, float) * (1 << 20)), 1)
+    groups = {}
+    for pos, g in enumerate(g_raws):
+        groups.setdefault(str(g.dtype), []).append((pos, g))
+    shards, metas = [], []
+    for members in groups.values():
+        bucket, size = [], 0
+        for pos, g in members:
+            nbytes = g.size * g.dtype.itemsize
+            if bucket and size + nbytes > cap:
+                shards.append(_scatter_bucket(bucket, axis_name, world,
+                                              metas))
+                bucket, size = [], 0
+            bucket.append((pos, g))
+            size += nbytes
+        if bucket:
+            shards.append(_scatter_bucket(bucket, axis_name, world,
+                                          metas))
+    return shards, metas
+
+
+def _scatter_bucket(bucket, axis_name, world, metas):
+    positions = tuple(p for p, _g in bucket)
+    shapes = tuple(tuple(int(d) for d in g.shape) for _p, g in bucket)
+    total = sum(int(g.size) for _p, g in bucket)
+    padded = zero_padded_size(total, world)
+    metas.append((positions, shapes, total, padded))
+    return traced_reduce_scatter_flat([g for _p, g in bucket], padded,
+                                      axis_name)
+
+
+def traced_bucket_allgather(shards, metas, axis_name):
+    """Inverse of :func:`traced_bucket_reduce_scatter`: one
+    ``lax.all_gather`` per bucket, results returned in the original
+    input order."""
+    out = {}
+    for shard, (positions, shapes, _total, _padded) in zip(shards, metas):
+        for pos, arr in zip(positions,
+                            traced_allgather_flat(shard, shapes,
+                                                  axis_name)):
+            out[pos] = arr
+    return [out[i] for i in range(len(out))]
+
+
+# the issue-facing alias: "allgather" pairs with "reduce_scatter" in
+# the public companion API
+traced_allgather = traced_bucket_allgather
 
 
 # ---------------------------------------------------------------------------

@@ -606,7 +606,7 @@ class Optimizer:
 
     # -- whole-step (traced) path -------------------------------------------
 
-    def whole_step_plan(self, indices, weights, states):
+    def whole_step_plan(self, indices, weights, states, zero_world=None):
         """Host-side grouping for the TRACED whole-step update: the same
         (kernel, dtype, static-attrs, scalar-values) grouping and
         ``aggregate_num`` chunking that ``fused_update`` dispatches,
@@ -620,6 +620,18 @@ class Optimizer:
         the per-chunk traced-scalar value tuples — or ``(None, None,
         reason)`` when any param has no fused form (those
         configurations bypass to the eager paths).
+
+        With ``zero_world=N`` the plan is the ZeRO-1 sharded form (arXiv
+        2004.13336): chunks are additionally capped by the flat-bucket
+        byte budget (``MXTPU_KVSTORE_BUCKET_MB`` — each chunk is ONE
+        reduce-scatter bucket) and carry ``(…, idxs, total, padded)``
+        where ``padded`` is the flat element count rounded up to a
+        multiple of ``N`` (the padding is part of the chunk fingerprint,
+        so uneven buckets never share state layout or executables with
+        even ones).  Optimizer state for a zero plan is allocated
+        shard-sized (``padded / N`` per rank) by the caller; the
+        per-param state-layout validation is therefore skipped — the
+        shards are created to match the plan.
 
         Validation runs BEFORE any step-count tick, so a bypassed plan
         has no side effects; a successful plan ticks ``_update_count``
@@ -641,7 +653,8 @@ class Optimizer:
                     "multi-precision fp16 master-weight params"
             if not np.issubdtype(np.dtype(w.dtype), np.floating):
                 return None, None, f"non-float param {i} ({w.dtype})"
-            if (len(sts) != spec[1]
+            if zero_world is None and (
+                    len(sts) != spec[1]
                     or any(s is None or s.dtype != w.dtype
                            or s.shape != w.shape for s in sts)):
                 return None, None, (
@@ -651,7 +664,7 @@ class Optimizer:
         groups = {}
         for pos, ((i, w, _st), (spec, sts)) in enumerate(zip(entries,
                                                              specs)):
-            kernel, _, scalar_names, static = spec
+            kernel, n_states, scalar_names, static = spec
             # tick BEFORE reading lr/t, exactly like fused_update
             self._update_count(i)
             t = self._index_update_count[i]
@@ -659,9 +672,37 @@ class Optimizer:
                 self._get_lr(i) if n == "lr" else float(t)
                 for n in scalar_names
             ) + (self._get_wd(i), float(self.rescale_grad))
-            key = (kernel, str(w.dtype), static, svals, len(sts))
+            key = (kernel, str(w.dtype), static, svals, n_states)
             groups.setdefault(key, []).append(pos)
         agg = max(1, int(self.aggregate_num))
+        if zero_world is not None:
+            from .base import getenv
+            from .kvstore import zero_padded_size
+
+            cap = max(int(getenv("KVSTORE_BUCKET_MB", 32.0, float)
+                          * (1 << 20)), 1)
+            plan, svals_out = [], []
+            for (kernel, dt, static, svals, n_states), members in \
+                    groups.items():
+                itemsize = np.dtype(dt).itemsize
+                chunk, size = [], 0
+                for pos in members:
+                    nbytes = int(entries[pos][1].size) * itemsize
+                    if chunk and (len(chunk) >= agg
+                                  or size + nbytes > cap):
+                        plan.append(self._zero_chunk(
+                            kernel, static, n_states, dt, chunk,
+                            entries, zero_world, zero_padded_size))
+                        svals_out.append(svals)
+                        chunk, size = [], 0
+                    chunk.append(pos)
+                    size += nbytes
+                if chunk:
+                    plan.append(self._zero_chunk(
+                        kernel, static, n_states, dt, chunk, entries,
+                        zero_world, zero_padded_size))
+                    svals_out.append(svals)
+            return tuple(plan), svals_out, None
         plan, svals_out = [], []
         for (kernel, dt, static, svals, n_states), members in \
                 groups.items():
@@ -670,6 +711,42 @@ class Optimizer:
                              tuple(members[c0:c0 + agg])))
                 svals_out.append(svals)
         return tuple(plan), svals_out, None
+
+    @staticmethod
+    def _zero_chunk(kernel, static, n_states, dt, chunk, entries,
+                    world, zero_padded_size):
+        total = sum(int(entries[pos][1].size) for pos in chunk)
+        return (kernel, static, n_states, dt, tuple(chunk), total,
+                zero_padded_size(total, world))
+
+    def zero_fused_update(self, plan, svals, w_shards, g_shards,
+                          st_shards):
+        """ZeRO-1 eager update: run each plan chunk's ``_fk_*`` kernel
+        over ONE shard-sized flat buffer — this rank's weight shard,
+        reduce-scattered grad shard, and shard-sized optimizer state —
+        through the same ``_multi_wrapper`` jitted body ``fused_update``
+        dispatches (update math keeps one source).  ``w_shards`` /
+        ``g_shards`` are raw ``(shard_n,)`` buffers per chunk;
+        ``st_shards[c]`` is the chunk's tuple of state-shard NDArrays
+        (rebound in place).  Returns the new weight-shard raws."""
+        from . import engine
+        from ._imperative import count_dispatch, get_jitted
+
+        new_w_shards = []
+        for (kernel, static, _n_states, dt, _idxs, _total, _padded), \
+                sv, w, g, sts in zip(plan, svals, w_shards, g_shards,
+                                     st_shards):
+            scalars = [jnp.asarray(v, np.dtype(dt)) for v in sv]
+            jitted = get_jitted(_multi_wrapper(kernel),
+                                {"static": static})
+            count_dispatch()
+            new_ws, new_cols = jitted([w], [g],
+                                      [[s._data] for s in sts],
+                                      scalars)
+            new_w_shards.append(engine.track(new_ws[0]))
+            for slot, st_nd in enumerate(sts):
+                st_nd._data = engine.track(new_cols[slot][0])
+        return new_w_shards
 
     @staticmethod
     def _scalar(v, like):
@@ -701,6 +778,43 @@ def apply_whole_step_plan(plan, w_raws, g_raws, st_raws, sval_raws):
             for slot in range(n_states):
                 new_sts[j][slot] = outs_cols[slot][jj]
     return new_ws, [tuple(st) for st in new_sts]
+
+
+def apply_zero_step_plan(plan, w_raws, g_raws, st_shard_raws, sval_raws,
+                         world, axis_name):
+    """Pure/traced ZeRO-1 twin of :func:`apply_whole_step_plan` (arXiv
+    2004.13336): for every chunk of a ``whole_step_plan(...,
+    zero_world=world)`` plan, reduce-scatter the chunk's gradients into
+    this rank's flat shard (``kvstore.traced_reduce_scatter_flat`` —
+    one in-program collective per chunk, zero-padded to ``padded``),
+    run the chunk's ``_fk_*`` kernel over the shard-sized weight/grad/
+    state buffers only, then allgather the updated weight shards back
+    into full per-tensor arrays (``kvstore.traced_allgather_flat``).
+    ``st_shard_raws[c]`` holds the chunk's ``(shard_n,)`` state buffers
+    (sharded over ``axis_name`` — 1/world optimizer state per rank).
+    Bit-identical to :func:`apply_whole_step_plan` after a psum of the
+    same grads: psum_scatter shares psum's per-element reduction order
+    and every kernel op is elementwise on the flat bucket."""
+    from . import kvstore as _kv
+
+    new_ws = list(w_raws)
+    new_sts = []
+    for (kernel, static, n_states, _dt, idxs, _total, padded), sv, sts \
+            in zip(plan, sval_raws, st_shard_raws):
+        gs = [g_raws[j] for j in idxs]
+        shapes = tuple(tuple(int(d) for d in g.shape) for g in gs)
+        gshard = _kv.traced_reduce_scatter_flat(gs, padded, axis_name)
+        wshard = _kv.traced_shard_slice([w_raws[j] for j in idxs],
+                                        padded, world, axis_name)
+        scalars = [sv[k] for k in range(int(sv.shape[0]))]
+        outs = kernel(wshard, gshard, *sts, *scalars, **dict(static))
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        full_ws = _kv.traced_allgather_flat(outs[0], shapes, axis_name)
+        for jj, j in enumerate(idxs):
+            new_ws[j] = full_ws[jj]
+        new_sts.append(tuple(outs[1:1 + n_states]))
+    return new_ws, new_sts
 
 
 @register("sgd")

@@ -367,7 +367,9 @@ register_section("trainerStep", _trainer_step_counters, _rows_table(
      ("dispatches per step", "dispatches_per_step"),
      ("whole-step compiled steps", "whole_step_steps"),
      ("whole-step compiles", "whole_step_compiles"),
-     ("whole-step fallbacks", "whole_step_fallbacks"))))
+     ("whole-step fallbacks", "whole_step_fallbacks"),
+     ("zero-sharded steps", "zero_steps"),
+     ("zero-shard fallbacks", "zero_fallbacks"))))
 register_section("dataPipeline", _data_pipeline_counters, _rows_table(
     "Data Pipeline",
     (("batches delivered", "batches"),

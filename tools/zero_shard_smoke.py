@@ -1,0 +1,135 @@
+"""ZeRO-1 sharding gate for `make verify` (docs/performance.md).
+
+On the virtual 8-device replica mesh: 50 post-warmup SHARDED whole
+steps under a decaying LR schedule must execute as ONE counted device
+dispatch each with ZERO post-warmup XLA compiles, the sharded path must
+actually engage (zero_steps == steps, zero fallbacks), a 5-step sharded
+vs unsharded whole-step A/B must leave BIT-identical weights, and the
+measured per-replica optimizer-state bytes must come in under HALF the
+unsharded footprint (the 1/world_size memory contract, padding
+included).  Runs on the CPU backend so the gate is deterministic and
+fast on any host.
+"""
+import os
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+# the gate A/Bs sharded vs unsharded — exported knobs would collapse
+# or skew the arms
+for _var in ("MXNET_OPTIMIZER_AGGREGATION_SIZE",
+             "MXTPU_OPTIMIZER_AGGREGATION_SIZE",
+             "MXTPU_WHOLE_STEP", "MXNET_WHOLE_STEP",
+             "MXTPU_ZERO_SHARD", "MXNET_ZERO_SHARD",
+             "MXTPU_KVSTORE_BUCKET_MB", "MXNET_KVSTORE_BUCKET_MB"):
+    os.environ.pop(_var, None)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # XLA_FLAGS above already provides the 8-device mesh
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import _imperative, gluon, lr_scheduler, nd  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+from mxnet_tpu.gluon import trainer as trainer_mod  # noqa: E402
+
+N_LAYERS, UNITS, WARMUP, STEPS, WORLD = 6, 13, 5, 50, 8
+CTXS = [mx.xla(i) for i in range(WORLD)]
+
+
+def loss_fn(out, y):
+    return (out - y) ** 2
+
+
+def build(zero):
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    for _ in range(N_LAYERS):
+        # 13 units: bucket sizes are NOT multiples of the 8-rank
+        # world, so every chunk exercises the zero-pad path; tanh
+        # keeps the stack bounded for the array_equal parity gate
+        net.add(nn.Dense(UNITS, in_units=UNITS, activation="tanh"))
+    net.initialize(mx.init.Xavier(), ctx=CTXS)
+    kwargs = {"learning_rate": 0.1, "momentum": 0.9,
+              "lr_scheduler": lr_scheduler.FactorScheduler(
+                  step=5, factor=0.95, base_lr=0.1)}
+    trainer = gluon.Trainer(net.collect_params(), "sgd", kwargs,
+                            whole_step=True, zero_shard=zero)
+    x = np.random.rand(8, UNITS).astype(np.float32)
+    y = np.random.rand(8, UNITS).astype(np.float32)
+    return net, trainer, x, y
+
+
+def main():
+    net, trainer, x, y = build(True)
+    for _ in range(WARMUP):
+        trainer.whole_step(net, loss_fn, x, y)
+    nd.waitall()
+    lr0 = trainer.learning_rate
+    trainer_mod.reset_trainer_step_stats()
+    c0 = _imperative.compiled_executable_count()
+    d0 = _imperative.device_dispatch_count()
+    for _ in range(STEPS):
+        trainer.whole_step(net, loss_fn, x, y)
+    nd.waitall()
+    compiles = _imperative.compiled_executable_count() - c0
+    dispatches = _imperative.device_dispatch_count() - d0
+    stats = trainer_mod.trainer_step_stats()
+    assert compiles == 0, \
+        f"sharded whole step recompiled: {compiles} new executables " \
+        f"in {STEPS} post-warmup steps (lr schedule must ride as a " \
+        "traced scalar)"
+    assert dispatches == STEPS, \
+        f"{dispatches} device dispatches for {STEPS} sharded whole " \
+        "steps — eager work is leaking into the compiled step loop"
+    assert stats["zero_steps"] == STEPS and \
+        stats["zero_fallbacks"] == 0, \
+        f"ZeRO-1 path did not engage: {stats}"
+    assert stats["whole_step_steps"] == STEPS and \
+        stats["whole_step_compiles"] == 0, \
+        f"whole-step signature churn post-warmup: {stats}"
+    assert trainer.learning_rate < lr0, \
+        f"LR schedule did not decay ({lr0} -> {trainer.learning_rate})"
+
+    # 5-step bit parity + state-bytes contract vs the unsharded
+    # whole-step arm on the SAME mesh
+    net_u, tr_u, x_u, y_u = build(False)
+    net_z, tr_z, x_z, y_z = build(True)
+    for _ in range(5):
+        tr_u.whole_step(net_u, loss_fn, x_u, y_u)
+        tr_z.whole_step(net_z, loss_fn, x_z, y_z)
+    for (na, a), (nb, b) in zip(
+            net_u.collect_params().items(),
+            net_z.collect_params().items()):
+        if not np.array_equal(a.data(CTXS[0]).asnumpy(),
+                              b.data(CTXS[0]).asnumpy()):
+            raise AssertionError(
+                f"sharded/unsharded weight divergence at {na}")
+    full = tr_u.optimizer_state_bytes()["per_replica"]
+    shard = tr_z.optimizer_state_bytes()["per_replica"]
+    assert full > 0 and shard < full / 2, \
+        f"per-replica optimizer state did not shrink: {shard} vs " \
+        f"{full} unsharded (world {WORLD})"
+
+    print(f"ZERO_SHARD_SMOKE_OK steps={STEPS} "
+          f"post_warmup_compiles={compiles} "
+          f"dispatches_per_step={dispatches / STEPS:.2f} "
+          f"zero_steps={stats['zero_steps']} "
+          f"state_bytes_per_replica={shard} (unsharded {full}, "
+          f"world {WORLD}) lr {lr0:.4f}->{trainer.learning_rate:.4f}")
+
+
+if __name__ == "__main__":
+    main()
