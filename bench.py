@@ -451,6 +451,99 @@ def _leaf_serve(platform):
     }))
 
 
+def _leaf_serve_decode(platform):
+    """Continuous-batching decode A/B (mxnet_tpu.serve.DecodeServer):
+    the same staggered request stream decoded twice through the same
+    warmed slot arena — token-level admission (``continuous``) vs
+    whole-batch admission (``batch``, every sequence waits for the
+    batch's straggler).  Both arms run the SAME single fixed-shape step
+    executable, so the delta is pure scheduling: continuous keeps the
+    arena full, whole-batch decays to the straggler.  Records tokens/s
+    per arm, p50/p99 TTFT and per-token latency, slot occupancy, the
+    zero-post-warmup-compile counter, and the honest dispatch
+    accounting."""
+    _leaf_setup(platform)
+    if platform == "cpu":
+        n_requests, slots = 50, 8
+    else:
+        n_requests, slots = 150, 16
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import _imperative, serve
+
+    mx.random.seed(0)
+    model = serve.TinyDecoder(vocab=256, embed=64)
+    model.initialize(mx.init.Xavier())
+    lengths = (4, 8, 16)
+    spec = serve.BucketSpec(batch_sizes=(1, 2, 4, 8),
+                            example_shape=(None,), lengths=lengths,
+                            dtype="int32")
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, 256, size=int(rng.randint(2, 17)))
+               .astype(np.int32) for _ in range(n_requests)]
+    # heavy-tailed budgets — the realistic serving shape and the exact
+    # scenario continuous batching exists for: most generations are
+    # short, a few are long, and under whole-batch scheduling every
+    # batch runs to its longest member
+    budgets = [int(rng.randint(48, 73)) if rng.rand() < 0.25
+               else int(rng.randint(4, 13)) for _ in range(n_requests)]
+
+    def run(admission):
+        srv = serve.DecodeServer(model, spec, max_slots=slots,
+                                 max_len=96,
+                                 max_queue=n_requests + 8,
+                                 admission=admission)
+        srv.start()
+        d0 = _imperative.device_dispatch_count()
+        t0 = time.perf_counter()
+        handles = []
+        for i, (p, m) in enumerate(zip(prompts, budgets)):
+            handles.append(srv.submit(p, max_new_tokens=m))
+            if i % 4 == 0:
+                time.sleep(0.0005)      # staggered offered load
+        for h in handles:
+            h.result(timeout=600)
+        dt = time.perf_counter() - t0
+        srv.drain()
+        s = srv.stats()
+        d1 = _imperative.device_dispatch_count()
+        assert s["served"] == n_requests
+        return {
+            "tokens_per_sec": round(s["tokens"] / dt, 2),
+            "tokens": s["tokens"],
+            "decode_steps": s["decode_steps"],
+            "slot_occupancy": s["slots"]["occupancy"],
+            "ttft_p50_ms": s["ttft"]["p50_ms"],
+            "ttft_p99_ms": s["ttft"]["p99_ms"],
+            "token_p50_ms": s["token_latency"]["p50_ms"],
+            "token_p99_ms": s["token_latency"]["p99_ms"],
+            "post_warmup_compiles": s["graph"]["post_warmup_compiles"],
+            "dispatch_accounting_exact": bool(
+                d1 - d0 == s["decode_steps"] + s["batches"]),
+        }
+
+    cont = run("continuous")
+    whole = run("batch")
+    import jax
+
+    dev = jax.devices()[0]
+    print(json.dumps({
+        "metric": "serve_decode_throughput",
+        "value": cont["tokens_per_sec"],
+        "unit": "tokens/sec",
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "n_requests": n_requests,
+        "max_slots": slots,
+        "continuous": cont,
+        "whole_batch": whole,
+        "speedup_vs_whole_batch": round(
+            cont["tokens_per_sec"] / whole["tokens_per_sec"], 4),
+    }))
+
+
 def _leaf_trainer_step(platform):
     """Full-training-step three-arm A/B (gluon.Trainer.whole_step):
     sequential (aggregate_num=1) / fused (the PR-3 default) /
@@ -866,7 +959,8 @@ def _leaf_recovery(platform):
 
 
 _LEAVES = {"resnet": _leaf_resnet, "bert": _leaf_bert,
-           "serve": _leaf_serve, "trainer_step": _leaf_trainer_step,
+           "serve": _leaf_serve, "serve_decode": _leaf_serve_decode,
+           "trainer_step": _leaf_trainer_step,
            "input_pipeline": _leaf_input_pipeline,
            "recovery": _leaf_recovery}
 
@@ -1031,8 +1125,8 @@ def main():
     # serve/trainer_step/input_pipeline/recovery last: their records
     # are satellites of the two north-star workloads and must never
     # delay or demote them
-    for model in ("bert", "resnet", "serve", "trainer_step",
-                  "input_pipeline", "recovery"):
+    for model in ("bert", "resnet", "serve", "serve_decode",
+                  "trainer_step", "input_pipeline", "recovery"):
         rec, tpu_ok = _measure(model, tpu_ok, note)
         if rec is not None:
             records[model] = rec

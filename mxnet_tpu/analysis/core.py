@@ -110,7 +110,7 @@ class AnalysisConfig:
     # ZeRO-1 sharded update it lowers into — host syncs anywhere inside
     # any of them are lint errors (MXA201)
     traced_names: tuple = ("_cached_graph_fn", "_whole_step_fn",
-                           "apply_zero_step_plan")
+                           "apply_zero_step_plan", "_step_graph_fn")
     getenv_fns: tuple = ("getenv",)
     fault_point_fns: tuple = ("fault_point",)
     # telemetry catalog (MXA403/MXA405): how sections register, which

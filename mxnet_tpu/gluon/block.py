@@ -430,6 +430,108 @@ class CachedOp:
         return jax.tree_util.tree_unflatten(treedef, list(outs))
 
 
+class CachedStepOp:
+    """Compile a block's forward as a fixed-shape, state-carrying step
+    executable — the continuous-batching decode hot path
+    (serve.DecodeServer).
+
+    Differences from :class:`CachedOp`:
+
+    - callers pass and receive RAW jax buffers (no NDArray wrap/unwrap
+      on the per-token path — the caller owns the arena and replaces
+      its buffers with the outputs every call);
+    - ``donate_inputs`` names input positions (indices into the
+      forward's argument list) whose buffers are DONATED to XLA, so the
+      carried state (KV-cache arenas) is updated in place instead of
+      allocating a second copy of the cache per token;
+    - the forward must return a FLAT tuple/list of NDArrays (the caller
+      knows the structure; there is no treedef round-trip);
+    - every call books exactly one device dispatch on the honest
+      ``_imperative`` counter, exactly like ``invoke()``.
+
+    Compile/reuse accounting rides the same global ``cached_graph_stats``
+    the serving tier's zero-post-warmup-compile gates read.
+    """
+
+    def __init__(self, block, donate_inputs=()):
+        self.block = block
+        self._donate = tuple(sorted(int(i) for i in donate_inputs))
+        self._fn = None
+        self._params = None      # ordered Parameter list, cached: the
+        # per-token path must not re-walk the block tree every call
+        self._seen_sigs = set()
+        self.stats = {"compiles": 0, "reuses": 0}
+
+    def release(self):
+        """Evict this op's compiled executables from the global caches."""
+        from .. import _imperative
+
+        if self._fn is not None:
+            _imperative.evict(self._fn)
+        self._fn = None
+        self._seen_sigs.clear()
+
+    def __del__(self):
+        try:
+            self.release()
+        except Exception:
+            pass
+
+    def _build_fn(self):
+        block = self.block
+
+        def _step_graph_fn(key, *arrays, _n_params):
+            out, _aux = traced_apply(block, arrays[:_n_params],
+                                     arrays[_n_params:], key, train=False)
+            outs = list(out) if isinstance(out, (list, tuple)) else [out]
+            if not all(isinstance(o, NDArray) for o in outs):
+                raise MXNetError(
+                    "a CachedStepOp forward must return a flat "
+                    "tuple/list of NDArrays")
+            return tuple(o._data for o in outs)
+
+        return _step_graph_fn
+
+    def __call__(self, *input_raws):
+        """Run one step on raw buffers; returns the flat raw-output
+        tuple.  Parameters are fetched live (``p.data()``) each call, so
+        a hot weight reload lands on the next step with no recompile."""
+        from .. import _imperative
+
+        if self._fn is None:
+            self._fn = self._build_fn()
+        if self._params is None:
+            self._params = [p for _, p in self.block._ordered_params()]
+        param_raws = [p.data()._data for p in self._params]
+        n = len(param_raws)
+        sig = tuple(
+            (tuple(r.shape), str(r.dtype)) if hasattr(r, "shape")
+            else repr(r) for r in input_raws)
+        with _graph_stats_lock:
+            fresh = sig not in self._seen_sigs
+            if fresh:
+                self._seen_sigs.add(sig)
+                self.stats["compiles"] += 1
+                _graph_stats["compiles"] += 1
+            else:
+                self.stats["reuses"] += 1
+                _graph_stats["reuses"] += 1
+        # +1 for the leading rng key arg of the graph fn
+        donate = tuple(1 + n + i for i in self._donate) or None
+        jitted = _imperative.get_jitted(self._fn, {"_n_params": n},
+                                        donate_argnums=donate)
+        _imperative.count_dispatch()
+        if fresh:
+            from .. import profiler
+
+            with profiler.op_scope(f"cached_op.compile.{self.block.name}",
+                                   cat="cached_op"):
+                outs = jitted(_random.next_key(), *param_raws, *input_raws)
+        else:
+            outs = jitted(_random.next_key(), *param_raws, *input_raws)
+        return outs if isinstance(outs, tuple) else (outs,)
+
+
 class HybridBlock(Block):
     """Block that can be hybridized into one compiled XLA computation
     (ref: gluon.HybridBlock)."""

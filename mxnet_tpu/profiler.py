@@ -258,6 +258,23 @@ def _resilience_counters(reset=False):
     return stats
 
 
+def _decode_serve_counters(reset=False):
+    """Continuous-batching decode counters (token steps, tokens,
+    prefill batches, admissions, finishes, deadline expiries, slot
+    occupancy) — window-scoped under reset=True exactly like every
+    other section; only present when the decode serving tier is
+    loaded."""
+    import sys
+
+    dec = sys.modules.get(__package__ + ".serve.decode")
+    if dec is None:
+        return None
+    stats = dec.decode_serve_stats()
+    if reset:
+        dec.reset_decode_serve_stats()
+    return stats
+
+
 def _telemetry_counters(reset=False):
     """Telemetry-subsystem counters (spans/instants/requests recorded,
     drops, flight dumps, scrapes, aggregations) — window-scoped under
@@ -379,6 +396,15 @@ register_section("dataPipeline", _data_pipeline_counters, _rows_table(
      ("prefetch hits", "prefetch_hits"),
      ("prefetch misses", "prefetch_misses"))))
 register_section("resilience", _resilience_counters, _resilience_table)
+register_section("decodeServe", _decode_serve_counters, _rows_table(
+    "Decode Serving (continuous batching)",
+    (("decode steps", "steps"),
+     ("tokens generated", "tokens"),
+     ("prefill batches", "prefill_batches"),
+     ("requests admitted", "admitted"),
+     ("requests finished", "finished"),
+     ("deadline expiries", "expired_deadlines"),
+     ("slot occupancy (mean live/max)", "slot_occupancy"))))
 register_section("telemetry", _telemetry_counters, _rows_table(
     "Telemetry (tracer / flight recorder / metrics)",
     (("spans recorded", "spans"),

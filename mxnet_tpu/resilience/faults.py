@@ -21,6 +21,7 @@ Fault-point catalog (site -> where it fires -> ctx keys):
 ``engine.d2h``            checkpoint d2h readback                —
 ``checkpoint.commit``     after shard writes, pre-manifest       ``dir, step``
 ``pipeline.map``          ``MapStage`` worker, before the fn     —
+``serve.decode``          ``DecodeServer`` token loop, pre-step  ``step, live``
 ========================  =====================================  ==========
 
 Actions:

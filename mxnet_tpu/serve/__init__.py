@@ -16,5 +16,6 @@ backpressure semantics, and the hot-reload workflow::
 from .batcher import (Batcher, DeadlineExceededError,  # noqa: F401
                       ServerClosedError, ServerOverloadedError)
 from .buckets import BucketOverflowError, BucketSpec  # noqa: F401
+from .decode import DecodeHandle, DecodeServer, TinyDecoder  # noqa: F401
 from .server import ModelServer  # noqa: F401
 from .stats import LatencyWindow, ServerStats  # noqa: F401

@@ -1,0 +1,916 @@
+"""Continuous batching: token-level decode scheduling over a slot arena.
+
+``ModelServer`` schedules at whole-batch granularity — fine for
+one-shot forwards, hostile to autoregressive decode, where one long
+sequence holds every co-batched request hostage until it finishes.
+:class:`DecodeServer` schedules at TOKEN granularity instead
+(iteration-level scheduling, the vLLM/Orca idea) while keeping the
+serve tier's closed-compile-surface discipline:
+
+- The decode state is a fixed-capacity **slot arena**: per-model
+  KV-cache buffers of shape ``(max_slots, max_len, ...)`` plus host
+  cursors, last-token ids, and an active mask.  The per-token step is
+  ONE pre-warmed executable (fixed shapes; cache buffers donated across
+  iterations on accelerator backends; inactive slots masked), no matter
+  how many requests are live — steady traffic does zero XLA compiles.
+- New requests are **admitted between tokens** into free slots: the
+  group's prompts run through the AOT-warmed prefill :class:`BucketSpec`
+  grid with the slot-scatter FUSED into the same executable — ONE
+  device dispatch per admission group, however many requests it admits.
+  Finished, expired, and cancelled requests free their slot at the next
+  token boundary instead of waiting for batch stragglers.
+- The serve substrate is reused end to end: the bounded
+  :class:`~.batcher.Batcher` admission queue with
+  ``ServerOverloadedError`` backpressure (slot exhaustion queues, queue
+  exhaustion rejects), per-request deadlines checked at token
+  boundaries, graceful drain, hot ``reload_weights()`` between tokens,
+  per-request streaming via a :class:`DecodeHandle` token iterator plus
+  the usual ``Future`` for the full sequence, and
+  ``ServerStats``/telemetry integration (TTFT + per-token latency
+  windows, slot-occupancy, the ``decodeServe`` profiler section, and
+  ``serve.decode.request`` async spans with prefill/decode phase
+  attribution).
+
+Decode model contract (``TinyDecoder`` below is the runnable
+reference; docs/serving.md documents it)::
+
+    model.prefill(prompts, lengths) -> (first_tokens, *cache_rows)
+        prompts : (batch, L) int32 NDArray, padded to a prefill bucket
+        lengths : (batch,) int32 NDArray of real prompt lengths
+        first_tokens : (batch,) int32 — the first generated token
+        cache_rows   : one or more (batch, L, ...) NDArrays, the
+                       per-position state to seed the slot cache with
+
+    model.decode_step(tokens, cursors, active, *cache)
+        -> (next_tokens, *new_cache)
+        tokens  : (max_slots,) int32 — each slot's last emitted token
+        cursors : (max_slots,) int32 — position the incoming token's
+                  cache row is written at
+        active  : (max_slots,) bool — inactive slots carry garbage and
+                  MUST be masked out of writes / kept NaN-safe
+        cache   : (max_slots, max_len, ...) buffers
+
+Both methods run under graph capture (``traced_apply``), so parameters
+are runtime inputs of the compiled step — a hot reload needs no
+recompile — and the step is compiled ONCE via
+:class:`~..gluon.block.CachedStepOp` with the cache buffers donated.
+"""
+from __future__ import annotations
+
+import queue as _queue_mod
+import threading
+import time
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FutureTimeout
+
+import numpy as np
+
+from .. import engine, profiler
+from ..base import MXNetError, getenv
+from ..gluon.block import Block, CachedStepOp
+from ..ndarray.ndarray import NDArray, _wrap, array as _nd_array
+from ..telemetry import tracer as _tracer
+from .batcher import (Batcher, DeadlineExceededError, _Request,
+                      ServerClosedError, ServerOverloadedError)
+from .buckets import BucketSpec
+from .stats import LatencyWindow, ServerStats
+
+#: counter set for the decode tier (same ServerStats machinery as
+#: ModelServer, token-granular names; ``batches`` counts admission
+#: groups — each is ONE fused prefill+slot-write dispatch — and is
+#: what ``record_batch`` tallies)
+DECODE_COUNTERS = ("submitted", "served", "rejected_overload",
+                   "expired_deadline", "failed", "cancelled", "admitted",
+                   "batches", "decode_steps", "tokens",
+                   "warmup_batches", "reloads")
+
+_DONE = object()          # stream sentinel: generation finished cleanly
+
+
+# ---------------------------------------------------------------------------
+# window-scoped module counters: the profiler's `decodeServe` section
+# (provider: profiler._decode_serve_counters; exported to /metrics as
+# mxtpu_decode_serve_* gauges by the section collector)
+
+_sec_lock = threading.Lock()
+_sec = {"steps": 0, "tokens": 0, "prefill_batches": 0, "admitted": 0,
+        "finished": 0, "expired_deadlines": 0, "occ_ratio_sum": 0.0}
+
+
+def _sec_bump(live_ratio=None, **deltas):
+    with _sec_lock:
+        for k, n in deltas.items():
+            _sec[k] += n
+        if live_ratio is not None:
+            _sec["occ_ratio_sum"] += live_ratio
+
+
+def decode_serve_stats():
+    """Window snapshot of the continuous-batching counters;
+    ``slot_occupancy`` is the token-step-weighted mean live/max_slots."""
+    with _sec_lock:
+        d = dict(_sec)
+    occ = d.pop("occ_ratio_sum")
+    d["slot_occupancy"] = round(occ / d["steps"], 4) if d["steps"] else 0.0
+    return d
+
+
+def reset_decode_serve_stats():
+    with _sec_lock:
+        for k in _sec:
+            _sec[k] = 0.0 if k == "occ_ratio_sum" else 0
+
+
+_donate_ok = None
+
+
+def _decode_donate_ok():
+    """Donate the cache arena to the step/writer executables (XLA
+    updates the KV buffers in place).  Off on CPU — PjRt:CPU has no
+    donation and would warn per token; MXTPU_DECODE_DONATE forces it
+    either way."""
+    global _donate_ok
+    if _donate_ok is None:
+        forced = getenv("DECODE_DONATE", None)
+        if forced is not None:
+            _donate_ok = forced not in ("0", "false", "False", "")
+        else:
+            import jax
+
+            _donate_ok = jax.default_backend() != "cpu"
+    return _donate_ok
+
+
+# ---------------------------------------------------------------------------
+# request / handle
+
+
+class _DecodeRequest(_Request):
+    __slots__ = ("max_new_tokens", "generated", "slot", "stream",
+                 "cancelled", "admitted_at")
+
+    def __init__(self, prompt, length, future, max_new_tokens,
+                 deadline_ms=None):
+        super().__init__(prompt, length, future, deadline_ms=deadline_ms)
+        self.max_new_tokens = int(max_new_tokens)
+        self.generated = []
+        self.slot = None
+        self.stream = _queue_mod.Queue()
+        self.cancelled = False
+        self.admitted_at = None
+
+
+class DecodeHandle:
+    """Per-request streaming handle: iterate tokens as they are
+    generated, or wait on :attr:`future` for the full sequence.
+
+    Iteration yields each token id (int) the moment its boundary
+    completes; it ends with ``StopIteration`` on clean finish and
+    re-raises the terminal error (deadline, cancellation, shutdown,
+    model failure) otherwise — the same error the future carries.
+    """
+
+    def __init__(self, req):
+        self._req = req
+        self.future = req.future
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._req.stream.get()
+        if item is _DONE:
+            # terminal sentinels stay consumable: a second iteration
+            # pass (or an iterator copy) must also terminate
+            self._req.stream.put(_DONE)
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self._req.stream.put(item)
+            raise item
+        return item
+
+    def result(self, timeout=None):
+        """The full generated token sequence (np.int32 array)."""
+        return self.future.result(timeout)
+
+    def cancel(self):
+        """Give up on this request: voided at dequeue if still queued,
+        freed at the next token boundary if mid-decode."""
+        self._req.cancelled = True
+        self._req.future.cancel()
+
+
+# ---------------------------------------------------------------------------
+# graph adapters: the fused admission body and the decode step, each
+# behind the gluon capture machinery so the compile surface is counted
+# (cached_graph_stats) and parameters stay runtime inputs
+
+
+class _AdmitAdapter(Block):
+    """CachedStepOp body for one admission group: ``model.prefill`` PLUS
+    the scatter of every admitted request's cache rows into its slot,
+    fused into ONE executable per prefill bucket shape (with the arena
+    buffers donated).  A split prefill-then-write design costs
+    ``1 + group_size`` dispatches per admission; on a dispatch-bound
+    host that overhead eats the scheduling win continuous batching
+    exists for — fused, admission is exactly one dispatch."""
+
+    def __init__(self, model, n_cache):
+        super().__init__()
+        self.model = model
+        self._n_cache = int(n_cache)
+
+    def forward(self, prompts, lengths, slots, *cache):
+        out = self.model.prefill(prompts, lengths)
+        if not isinstance(out, (tuple, list)) or len(out) < 2:
+            raise MXNetError(
+                "model.prefill must return (first_tokens, *cache_rows)")
+        first, rows = out[0], out[1:self._n_cache + 1]
+        from jax import lax
+
+        s = slots._data                       # (b,) int32
+        outs = []
+        for c_nd, r_nd in zip(cache, rows):
+            c, r = c_nd._data, r_nd._data
+            b = r.shape[0]
+            # unrolled per-row scatter, REVERSED: padding rows beyond
+            # the real group carry slots[i] == slots[0], so their
+            # garbage lands on slot[0] FIRST and row 0's own write
+            # (last) fully overwrites it — dead rows never touch a
+            # live slot and no per-row mask/select is needed
+            for i in reversed(range(b)):
+                blk = lax.dynamic_slice_in_dim(r, i, 1, axis=0)
+                start = (s[i],) + (0,) * (c.ndim - 1)
+                c = lax.dynamic_update_slice(c, blk.astype(c.dtype),
+                                             start)
+            outs.append(_wrap(c))
+        return (first,) + tuple(outs)
+
+
+class _StepAdapter(Block):
+    """CachedStepOp body for ``model.decode_step`` (ONE fixed-shape
+    executable for the whole serving lifetime)."""
+
+    def __init__(self, model):
+        super().__init__()
+        self.model = model
+
+    def forward(self, tokens, cursors, active, *cache):
+        out = self.model.decode_step(tokens, cursors, active, *cache)
+        if not isinstance(out, (tuple, list)) or len(out) < 2:
+            raise MXNetError(
+                "model.decode_step must return (next_tokens, *new_cache)")
+        return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# the server
+
+
+class DecodeServer:
+    """Continuous-batching autoregressive decode server.
+
+    Parameters
+    ----------
+    model : Block implementing the decode model contract (module doc).
+    spec : BucketSpec
+        The closed prefill grid: ``example_shape=(None,)`` int token
+        prompts, ``lengths`` = allowed padded prompt lengths.  Every
+        length bucket must fit ``max_len``.
+    max_slots : int, optional
+        Arena capacity (concurrent sequences); default
+        ``MXTPU_DECODE_SLOTS`` (8).
+    max_len : int, optional
+        Cache length per slot; default ``MXTPU_DECODE_MAX_LEN`` (128).
+        A request needs ``prompt_len + max_new_tokens <= max_len``.
+    eos_id : int, optional
+        Token id that terminates a sequence early (None = run to
+        ``max_new_tokens``).
+    max_new_tokens : int
+        Default generation budget per request (``submit()`` overrides).
+    max_queue : int
+        Bound on queued admissions before submit() fails fast.
+    admission : "continuous" | "batch"
+        ``"continuous"`` (the point of this class) backfills free slots
+        between tokens.  ``"batch"`` only admits when the arena is
+        EMPTY — whole-batch decode semantics, every sequence waits for
+        the batch's straggler — kept as the honest A/B baseline for
+        ``bench.py serve_decode`` and the parity tests.
+    ctx : Context, optional
+    checkpoint : CheckpointManager or str, optional
+        Source for ``reload_weights()``.
+    """
+
+    def __init__(self, model, spec, max_slots=None, max_len=None,
+                 eos_id=None, max_new_tokens=32, max_queue=256,
+                 admission="continuous", ctx=None, checkpoint=None):
+        if not isinstance(spec, BucketSpec):
+            raise MXNetError("spec must be a serve.BucketSpec")
+        if spec.var_axis is None or len(spec.example_shape) != 1:
+            raise MXNetError(
+                "DecodeServer prompts are 1-D token sequences: use "
+                "BucketSpec(example_shape=(None,), lengths=...)")
+        if admission not in ("continuous", "batch"):
+            raise MXNetError(
+                f"admission must be 'continuous' or 'batch', "
+                f"got {admission!r}")
+        self._model = model
+        self._spec = spec
+        self._slots = int(max_slots if max_slots is not None
+                          else getenv("DECODE_SLOTS", 8, int))
+        self._max_len = int(max_len if max_len is not None
+                            else getenv("DECODE_MAX_LEN", 128, int))
+        if self._slots < 1 or self._max_len < 2:
+            raise MXNetError("max_slots must be >= 1 and max_len >= 2")
+        if spec.lengths[-1] > self._max_len:
+            raise MXNetError(
+                f"prefill bucket length {spec.lengths[-1]} exceeds the "
+                f"slot cache max_len {self._max_len}")
+        self._eos_id = None if eos_id is None else int(eos_id)
+        self._default_mnt = int(max_new_tokens)
+        self._admission = admission
+        self._ctx = ctx
+        self._batcher = Batcher(max_queue=max_queue, linger_ms=0.0)
+        self._stats = ServerStats(counters=DECODE_COUNTERS)
+        self._ttft = LatencyWindow()
+        self._token_lat = LatencyWindow()
+        self._occ_lock = threading.Lock()
+        self._occ_sum = 0.0
+        self._occ_steps = 0
+        self._exec_lock = threading.Lock()   # token step XOR reload
+        self._admit_op = None                # built at start() (need
+        self._step_op = None                 # the cache layout first)
+        self._n_cache = None
+        self._cache_meta = None              # [(tail shape, dtype)]
+        self._cache = None                   # list of raw device arrays
+        self._tokens = np.zeros(self._slots, np.int32)
+        self._cursors = np.zeros(self._slots, np.int32)
+        self._active = np.zeros(self._slots, bool)
+        self._slot_req = [None] * self._slots
+        self._step_count = 0
+        self._donate = False                 # resolved at _warmup()
+        self._started = False
+        self._closing = False
+        self._abort = False
+        self._worker = None
+        self._warmup_compiles = 0
+        self._metrics_collector = None
+        if isinstance(checkpoint, str):
+            from ..checkpoint import CheckpointManager
+
+            checkpoint = CheckpointManager(checkpoint)
+        self._ckpt = checkpoint
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self):
+        """Warm the whole compile surface (one fused prefill+write
+        executable per prompt bucket, the ONE decode step), then start
+        the token loop.  A drained server restarts with zero new
+        compiles."""
+        if self._started:
+            raise MXNetError("DecodeServer already started")
+        self._abort = False
+        self._batcher.reopen()
+        if self._cache is None:
+            self._warmup()
+        self._warmup_compiles = self._graph_stats_raw()["compiles"]
+        self._started = True
+        self._closing = False
+        if self._metrics_collector is None:
+            from ..telemetry import metrics as _metrics
+
+            self._metrics_collector = _metrics.register_decode_server(self)
+        self._worker = threading.Thread(target=self._loop,
+                                        name="mxtpu-decode-loop",
+                                        daemon=True)
+        self._worker.start()
+        return self
+
+    def _warmup(self):
+        with profiler.op_scope("serve.decode.warmup", cat="serve"):
+            # ONE eager probe call discovers the model's cache layout
+            # (buffer count, per-position tail shapes, dtypes) before
+            # any arena or executable exists
+            min_len = self._spec.lengths[0]
+            probe = self._model.prefill(
+                _nd_array(np.zeros((1, min_len), np.int32),
+                          ctx=self._ctx),
+                _nd_array(np.full(1, min_len, np.int32), ctx=self._ctx))
+            rows = [o for o in probe[1:] if isinstance(o, NDArray)]
+            if not rows:
+                raise MXNetError("model.prefill returned no cache rows")
+            self._cache_meta = [(r.shape[2:], r.dtype) for r in rows]
+            self._n_cache = n = len(self._cache_meta)
+            self._cache = self._zero_arena()
+            # decided once, on the start() thread; the loop thread only
+            # reads the cached flag
+            donate = self._donate = _decode_donate_ok()
+            self._admit_op = CachedStepOp(
+                _AdmitAdapter(self._model, n),
+                donate_inputs=tuple(range(3, 3 + n)) if donate else ())
+            self._step_op = CachedStepOp(
+                _StepAdapter(self._model),
+                donate_inputs=tuple(range(3, 3 + n)) if donate else ())
+            # one fused prefill+write executable per prompt bucket
+            # shape — the whole admission surface, compiled up front
+            for shape in self._spec.bucket_shapes():
+                b, length = shape[0], shape[1]
+                outs = self._admit_op(
+                    np.zeros((b, length), np.int32),
+                    np.full(b, length, np.int32),
+                    np.zeros(b, np.int32), *self._cache)
+                np.asarray(outs[0])  # fail in warmup, not mid-token
+                self._cache = list(outs[1:])
+                self._stats.incr("warmup_batches")
+            # the decode step: ONE executable, compiled before traffic
+            outs = self._step_op(self._tokens, self._cursors,
+                                 self._active, *self._cache)
+            self._cache = list(outs[1:])
+            # warmup scribbled zero-rows into slot 0; hand traffic a
+            # clean arena (committed, same jit key as executed outputs)
+            self._cache = self._zero_arena()
+
+    def __enter__(self):
+        if not self._started:
+            self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=exc == (None, None, None))
+        return False
+
+    def drain(self, timeout=None):
+        """Stop admissions and block until every admitted sequence has
+        finished decoding; ends with zero queued work and zero live
+        slots."""
+        self._closing = True
+        self._batcher.close()
+        if self._worker is not None:
+            self._worker.join(timeout)
+            if self._worker.is_alive():
+                raise MXNetError("drain timed out with live decode slots")
+            self._worker = None
+        self._started = False
+
+    def shutdown(self, drain=True, timeout=None):
+        if not self._started and self._worker is None:
+            return
+        if drain:
+            self.drain(timeout)
+            return
+        self._closing = True
+        self._abort = True
+        self._batcher.close()
+        if self._worker is not None:
+            self._worker.join(timeout)
+            self._worker = None
+        self._started = False
+        # fail live slots, then sweep the queue
+        for slot in np.flatnonzero(self._active):
+            self._finish_slot(int(slot), "cancelled",
+                              ServerClosedError("server shut down"))
+        while True:
+            group, expired = self._batcher.next_group(self._slots,
+                                                      timeout=0)
+            if not group and not expired:
+                break
+            for req in group + expired:
+                self._resolve_error(req, "cancelled",
+                                    ServerClosedError("server shut down"))
+
+    # -- request path -------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens=None, deadline_ms=None):
+        """Queue one prompt (1-D int token array); returns a
+        :class:`DecodeHandle` (stream iterator + ``.future``)."""
+        if not self._started or self._closing:
+            raise ServerClosedError(
+                "DecodeServer is not accepting requests (not started, "
+                "draining, or shut down)")
+        if isinstance(prompt, NDArray):
+            prompt = prompt.asnumpy()
+        prompt = np.asarray(prompt, dtype=np.int32)
+        length = self._spec.validate(prompt)
+        mnt = int(max_new_tokens if max_new_tokens is not None
+                  else self._default_mnt)
+        if mnt < 1:
+            raise MXNetError("max_new_tokens must be >= 1")
+        if length + mnt > self._max_len:
+            raise MXNetError(
+                f"prompt_len {length} + max_new_tokens {mnt} exceeds the "
+                f"slot cache max_len {self._max_len}; truncate the "
+                f"prompt, lower the budget, or raise MXTPU_DECODE_MAX_LEN")
+        req = _DecodeRequest(prompt, length, Future(), mnt,
+                             deadline_ms=deadline_ms)
+        req.trace_id = _tracer.request_begin(
+            "serve.decode.request", cat="serve", prompt_len=length,
+            max_new_tokens=mnt,
+            deadline_ms=deadline_ms if deadline_ms is not None else -1)
+        self._stats.incr("submitted")
+        try:
+            self._batcher.put(req)
+        except MXNetError as e:
+            self._stats.incr("submitted", -1)
+            if isinstance(e, ServerOverloadedError):
+                self._stats.incr("rejected_overload")
+            _tracer.request_end("serve.decode.request", req.trace_id,
+                                cat="serve", outcome="rejected")
+            raise
+        return DecodeHandle(req)
+
+    def generate(self, prompt, max_new_tokens=None, deadline_ms=None,
+                 timeout=None):
+        """Synchronous convenience wrapper: the full token sequence."""
+        handle = self.submit(prompt, max_new_tokens=max_new_tokens,
+                             deadline_ms=deadline_ms)
+        try:
+            return handle.result(timeout)
+        except _FutureTimeout:
+            # caller gave up: void the request so it stops consuming a
+            # queue position / decode slot (same contract as
+            # ModelServer.predict)
+            handle.cancel()
+            raise
+
+    # -- the token loop -----------------------------------------------------
+
+    def _loop(self):
+        try:
+            while not self._abort:
+                live = int(self._active.sum())
+                self._admit(timeout=0.05 if live == 0 else 0.0)
+                live = int(self._active.sum())
+                if live == 0:
+                    if self._batcher.drained():
+                        return
+                    continue
+                with self._exec_lock:
+                    self._boundary_and_step()
+        except Exception as e:  # noqa: BLE001 — a dead loop thread
+            # would strand every future forever; fail loudly instead
+            for slot in np.flatnonzero(self._active):
+                self._finish_slot(int(slot), "failed", e)
+            while True:
+                group, expired = self._batcher.next_group(self._slots,
+                                                          timeout=0)
+                if not group and not expired:
+                    return
+                for req in group + expired:
+                    self._resolve_error(req, "failed", e)
+
+    def _free_slots(self):
+        return [i for i in range(self._slots) if not self._active[i]]
+
+    def _admit(self, timeout):
+        free = self._free_slots()
+        if not free:
+            return
+        if self._admission == "batch" and len(free) < self._slots:
+            # whole-batch mode: no backfill until the arena is EMPTY
+            return
+        group, expired = self._batcher.next_group(
+            min(len(free), self._spec.max_batch), timeout=timeout)
+        for req in expired:
+            self._resolve_error(req, "expired",
+                                DeadlineExceededError(
+                                    "deadline passed while queued"))
+        if not group:
+            return
+        # void caller-side-cancelled requests at dequeue (they must not
+        # consume a prefill row or a slot)
+        live = []
+        for req in group:
+            if req.cancelled or req.future.cancelled():
+                self._resolve_error(req, "cancelled",
+                                    ServerClosedError("request cancelled"))
+            else:
+                live.append(req)
+        if not live:
+            return
+        try:
+            self._prefill_group(live, free)
+        except Exception as e:  # noqa: BLE001 — fail THIS group's
+            # futures; the loop (and every live slot) must survive
+            for req in live:
+                if req.slot is not None:
+                    continue   # already admitted before the failure
+                self._resolve_error(req, "failed", e)
+            if self._donate:
+                # the failed admit op may have consumed the donated
+                # arena buffers; every live sequence's cache state is
+                # unknowable, so fail them too and start clean (a
+                # deleted-buffer step would take them all down anyway,
+                # with a far less diagnosable error)
+                for slot in np.flatnonzero(self._active):
+                    self._finish_slot(int(slot), "failed", e)
+                self._reset_arena()
+
+    def _prefill_group(self, group, free):
+        spec = self._spec
+        max_len = max(r.length for r in group)
+        batch, length = spec.pick(len(group), max_len)
+        key = spec.key(batch, length)
+        slots = [free.pop(0) for _ in group]
+        with profiler.op_scope("serve.decode.admit", cat="serve"):
+            padded = spec.pad_batch([r.example for r in group], batch,
+                                    length)
+            lengths = np.ones(batch, np.int32)
+            lengths[:len(group)] = [r.length for r in group]
+            # padding rows beyond the group target slots[0]: the fused
+            # scatter writes them first and overwrites with row 0's
+            # real rows (see _AdmitAdapter), so they never touch a
+            # live slot
+            slot_vec = np.full(batch, slots[0], np.int32)
+            slot_vec[:len(group)] = slots
+            # the exec lock serializes this dispatch with
+            # reload_weights(): the admit op fetches p.data() live, so
+            # an unserialized restore could hand it a torn mix of old
+            # and new parameters
+            with self._exec_lock, \
+                    profiler.op_scope("serve.prefill", cat="serve"):
+                outs = self._admit_op(padded, lengths, slot_vec,
+                                      *self._cache)
+                first = np.asarray(outs[0])
+                self._cache = list(outs[1:])
+        self._stats.record_batch(
+            key, n_real=len(group), n_rows=batch,
+            real_elems=sum(r.length for r in group),
+            padded_elems=batch * length)
+        _sec_bump(prefill_batches=1)
+        now = time.monotonic()
+        for i, req in enumerate(group):
+            slot = slots[i]
+            req.slot = slot
+            req.admitted_at = now
+            self._slot_req[slot] = req
+            self._tokens[slot] = first[i]
+            self._cursors[slot] = req.length
+            self._active[slot] = True
+            self._stats.incr("admitted")
+            _sec_bump(admitted=1)
+            _tracer.request_instant("serve.decode.admitted", req.trace_id,
+                                    cat="serve", slot=slot,
+                                    bucket=key)
+            self._emit_token(req, int(first[i]), now)
+            # a 1-token budget (or an immediate EOS) finishes at
+            # admission without ever occupying a decode step
+            self._maybe_finish(req, now)
+
+    def _emit_token(self, req, token, now):
+        if not req.generated:
+            ttft_ms = (now - req.enqueued_at) * 1e3
+            # _occ_lock guards the ttft/token windows against a
+            # concurrent stats(reset=True) rewind (LatencyWindow itself
+            # is unlocked; ServerStats routes through its own lock)
+            with self._occ_lock:
+                self._ttft.record(ttft_ms)
+            _tracer.request_instant("serve.decode.first_token",
+                                    req.trace_id, cat="serve",
+                                    ttft_ms=round(ttft_ms, 3))
+        req.generated.append(token)
+        req.stream.put(token)
+        self._stats.incr("tokens")
+        _sec_bump(tokens=1)
+
+    def _boundary_and_step(self):
+        """One token boundary: expire/cancel live slots, then run the
+        single fixed-shape decode step and fan its tokens out."""
+        now = time.monotonic()
+        for slot in np.flatnonzero(self._active):
+            req = self._slot_req[int(slot)]
+            if req.cancelled:
+                self._finish_slot(int(slot), "cancelled",
+                                  ServerClosedError("request cancelled"))
+            elif req.expired(now):
+                self._finish_slot(int(slot), "expired",
+                                  DeadlineExceededError(
+                                      "deadline passed mid-decode"))
+        live = int(self._active.sum())
+        if live == 0:
+            return
+        t0 = time.monotonic()
+        try:
+            engine.fault_point("serve.decode", step=self._step_count,
+                               live=live)
+            with profiler.op_scope("serve.decode.step", cat="serve"):
+                outs = self._step_op(self._tokens, self._cursors,
+                                     self._active, *self._cache)
+                nxt = np.asarray(outs[0])
+                self._cache = list(outs[1:])
+        except Exception as e:  # noqa: BLE001 — fail every live
+            # sequence (their cache state is gone if buffers were
+            # donated), reset the arena, keep serving
+            for slot in np.flatnonzero(self._active):
+                self._finish_slot(int(slot), "failed", e)
+            self._reset_arena()
+            return
+        now = time.monotonic()
+        step_ms = (now - t0) * 1e3
+        self._step_count += 1
+        self._stats.incr("decode_steps")
+        with self._occ_lock:
+            self._token_lat.record(step_ms)
+            self._occ_sum += live / self._slots
+            self._occ_steps += 1
+        _sec_bump(live_ratio=live / self._slots, steps=1)
+        for slot in np.flatnonzero(self._active):
+            slot = int(slot)
+            req = self._slot_req[slot]
+            self._cursors[slot] += 1
+            self._tokens[slot] = nxt[slot]
+            self._emit_token(req, int(nxt[slot]), now)
+            self._maybe_finish(req, now)
+
+    def _maybe_finish(self, req, now):
+        done = (len(req.generated) >= req.max_new_tokens
+                or (self._eos_id is not None
+                    and req.generated[-1] == self._eos_id))
+        if done:
+            self._finish_slot(req.slot, "served")
+
+    def _finish_slot(self, slot, outcome, error=None):
+        req = self._slot_req[slot]
+        self._active[slot] = False
+        self._tokens[slot] = 0
+        self._cursors[slot] = 0
+        self._slot_req[slot] = None
+        self._resolve(req, outcome, error)
+
+    def _resolve(self, req, outcome, error=None):
+        now = time.monotonic()
+        counter = {"served": "served", "expired": "expired_deadline",
+                   "cancelled": "cancelled", "failed": "failed"}[outcome]
+        self._stats.incr(counter)
+        if outcome == "served":
+            self._stats.record_latency((now - req.enqueued_at) * 1e3)
+            _sec_bump(finished=1)
+        elif outcome == "expired":
+            _sec_bump(expired_deadlines=1)
+        decode_ms = ((now - req.admitted_at) * 1e3
+                     if req.admitted_at is not None else -1)
+        _tracer.request_end(
+            "serve.decode.request", req.trace_id, cat="serve",
+            outcome=outcome, tokens=len(req.generated),
+            slot=req.slot if req.slot is not None else -1,
+            queue_ms=round(((req.admitted_at or now)
+                            - req.enqueued_at) * 1e3, 3),
+            decode_ms=round(decode_ms, 3))
+        if error is None:
+            req.stream.put(_DONE)
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_result(np.asarray(req.generated, np.int32))
+        else:
+            req.stream.put(error)
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_exception(error)
+
+    def _resolve_error(self, req, outcome, error):
+        """Terminal path for requests that never reached a slot."""
+        self._resolve(req, outcome, error)
+
+    def _zero_arena(self):
+        """Fresh zeroed cache buffers, COMMITTED to the serving device:
+        every steady-state cache input is a committed executable
+        output, so an uncommitted warmup arena would carve a second jit
+        cache key for the first bucket's admit op — one phantom compile
+        on first traffic (observed; the decode tests pin executable
+        counts)."""
+        import jax
+        import jax.numpy as jnp
+
+        dev = self._ctx.jax_device() if self._ctx is not None \
+            else jax.devices()[0]
+        return [jax.device_put(
+            jnp.zeros((self._slots, self._max_len) + tuple(tail),
+                      dtype=dtype), dev)
+            for tail, dtype in self._cache_meta]
+
+    def _reset_arena(self):
+        self._cache = self._zero_arena()
+        self._tokens[:] = 0
+        self._cursors[:] = 0
+        self._active[:] = False
+
+    # -- hot reload ---------------------------------------------------------
+
+    def reload_weights(self, step=None):
+        """Swap parameters from the checkpoint manager between token
+        boundaries: in-flight sequences finish their current token on
+        the old weights and continue on the new — no drops, no
+        recompile (parameters are runtime inputs of the step)."""
+        if self._ckpt is None:
+            raise MXNetError(
+                "no checkpoint manager: construct DecodeServer("
+                "checkpoint=...) to enable reload_weights()")
+        with self._exec_lock:
+            with profiler.op_scope("serve.reload", cat="serve"):
+                meta = self._ckpt.restore(step=step, params=self._model,
+                                          restore_rng=False)
+        self._stats.incr("reloads")
+        return {"step": meta["step"], "epoch": meta.get("epoch")}
+
+    # -- observability ------------------------------------------------------
+
+    def _graph_stats_raw(self):
+        agg = {"compiles": 0, "reuses": 0}
+        for op in (self._admit_op, self._step_op):
+            if op is not None:
+                agg["compiles"] += op.stats.get("compiles", 0)
+                agg["reuses"] += op.stats.get("reuses", 0)
+        return agg
+
+    def live_slots(self):
+        return int(self._active.sum())
+
+    def stats(self, reset=False):
+        """One snapshot of the decode tier, same window-scoping contract
+        as ``ModelServer.stats`` — the quiescent invariant::
+
+            submitted == served + expired_deadline + failed + cancelled
+                         + queue_depth + live_slots
+        """
+        g = self._graph_stats_raw()
+        graph = dict(g, post_warmup_compiles=g["compiles"]
+                     - self._warmup_compiles)
+        with self._occ_lock:
+            occ = (round(self._occ_sum / self._occ_steps, 4)
+                   if self._occ_steps else None)
+            ttft = self._ttft.snapshot()
+            token = self._token_lat.snapshot()
+            if reset:
+                self._occ_sum = 0.0
+                self._occ_steps = 0
+                self._ttft.reset()
+                self._token_lat.reset()
+        return self._stats.snapshot(
+            queue_depth=len(self._batcher),
+            in_flight=self.live_slots(), reset=reset,
+            extra={"graph": graph, "buckets": repr(self._spec),
+                   "slots": {"max": self._slots, "live": self.live_slots(),
+                             "occupancy": occ,
+                             "max_len": self._max_len},
+                   "ttft": ttft, "token_latency": token})
+
+
+# ---------------------------------------------------------------------------
+# reference decode model
+
+
+class TinyDecoder(Block):
+    """Minimal runnable decode model: greedy argmax over a cumulative
+    mean of token embeddings — the per-slot state is a genuine
+    ``(slots, max_len, embed)`` cache of per-position embeddings, so it
+    exercises the arena exactly like a transformer KV cache while
+    staying a two-matmul CPU-friendly graph.
+
+    Used by tests/test_decode.py, tools/decode_smoke.py, and the
+    ``bench.py serve_decode`` leaf; it doubles as the executable
+    documentation of the decode model contract.  Math notes:
+
+    - every per-slot quantity depends only on that slot's row, so
+      continuous vs whole-batch decode is bit-identical by construction
+      (the acceptance parity gate);
+    - inactive slots are masked out of cache writes and divide by
+      ``max(cursor+1, 1)``, so garbage slots can never NaN the batch.
+    """
+
+    def __init__(self, vocab=64, embed=16, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.vocab = int(vocab)
+        self.embed_dim = int(embed)
+        self.embedding = self.params.get("embedding",
+                                         shape=(vocab, embed))
+        self.proj = self.params.get("proj", shape=(embed, vocab))
+
+    def _weights(self):
+        return (self.embedding.data()._data, self.proj.data()._data)
+
+    def prefill(self, prompts, lengths):
+        import jax.numpy as jnp
+
+        E, W = self._weights()
+        p = prompts._data                      # (B, L) int32
+        ln = lengths._data                     # (B,) int32
+        emb = jnp.take(E, p, axis=0)           # (B, L, d)
+        m = (jnp.arange(emb.shape[1])[None, :] < ln[:, None])
+        h = jnp.sum(emb * m[..., None].astype(emb.dtype), axis=1) \
+            / jnp.maximum(ln, 1).astype(emb.dtype)[:, None]
+        first = jnp.argmax(h @ W, axis=-1).astype(jnp.int32)
+        return _wrap(first), _wrap(emb)
+
+    def decode_step(self, tokens, cursors, active, cache):
+        import jax.numpy as jnp
+
+        E, W = self._weights()
+        t, cur = tokens._data, cursors._data
+        act, c = active._data, cache._data
+        e = jnp.take(E, t, axis=0)             # (S, d)
+        pos = jnp.arange(c.shape[1])[None, :]
+        write = (pos == cur[:, None]) & act[:, None]
+        c = jnp.where(write[..., None], e[:, None, :], c)
+        seen = (pos <= cur[:, None])
+        h = jnp.sum(c * seen[..., None].astype(c.dtype), axis=1) \
+            / jnp.maximum(cur + 1, 1).astype(c.dtype)[:, None]
+        nxt = jnp.argmax(h @ W, axis=-1).astype(jnp.int32)
+        return _wrap(nxt), _wrap(c)

@@ -32,6 +32,7 @@ from __future__ import annotations
 import threading
 import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FutureTimeout
 
 import numpy as np
 
@@ -224,8 +225,21 @@ class ModelServer:
         return req.future
 
     def predict(self, example, deadline_ms=None, timeout=None):
-        """Synchronous convenience wrapper around submit()."""
-        return self.submit(example, deadline_ms=deadline_ms).result(timeout)
+        """Synchronous convenience wrapper around submit().
+
+        A caller-side ``timeout`` expiry CANCELS the queued request —
+        without that, the abandoned request would still consume a batch
+        slot when it finally dequeues (the caller stopped listening, so
+        computing its answer is pure waste, exactly like an expired
+        deadline).  The batcher thread voids cancelled requests at
+        dequeue, counted as ``cancelled``.
+        """
+        fut = self.submit(example, deadline_ms=deadline_ms)
+        try:
+            return fut.result(timeout)
+        except _FutureTimeout:
+            fut.cancel()
+            raise
 
     # -- batcher thread -----------------------------------------------------
 
@@ -241,6 +255,22 @@ class ModelServer:
                 if req.future.set_running_or_notify_cancel():
                     req.future.set_exception(DeadlineExceededError(
                         "deadline passed while queued"))
+            if group:
+                # void requests whose caller already cancelled (e.g. a
+                # predict(timeout=) expiry): they must not consume a
+                # batch row — the expired-deadline rule, applied to
+                # caller-side give-ups
+                live = []
+                for req in group:
+                    if req.future.cancelled():
+                        self._finish(req)
+                        self._stats.incr("cancelled")
+                        _tracer.request_end("serve.request", req.trace_id,
+                                            cat="serve",
+                                            outcome="cancelled")
+                    else:
+                        live.append(req)
+                group = live
             if group:
                 with self._exec_lock:
                     self._run_batch(group)

@@ -86,23 +86,20 @@ class LatencyWindow:
         }
 
 
-class ServerStats:
-    """All ModelServer counters behind one lock."""
+#: the ModelServer counter set (DecodeServer passes its own — same
+#: machinery, token-granular names)
+DEFAULT_COUNTERS = ("submitted", "served", "rejected_overload",
+                    "expired_deadline", "failed", "cancelled", "batches",
+                    "warmup_batches", "reloads")
 
-    def __init__(self, latency_capacity=4096):
+
+class ServerStats:
+    """All ModelServer/DecodeServer counters behind one lock."""
+
+    def __init__(self, latency_capacity=4096, counters=None):
         self._lock = threading.Lock()
         self.latency = LatencyWindow(latency_capacity)
-        self._c = {
-            "submitted": 0,
-            "served": 0,
-            "rejected_overload": 0,
-            "expired_deadline": 0,
-            "failed": 0,
-            "cancelled": 0,
-            "batches": 0,
-            "warmup_batches": 0,
-            "reloads": 0,
-        }
+        self._c = {k: 0 for k in (counters or DEFAULT_COUNTERS)}
         # batch-fill ratio = real requests / padded batch rows, the
         # throughput-per-compile-surface figure of merit
         self._fill_real = 0
@@ -111,6 +108,11 @@ class ServerStats:
         self._pad_real = 0
         self._pad_padded = 0
         self._bucket_hits = {}
+        # per-bucket splits of the two aggregates above: the traffic
+        # data the bucket autotuner (ROADMAP item 4) and the
+        # decode-vs-whole-batch comparison read off /metrics
+        self._bucket_fill = {}   # key -> [real requests, padded rows]
+        self._bucket_pad = {}    # key -> [real elems, padded elems]
 
     # -- mutation -----------------------------------------------------------
 
@@ -128,6 +130,12 @@ class ServerStats:
             self._pad_padded += padded_elems
             self._bucket_hits[bucket_key] = \
                 self._bucket_hits.get(bucket_key, 0) + 1
+            fill = self._bucket_fill.setdefault(bucket_key, [0, 0])
+            fill[0] += n_real
+            fill[1] += n_rows
+            pad = self._bucket_pad.setdefault(bucket_key, [0, 0])
+            pad[0] += real_elems
+            pad[1] += padded_elems
 
     def record_latency(self, ms):
         with self._lock:
@@ -139,6 +147,8 @@ class ServerStats:
         self._fill_real = self._fill_rows = 0
         self._pad_real = self._pad_padded = 0
         self._bucket_hits = {}
+        self._bucket_fill = {}
+        self._bucket_pad = {}
         self.latency.reset()
 
     def reset(self):
@@ -164,6 +174,12 @@ class ServerStats:
                 round(self._pad_padded / self._pad_real - 1.0, 4)
                 if self._pad_real else None)
             snap["bucket_hits"] = dict(self._bucket_hits)
+            snap["bucket_fill_ratio"] = {
+                k: round(real / rows, 4)
+                for k, (real, rows) in self._bucket_fill.items() if rows}
+            snap["bucket_padding_overhead"] = {
+                k: round(padded / real - 1.0, 4)
+                for k, (real, padded) in self._bucket_pad.items() if real}
             snap["latency"] = self.latency.snapshot()
             if reset:
                 # read-and-rewind is atomic: a sample landing between

@@ -349,6 +349,24 @@ def register_server(server, registry=None):
                          "batches per bucket shape (current window)",
                          [(dict(lab, bucket=str(b)), float(n))
                           for b, n in sorted(hits.items())]))
+        # per-bucket traffic quality: where padding waste actually
+        # lands — the data the bucket autotuner (ROADMAP item 4) and
+        # the decode-vs-whole-batch comparison need, vs the aggregate
+        # fill ratio that was the only scrapeable figure before
+        fill = snap.get("bucket_fill_ratio") or {}
+        if fill:
+            fams.append(("mxtpu_serve_bucket_fill_ratio", "gauge",
+                         "real requests / padded rows per bucket "
+                         "(current window)",
+                         [(dict(lab, bucket=str(b)), float(v))
+                          for b, v in sorted(fill.items())]))
+        pad = snap.get("bucket_padding_overhead") or {}
+        if pad:
+            fams.append(("mxtpu_serve_bucket_padding_overhead", "gauge",
+                         "padded/real elements - 1 per bucket "
+                         "(current window)",
+                         [(dict(lab, bucket=str(b)), float(v))
+                          for b, v in sorted(pad.items())]))
         hist = (snap.get("latency") or {}).get("histogram")
         if hist:
             fams.append(("mxtpu_serve_latency_ms", "histogram",
@@ -361,6 +379,68 @@ def register_server(server, registry=None):
         for k, v in sorted(graph.items()):
             fams.append((f"mxtpu_serve_graph_{k}", "gauge",
                          f"serve compiled-graph {k}",
+                         [(lab, float(v))]))
+        return fams
+
+    reg.register_collector(_collect)
+    return _collect
+
+
+# -- DecodeServer export -----------------------------------------------------
+
+
+def register_decode_server(server, registry=None):
+    """Export a ``DecodeServer``'s ``stats()`` under
+    ``mxtpu_decode_*{server="<id>"}`` — weakly held, gauges throughout
+    (``stats(reset=True)`` rewinds the window), mirroring
+    :func:`register_server` for the continuous-batching tier."""
+    # the decode tier defines its counter set ONCE; importing it here
+    # (lazily — decode.py imports this module) keeps the export from
+    # drifting out of sync with the stats it scrapes
+    from ..serve.decode import DECODE_COUNTERS
+
+    reg = registry or _default
+    ref = weakref.ref(server)
+    sid = str(next(_server_ids))
+
+    def _collect():
+        s = ref()
+        if s is None:
+            reg.unregister_collector(_collect)
+            return []
+        snap = s.stats()
+        lab = {"server": sid}
+        fams = []
+        for k in DECODE_COUNTERS:
+            fams.append((f"mxtpu_decode_{k}", "gauge",
+                         f"decode serve {k} (current accounting window)",
+                         [(lab, float(snap.get(k, 0)))]))
+        fams.append(("mxtpu_decode_queue_depth", "gauge",
+                     "queued admissions",
+                     [(lab, float(snap.get("queue_depth", 0)))]))
+        slots = snap.get("slots") or {}
+        fams.append(("mxtpu_decode_slots_live", "gauge",
+                     "occupied decode slots",
+                     [(lab, float(slots.get("live", 0)))]))
+        if slots.get("occupancy") is not None:
+            fams.append(("mxtpu_decode_slot_occupancy", "gauge",
+                         "token-step-weighted mean live/max_slots",
+                         [(lab, float(slots["occupancy"]))]))
+        for name, key in (("mxtpu_decode_ttft_ms", "ttft"),
+                          ("mxtpu_decode_token_ms", "token_latency"),
+                          ("mxtpu_decode_latency_ms", "latency")):
+            hist = (snap.get(key) or {}).get("histogram")
+            if hist:
+                fams.append((name, "histogram",
+                             f"decode serve {key}",
+                             [(lab, {"buckets": [(b, c) for b, c in
+                                                 hist["buckets"]],
+                                     "sum": hist["sum_ms"],
+                                     "count": hist["count"]})]))
+        graph = snap.get("graph") or {}
+        for k, v in sorted(graph.items()):
+            fams.append((f"mxtpu_decode_graph_{k}", "gauge",
+                         f"decode serve compiled-graph {k}",
                          [(lab, float(v))]))
         return fams
 

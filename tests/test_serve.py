@@ -240,6 +240,71 @@ def test_overload_rejection():
     assert s["served"] == s["submitted"] == 24 - rejected
 
 
+def test_predict_timeout_voids_queued_request():
+    """ISSUE 11 satellite: a caller-side predict(timeout=) expiry used
+    to leave the request queued and still consuming a batch row when it
+    finally dequeued; it must be cancelled at the caller and voided at
+    dequeue (counted ``cancelled``), like an expired deadline."""
+    from concurrent.futures import TimeoutError as FutTimeout
+
+    net = _make_net()
+    srv = serve.ModelServer(net, _spec(), max_queue=16, linger_ms=0.5)
+    srv.start()
+    handle = net.register_forward_pre_hook(_slow_hook(0.2))
+    try:
+        rng = np.random.RandomState(13)
+        # occupy the worker, then time out on a queued request
+        slow = srv.submit(rng.rand(4, FEAT).astype(np.float32))
+        time.sleep(0.05)
+        with pytest.raises(FutTimeout):
+            srv.predict(rng.rand(4, FEAT).astype(np.float32),
+                        timeout=0.01)
+        assert slow.result(timeout=60).shape == (4, 5)
+    finally:
+        handle.detach()
+        srv.drain()
+    s = srv.stats()
+    # the abandoned request was voided at dequeue, never served
+    assert s["cancelled"] == 1
+    assert s["served"] == 1
+    assert s["submitted"] == s["served"] + s["cancelled"]
+    assert s["in_flight"] == 0 and s["queue_depth"] == 0
+
+
+def test_per_bucket_padding_and_fill_stats():
+    """ISSUE 11 satellite: stats() exposes per-bucket fill-ratio and
+    padding-overhead splits (not just the aggregates), and the /metrics
+    collector exports them as labeled gauges."""
+    from mxnet_tpu.telemetry import metrics as tmetrics
+
+    srv = serve.ModelServer(_make_net(), _spec(), max_queue=64,
+                            linger_ms=1.0)
+    rng = np.random.RandomState(14)
+    reg = tmetrics.Registry()
+    with srv:
+        tmetrics.register_server(srv, registry=reg)
+        futs = [srv.submit(x) for x in _requests(12, rng)]
+        for f in futs:
+            f.result(timeout=60)
+        page = reg.render()
+    s = srv.stats()
+    assert set(s["bucket_fill_ratio"]) == set(s["bucket_hits"])
+    assert set(s["bucket_padding_overhead"]) == set(s["bucket_hits"])
+    for k, hits in s["bucket_hits"].items():
+        assert 0 < s["bucket_fill_ratio"][k] <= 1.0
+        assert s["bucket_padding_overhead"][k] >= 0.0
+    # labeled gauges on the scrape, one sample per bucket
+    assert "mxtpu_serve_bucket_fill_ratio{" in page
+    assert "mxtpu_serve_bucket_padding_overhead{" in page
+    some_bucket = next(iter(s["bucket_hits"]))
+    assert f'bucket="{some_bucket}"' in page
+    # reset=True window-scopes the new per-bucket splits too
+    srv.stats(reset=True)
+    s2 = srv.stats()
+    assert s2["bucket_fill_ratio"] == {}
+    assert s2["bucket_padding_overhead"] == {}
+
+
 def test_drain_leaves_zero_in_flight():
     srv = serve.ModelServer(_make_net(), _spec(), max_queue=256,
                             linger_ms=1.0)

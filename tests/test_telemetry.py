@@ -328,10 +328,11 @@ def test_registered_sections_cover_all_subsystems():
     import mxnet_tpu.gluon  # noqa: F401
     import mxnet_tpu.pipeline  # noqa: F401
     import mxnet_tpu.resilience  # noqa: F401
+    import mxnet_tpu.serve.decode  # noqa: F401
 
     d = json.loads(profiler.dumps())
     for section in ("cachedGraph", "trainerStep", "dataPipeline",
-                    "resilience", "telemetry"):
+                    "resilience", "telemetry", "decodeServe"):
         assert section in d, sorted(d)
 
 
