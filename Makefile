@@ -58,6 +58,15 @@ serve-smoke:
 decode-smoke:
 	env PYTHONPATH=. python tools/decode_smoke.py
 
+# compiled-INT8 serving gate: calibrate -> quantize -> serve a request
+# burst through ModelServer + a decode burst through DecodeServer —
+# zero post-warmup compiles, exact dispatch accounting (one executable
+# per batch / per token step), >= 99% argmax agreement with fp32,
+# compiled==eager bit parity — see tools/int8_smoke.py /
+# docs/quantization.md
+int8-smoke:
+	env PYTHONPATH=. python tools/int8_smoke.py
+
 # step-fusion gate: 50 fused Trainer.step()s under a decaying LR
 # schedule with zero post-warmup compiles + fused/sequential bit
 # parity — see tools/step_fusion_smoke.py / docs/performance.md
@@ -111,7 +120,7 @@ analyze:
 
 # the ROADMAP tier-1 gate, verbatim ($$ = make-escaped shell $)
 verify: SHELL := /bin/bash
-verify: analyze serve-smoke decode-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke trace-smoke
+verify: analyze serve-smoke decode-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke trace-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
-.PHONY: all clean test verify analyze serve-smoke decode-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke trace-smoke
+.PHONY: all clean test verify analyze serve-smoke decode-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke trace-smoke

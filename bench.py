@@ -451,6 +451,152 @@ def _leaf_serve(platform):
     }))
 
 
+def _leaf_serve_int8(platform):
+    """Compiled-INT8 serving A/B (contrib.quantization + ModelServer):
+    the same trained classifier served three ways through identically
+    configured warmed servers — fp32 compiled, int8 compiled
+    (quantize_net: one fused int8 executable per bucket, activations
+    int8 between layers), and the old eager-quantized arm (per-op
+    dispatch, fp32 between every layer — what quantize_net emitted
+    before the compile-native rebuild).  Gates recorded: compiled-int8
+    >= 2x the eager-quantized arm, >= 99% argmax agreement with fp32,
+    compiled==eager bit parity, zero post-warmup compiles."""
+    _leaf_setup(platform)
+    n_requests = 150 if platform == "cpu" else 400
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd, serve
+    from mxnet_tpu.contrib import quantization as qz
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.block import Block
+
+    # geometry: deep-and-narrow with small buckets keeps the serve loop
+    # DISPATCH-bound — the regime the eager-quantized path loses in
+    # (per-op dispatch × layers × chain stages per batch) and the whole
+    # reason the compiled path exists.  Compute-bound geometries
+    # converge to the matmul cost on every arm.
+    feat, hidden, classes, layers = 32, 96, 10, 12
+    rs = np.random.RandomState(0)
+    centers = rs.randn(classes, feat).astype(np.float32) * 2.0
+
+    def sample(n, rng):
+        y = rng.randint(0, classes, n)
+        return (centers[y] + rng.randn(n, feat)).astype(np.float32), y
+
+    def build(seed):
+        mx.random.seed(seed)
+        net = nn.HybridSequential()
+        prev = feat
+        for _ in range(layers - 1):
+            net.add(nn.Dense(hidden, activation="relu", in_units=prev,
+                             flatten=False))
+            prev = hidden
+        net.add(nn.Dense(classes, in_units=prev, flatten=False))
+        net.initialize(mx.init.Xavier())
+        return net
+
+    # brief training: the quality gate is defined on a net with real
+    # decision margins
+    fp32 = build(0)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(fp32.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    for _ in range(150):
+        x, y = sample(64, rs)
+        with autograd.record():
+            loss = loss_fn(fp32(nd.array(x)), nd.array(y.astype(np.int32)))
+        loss.backward()
+        trainer.step(64)
+
+    def clone():
+        net = build(1)
+        for dst, src in zip(net.collect_params().values(),
+                            fp32.collect_params().values()):
+            dst.set_data(src.data())
+        return net
+
+    # naive calibration: entropy's aggressive clipping COMPOUNDS
+    # through a deep folded chain (every int8 boundary re-clips) and
+    # wrecks agreement past ~10 layers; min/max is the right mode here
+    # (docs/quantization.md, accuracy expectations)
+    calib, _ = sample(256, rs)
+    q_compiled = qz.quantize_net(clone(), calib_data=calib,
+                                 calib_mode="naive")
+    # the old arm: per-layer eager dispatch with fp32 boundaries (no
+    # fold), behind a Block facade so ModelServer can't hybridize it
+    q_eager_inner = qz.quantize_net(clone(), calib_data=calib,
+                                    calib_mode="naive", fold=False)
+
+    class _EagerFacade(Block):
+        def __init__(self, inner):
+            super().__init__()
+            self._inner = inner
+
+        def forward(self, x):
+            return self._inner(x)
+
+    requests, _ = sample(n_requests, rs)
+    spec = serve.BucketSpec(batch_sizes=(1, 2, 4),
+                            example_shape=(feat,))
+
+    def run_arm(net):
+        srv = serve.ModelServer(net, spec, max_queue=n_requests + 8,
+                                linger_ms=1.0)
+        srv.start()
+        t0 = time.perf_counter()
+        futs = [srv.submit(x) for x in requests]
+        for f in futs:
+            f.result(timeout=300)
+        dt = time.perf_counter() - t0
+        srv.drain()
+        stats = srv.stats()
+        srv.shutdown()
+        return n_requests / dt, stats
+
+    fp32_rps, fp32_stats = run_arm(fp32)
+    int8_rps, int8_stats = run_arm(q_compiled)
+    eager_rps, _ = run_arm(_EagerFacade(q_eager_inner))
+
+    # quality + parity on held-out data (after serving: direct forwards
+    # would otherwise add executables under the servers' counters)
+    xe, _ = sample(500, np.random.RandomState(42))
+    ref = fp32(nd.array(xe)).asnumpy()
+    got = q_compiled(nd.array(xe)).asnumpy()
+    agreement = float((got.argmax(1) == ref.argmax(1)).mean())
+    xb = xe[:16]
+    compiled_out = q_compiled(nd.array(xb)).asnumpy()
+    q_compiled._active = False
+    eager_out = q_compiled(nd.array(xb)).asnumpy()
+    q_compiled._active = True
+    bit_identical = bool(np.array_equal(compiled_out, eager_out))
+
+    import jax
+
+    dev = jax.devices()[0]
+    print(json.dumps({
+        "metric": "serve_int8_throughput",
+        "value": round(int8_rps, 2),
+        "unit": "requests/sec",
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "n_requests": n_requests,
+        "fp32_rps": round(fp32_rps, 2),
+        "eager_int8_rps": round(eager_rps, 2),
+        "speedup_vs_eager_int8": round(int8_rps / eager_rps, 4),
+        "speedup_vs_fp32": round(int8_rps / fp32_rps, 4),
+        "agreement_argmax_vs_fp32": agreement,
+        "compiled_eager_bit_identical": bit_identical,
+        "p50_ms": int8_stats["latency"]["p50_ms"],
+        "p99_ms": int8_stats["latency"]["p99_ms"],
+        "post_warmup_compiles": int8_stats["graph"]
+        ["post_warmup_compiles"],
+        "fp32_post_warmup_compiles": fp32_stats["graph"]
+        ["post_warmup_compiles"],
+    }))
+
+
 def _leaf_serve_decode(platform):
     """Continuous-batching decode A/B (mxnet_tpu.serve.DecodeServer):
     the same staggered request stream decoded twice through the same
@@ -960,6 +1106,7 @@ def _leaf_recovery(platform):
 
 _LEAVES = {"resnet": _leaf_resnet, "bert": _leaf_bert,
            "serve": _leaf_serve, "serve_decode": _leaf_serve_decode,
+           "serve_int8": _leaf_serve_int8,
            "trainer_step": _leaf_trainer_step,
            "input_pipeline": _leaf_input_pipeline,
            "recovery": _leaf_recovery}
@@ -1126,7 +1273,8 @@ def main():
     # are satellites of the two north-star workloads and must never
     # delay or demote them
     for model in ("bert", "resnet", "serve", "serve_decode",
-                  "trainer_step", "input_pipeline", "recovery"):
+                  "serve_int8", "trainer_step", "input_pipeline",
+                  "recovery"):
         rec, tpu_ok = _measure(model, tpu_ok, note)
         if rec is not None:
             records[model] = rec

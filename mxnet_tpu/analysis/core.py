@@ -109,8 +109,14 @@ class AnalysisConfig:
     # the CachedOp graph fn, the whole-step trainer closure, and the
     # ZeRO-1 sharded update it lowers into — host syncs anywhere inside
     # any of them are lint errors (MXA201)
+    # also the quantized-block forward bodies: they run inside CachedOp/
+    # CachedStepOp captures, so a host sync there stalls every int8
+    # serve batch
     traced_names: tuple = ("_cached_graph_fn", "_whole_step_fn",
-                           "apply_zero_step_plan", "_step_graph_fn")
+                           "apply_zero_step_plan", "_step_graph_fn",
+                           "_quantized_dense_forward",
+                           "_quantized_conv_forward",
+                           "_finish_quantized")
     getenv_fns: tuple = ("getenv",)
     fault_point_fns: tuple = ("fault_point",)
     # telemetry catalog (MXA403/MXA405): how sections register, which

@@ -68,6 +68,31 @@ class HookHandle:
         self.detach()
 
 
+def _int8_container_mismatch(params, loaded):
+    """Detect an fp32 ↔ int8 .params container mismatch before the
+    generic missing-parameter error hides it: loading an fp32 file into
+    an INT8-quantized net (or vice versa) silently loads nothing and
+    reconstructs garbage unless it fails HERE with a diagnosis."""
+    def has(keys, suffix):
+        return any(k == suffix or k.endswith("." + suffix)
+                   or k.endswith("_" + suffix) for k in keys)
+
+    net_q, file_q = has(params, "qweight"), has(loaded, "qweight")
+    if net_q and not file_q and has(loaded, "weight"):
+        return ("file holds fp32 parameters but this network is "
+                "INT8-quantized — re-quantize them via contrib."
+                "quantization.apply_fp32_params(net, nd.load(file)) "
+                "(ModelServer/DecodeServer reload_weights() does this "
+                "automatically), or save from the quantized net itself")
+    if file_q and not net_q and has(params, "weight"):
+        return ("file holds INT8-quantized parameters but this network "
+                "is fp32 — rebuild the target with contrib.quantization"
+                ".quantize_net (same architecture + calibration config) "
+                "before loading, or load the fp32 training checkpoint "
+                "instead")
+    return None
+
+
 class Block:
     """Base container for layers & parameters (ref: gluon.Block)."""
 
@@ -186,6 +211,9 @@ class Block:
         if loaded and params and not any(k in params for k in loaded):
             # fall back to full-prefix names (collect_params keys)
             params = dict(self.collect_params().items())
+        mismatch = _int8_container_mismatch(params, loaded)
+        if mismatch:
+            raise MXNetError(f"{filename}: {mismatch}")
         for name, p in params.items():
             if name in loaded:
                 p.shape = loaded[name].shape

@@ -73,8 +73,12 @@ register("_contrib_quantize_v2", _k_quantize_v2, arg_names=("data",),
 
 
 def _k_dequantize(data, min_range, max_range, *, out_type="float32"):
-    mn = jnp.asarray(min_range, jnp.float32).reshape(())
-    mx = jnp.asarray(max_range, jnp.float32).reshape(())
+    # ranges BROADCAST against data instead of being forced scalar: the
+    # per-channel int8 kernels below thread (C,)-shaped (or (C,1,...))
+    # range vectors through the same (q, min, max) triple protocol, so
+    # one dequantize serves per-tensor and per-channel alike
+    mn = jnp.asarray(min_range, jnp.float32)
+    mx = jnp.asarray(max_range, jnp.float32)
     if data.dtype == jnp.uint8:
         return mn + data.astype(jnp.float32) * (mx - mn) / 255.0
     if data.dtype == jnp.int32:
@@ -103,6 +107,32 @@ def _k_requantize(data, min_range, max_range, *, min_calib_range=None,
 register("_contrib_requantize", _k_requantize,
          arg_names=("data", "min_range", "max_range"),
          aliases=("requantize",), num_outputs=3, nondiff=True)
+
+
+def _k_requantize_v2(data, min_range, max_range, min_calib, max_calib, *,
+                     act=None):
+    """Array-calibrated requantize — the fold op.
+
+    Same math as ``_k_requantize`` (dequantize at the incoming — possibly
+    per-channel — range, re-quantize symmetric int8 at the calibrated
+    range), but the calibrated range arrives as ARRAYS so it can live as
+    a runtime parameter of a compiled graph (re-calibration needs no
+    recompile), and an optional relu is applied IN int8: symmetric
+    scaling commutes with relu (``dequant(max(q,0)) == relu(dequant(q))``),
+    so a calibrated relu layer keeps its activations int8 end-to-end.
+    """
+    real = _k_dequantize(data, min_range, max_range)
+    r = _abs_range(jnp.asarray(min_calib, jnp.float32).reshape(()),
+                   jnp.asarray(max_calib, jnp.float32).reshape(()))
+    q = _q8(real, r)
+    if act == "relu":
+        q = jnp.maximum(q, jnp.int8(0))
+    return q, -r, r
+
+register("_contrib_requantize_v2", _k_requantize_v2,
+         arg_names=("data", "min_range", "max_range", "min_calib",
+                    "max_calib"),
+         aliases=("requantize_v2",), num_outputs=3, nondiff=True)
 
 
 # ---------------------------------------------------------------------------
@@ -200,6 +230,85 @@ register("_contrib_quantized_conv", _k_quantized_conv,
          arg_names=("data", "weight", "bias", "min_data", "max_data",
                     "min_weight", "max_weight", "min_bias", "max_bias"),
          aliases=("quantized_conv",), num_outputs=3, nondiff=True)
+
+
+# ---------------------------------------------------------------------------
+# per-channel compute ops: the compile-native quantize_net path.
+# Weight ranges arrive as a PER-OUTPUT-CHANNEL vector (shape (C,), or
+# (1,) for per-tensor) instead of scalar min/max — per-channel scaling
+# closes most of the accuracy gap symmetric per-tensor scaling leaves
+# (one outlier row no longer wrecks every other row's resolution).  The
+# fp32 bias is re-quantized to the per-channel accumulator scale
+# s_data*s_weight_c INSIDE the kernel (ref: quantization_utils.h bias
+# handling, generalized per channel), and the int32 output's range rides
+# the triple protocol as a broadcastable vector so the stock
+# dequantize/requantize_v2 close the chain.
+
+
+def _ranges_i32_pc(min_data, max_data, wrange, bcast_shape):
+    """Scalar data range x per-channel weight range -> (r_d, r_w, r_out)
+    with r_out shaped to broadcast against the int32 accumulator."""
+    r_d = _abs_range(jnp.asarray(min_data, jnp.float32).reshape(()),
+                     jnp.asarray(max_data, jnp.float32).reshape(()))
+    r_w = jnp.maximum(jnp.asarray(wrange, jnp.float32).reshape(-1), 1e-30)
+    r_o = (r_d * r_w * (_INT32_MAX / (127.0 * 127.0))).reshape(bcast_shape)
+    return r_d, r_w, r_o
+
+
+def _bias_to_i32_pc(bias, r_d, r_w):
+    s = (127.0 / jnp.maximum(r_d, 1e-30)) * (127.0 / r_w)
+    return jnp.round(bias.astype(jnp.float32) * s).astype(jnp.int32)
+
+
+def _k_quantized_dense_pc(data, weight, wrange, *rest, num_hidden,
+                          no_bias=False, flatten=True):
+    if no_bias:
+        bias = None
+        min_data, max_data = rest[:2]
+    else:
+        bias, min_data, max_data = rest[:3]
+    x = data.reshape(data.shape[0], -1) if flatten and data.ndim > 2 else data
+    out = lax.dot_general(x, weight, (((x.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    r_d, r_w, r_o = _ranges_i32_pc(min_data, max_data, wrange, (-1,))
+    if not no_bias and bias is not None:
+        out = out + _bias_to_i32_pc(bias, r_d, r_w)
+    return out, -r_o, r_o
+
+register("_contrib_quantized_dense_pc", _k_quantized_dense_pc,
+         arg_names=("data", "weight", "wrange", "bias", "min_data",
+                    "max_data"),
+         aliases=("quantized_dense_pc",), num_outputs=3, nondiff=True)
+
+
+def _k_quantized_conv_pc(data, weight, wrange, *rest, kernel, stride=(),
+                         dilate=(), pad=(), num_filter=0, num_group=1,
+                         no_bias=False):
+    if no_bias:
+        bias = None
+        min_data, max_data = rest[:2]
+    else:
+        bias, min_data, max_data = rest[:3]
+    nd = len(kernel)
+    stride = stride or (1,) * nd
+    dilate = dilate or (1,) * nd
+    pad = pad or (0,) * nd
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_DIMS[nd])
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group, preferred_element_type=jnp.int32)
+    r_d, r_w, r_o = _ranges_i32_pc(min_data, max_data, wrange,
+                                   (-1,) + (1,) * nd)
+    if not no_bias and bias is not None:
+        b = _bias_to_i32_pc(bias, r_d, r_w)
+        out = out + b.reshape((1, -1) + (1,) * nd)
+    return out, -r_o, r_o
+
+register("_contrib_quantized_conv_pc", _k_quantized_conv_pc,
+         arg_names=("data", "weight", "wrange", "bias", "min_data",
+                    "max_data"),
+         aliases=("quantized_conv_pc",), num_outputs=3, nondiff=True)
 
 
 def _k_quantized_pooling(data, min_data, max_data, *, kernel=(), pool_type="max",

@@ -275,6 +275,22 @@ def _decode_serve_counters(reset=False):
     return stats
 
 
+def _quantize_counters(reset=False):
+    """INT8 quantization counters (layers quantized, calibration
+    batches + wall time, requantize folds, compiled int8 serve
+    batches) — window-scoped under reset=True exactly like every other
+    section; only present when the quantization tier is loaded."""
+    import sys
+
+    qz = sys.modules.get(__package__ + ".contrib.quantization")
+    if qz is None:
+        return None
+    stats = qz.quantize_stats()
+    if reset:
+        qz.reset_quantize_stats()
+    return stats
+
+
 def _telemetry_counters(reset=False):
     """Telemetry-subsystem counters (spans/instants/requests recorded,
     drops, flight dumps, scrapes, aggregations) — window-scoped under
@@ -405,6 +421,13 @@ register_section("decodeServe", _decode_serve_counters, _rows_table(
      ("requests finished", "finished"),
      ("deadline expiries", "expired_deadlines"),
      ("slot occupancy (mean live/max)", "slot_occupancy"))))
+register_section("quantize", _quantize_counters, _rows_table(
+    "INT8 Quantization",
+    (("layers quantized", "layers_quantized"),
+     ("calibration batches", "calib_batches"),
+     ("calibration time (ms)", "calib_ms"),
+     ("requantize folds", "requant_folds"),
+     ("int8 serve batches", "int8_serve_batches"))))
 register_section("telemetry", _telemetry_counters, _rows_table(
     "Telemetry (tracer / flight recorder / metrics)",
     (("spans recorded", "spans"),

@@ -73,6 +73,7 @@ from ..telemetry import tracer as _tracer
 from .batcher import (Batcher, DeadlineExceededError, _Request,
                       ServerClosedError, ServerOverloadedError)
 from .buckets import BucketSpec
+from .server import _int8_batch_hook
 from .stats import LatencyWindow, ServerStats
 
 #: counter set for the decode tier (same ServerStats machinery as
@@ -316,6 +317,31 @@ class DecodeServer:
                 f"got {admission!r}")
         self._model = model
         self._spec = spec
+        # an int8-quantized decode model (quantize_net output) books
+        # its prefill groups and token steps into the `quantize`
+        # profiler section; reload_weights() re-quantizes fp32
+        # checkpoints
+        self._int8 = bool(getattr(model, "_int8_quantized", False))
+        self._note_int8 = _int8_batch_hook(model)
+        if self._int8:
+            # the decode path requires CALIBRATED quantization: a
+            # dynamic range is a jnp.min/max over the whole slot arena,
+            # so one request's quantization would depend on co-resident
+            # (including garbage inactive) slots — silently breaking
+            # the per-slot independence / continuous==batch parity
+            # contract.  Fail at construction, not per-token.
+            from ..contrib.quantization import _iter_quantized
+
+            uncal = [w.name for _, w in _iter_quantized(model)
+                     if not w._calibrated]
+            if uncal:
+                raise MXNetError(
+                    f"DecodeServer needs CALIBRATED quantization: "
+                    f"layer(s) {uncal} quantize with dynamic per-batch "
+                    "ranges, which reduce over the whole slot arena "
+                    "and couple independent requests; re-run "
+                    "quantize_net with calib_data= "
+                    "(docs/quantization.md)")
         self._slots = int(max_slots if max_slots is not None
                           else getenv("DECODE_SLOTS", 8, int))
         self._max_len = int(max_len if max_len is not None
@@ -638,6 +664,8 @@ class DecodeServer:
             real_elems=sum(r.length for r in group),
             padded_elems=batch * length)
         _sec_bump(prefill_batches=1)
+        if self._int8:
+            self._note_int8()
         now = time.monotonic()
         for i, req in enumerate(group):
             slot = slots[i]
@@ -709,6 +737,8 @@ class DecodeServer:
         step_ms = (now - t0) * 1e3
         self._step_count += 1
         self._stats.incr("decode_steps")
+        if self._int8:
+            self._note_int8()
         with self._occ_lock:
             self._token_lat.record(step_ms)
             self._occ_sum += live / self._slots
@@ -805,8 +835,22 @@ class DecodeServer:
                 "checkpoint=...) to enable reload_weights()")
         with self._exec_lock:
             with profiler.op_scope("serve.reload", cat="serve"):
-                meta = self._ckpt.restore(step=step, params=self._model,
-                                          restore_rng=False)
+                if self._int8:
+                    # quantized decode model: int8-native checkpoints
+                    # restore directly, fp32 training checkpoints
+                    # re-quantize against the stored scales — either
+                    # way zero recompiles (runtime graph inputs)
+                    meta = self._ckpt.restore(step=step,
+                                              restore_rng=False)
+                    from ..contrib.quantization import \
+                        load_serving_params
+
+                    load_serving_params(self._model,
+                                        meta.get("params") or {})
+                else:
+                    meta = self._ckpt.restore(step=step,
+                                              params=self._model,
+                                              restore_rng=False)
         self._stats.incr("reloads")
         return {"step": meta["step"], "epoch": meta.get("epoch")}
 
@@ -873,36 +917,56 @@ class TinyDecoder(Block):
       (the acceptance parity gate);
     - inactive slots are masked out of cache writes and divide by
       ``max(cursor+1, 1)``, so garbage slots can never NaN the batch.
+
+    With ``proj_block=True`` the output projection is an ``nn.Dense``
+    CHILD block instead of a raw parameter, which makes the model
+    quantizable: ``contrib.quantization.quantize_net(model, ...)``
+    swaps the projection for a compiled int8 Dense and the whole decode
+    step (CachedStepOp) carries the int8 matmul — the INT8 decode path.
+    Per-slot independence survives because calibrated ranges are
+    runtime constants, not batch reductions.
     """
 
-    def __init__(self, vocab=64, embed=16, prefix=None, params=None):
+    def __init__(self, vocab=64, embed=16, proj_block=False, prefix=None,
+                 params=None):
         super().__init__(prefix=prefix, params=params)
         self.vocab = int(vocab)
         self.embed_dim = int(embed)
+        self._proj_block = bool(proj_block)
         self.embedding = self.params.get("embedding",
                                          shape=(vocab, embed))
-        self.proj = self.params.get("proj", shape=(embed, vocab))
+        if proj_block:
+            from ..gluon import nn as _gnn
 
-    def _weights(self):
-        return (self.embedding.data()._data, self.proj.data()._data)
+            self.proj = _gnn.Dense(vocab, use_bias=False, flatten=False,
+                                   in_units=embed)
+        else:
+            self.proj = self.params.get("proj", shape=(embed, vocab))
+
+    def _logits(self, h):
+        """Raw (..., d) hidden -> raw (..., vocab) logits, through the
+        Dense child (quantizable) or the raw projection parameter."""
+        if self._proj_block:
+            return self.proj(_wrap(h))._data
+        return h @ self.proj.data()._data
 
     def prefill(self, prompts, lengths):
         import jax.numpy as jnp
 
-        E, W = self._weights()
+        E = self.embedding.data()._data
         p = prompts._data                      # (B, L) int32
         ln = lengths._data                     # (B,) int32
         emb = jnp.take(E, p, axis=0)           # (B, L, d)
         m = (jnp.arange(emb.shape[1])[None, :] < ln[:, None])
         h = jnp.sum(emb * m[..., None].astype(emb.dtype), axis=1) \
             / jnp.maximum(ln, 1).astype(emb.dtype)[:, None]
-        first = jnp.argmax(h @ W, axis=-1).astype(jnp.int32)
+        first = jnp.argmax(self._logits(h), axis=-1).astype(jnp.int32)
         return _wrap(first), _wrap(emb)
 
     def decode_step(self, tokens, cursors, active, cache):
         import jax.numpy as jnp
 
-        E, W = self._weights()
+        E = self.embedding.data()._data
         t, cur = tokens._data, cursors._data
         act, c = active._data, cache._data
         e = jnp.take(E, t, axis=0)             # (S, d)
@@ -912,5 +976,5 @@ class TinyDecoder(Block):
         seen = (pos <= cur[:, None])
         h = jnp.sum(c * seen[..., None].astype(c.dtype), axis=1) \
             / jnp.maximum(cur + 1, 1).astype(c.dtype)[:, None]
-        nxt = jnp.argmax(h @ W, axis=-1).astype(jnp.int32)
+        nxt = jnp.argmax(self._logits(h), axis=-1).astype(jnp.int32)
         return _wrap(nxt), _wrap(c)

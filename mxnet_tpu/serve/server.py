@@ -45,6 +45,18 @@ from .batcher import (Batcher, DeadlineExceededError, _Request,
 from .buckets import BucketSpec
 from .stats import ServerStats
 
+def _int8_batch_hook(block):
+    """The `quantize`-section booking hook for a served net, or None
+    for fp32 nets (call sites guard on the server's ``_int8`` flag).
+    Resolved once per server: a ``quantize_net`` output's construction
+    already imported the quantization tier, so serve stays free of the
+    import otherwise."""
+    if not getattr(block, "_int8_quantized", False):
+        return None
+    from ..contrib.quantization import note_int8_serve_batch
+
+    return note_int8_serve_batch
+
 
 class ModelServer:
     """Serve a gluon block behind an async dynamically-batched queue.
@@ -76,6 +88,11 @@ class ModelServer:
         self._net = block
         self._spec = spec
         self._ctx = ctx
+        # quantize_net marks its output; an int8 net books its batches
+        # into the `quantize` profiler section and hot-reloads fp32
+        # training checkpoints via re-quantization
+        self._int8 = bool(getattr(block, "_int8_quantized", False))
+        self._note_int8 = _int8_batch_hook(block)
         self._batcher = Batcher(max_queue=max_queue, linger_ms=linger_ms)
         self._stats = ServerStats()
         self._exec_lock = threading.Lock()   # batch exec XOR reload
@@ -312,6 +329,8 @@ class ModelServer:
                 real_elems=sum(int(np.prod(r.example.shape))
                                for r in group),
                 padded_elems=batch * int(np.prod(padded.shape[1:])))
+            if self._int8:
+                self._note_int8()
             now = time.monotonic()
             with profiler.op_scope("serve.split", cat="serve"):
                 for i, req in enumerate(group):
@@ -366,6 +385,13 @@ class ModelServer:
         batch finishes on the old weights, the next starts on the new —
         no torn reads, no recompile (parameters are runtime graph
         inputs, so the bucket executables are reused as-is).
+
+        A QUANTIZED net (``contrib.quantization.quantize_net`` output)
+        accepts both checkpoint flavors: int8-native checkpoints (saved
+        from the quantized net) restore directly, fp32 training
+        checkpoints are re-quantized in place against the stored scales
+        — still no recompile, since every scale/range is a runtime
+        graph input.
         """
         if self._ckpt is None:
             raise MXNetError(
@@ -373,8 +399,17 @@ class ModelServer:
                 "checkpoint=...) to enable reload_weights()")
         with self._exec_lock:
             with profiler.op_scope("serve.reload", cat="serve"):
-                meta = self._ckpt.restore(step=step, params=self._net,
-                                          restore_rng=False)
+                if self._int8:
+                    meta = self._ckpt.restore(step=step,
+                                              restore_rng=False)
+                    from ..contrib.quantization import \
+                        load_serving_params
+
+                    load_serving_params(self._net,
+                                        meta.get("params") or {})
+                else:
+                    meta = self._ckpt.restore(step=step, params=self._net,
+                                              restore_rng=False)
         self._stats.incr("reloads")
         return {"step": meta["step"], "epoch": meta.get("epoch")}
 

@@ -1,0 +1,209 @@
+"""`make int8-smoke`: compiled INT8 serving CI gate.
+
+Trains a small classifier (real decision margins — the quality band is
+meaningless on iid-random logits), calibrates + quantizes a twin with
+`contrib.quantization.quantize_net`, and serves a request burst through
+ModelServer plus a quantized decode burst through DecodeServer,
+asserting the INT8 serving invariants from docs/quantization.md:
+
+    graph.post_warmup_compiles == 0        (closed compile surface)
+    dispatch delta == batches              (ModelServer: ONE executable
+                                            per batch, nothing eager
+                                            leaks into the hot path)
+    dispatch delta == steps + admissions   (DecodeServer: one per token
+                                            step, one per fused
+                                            prefill+write group)
+    argmax agreement vs fp32 >= 99%        (quality band, held-out data)
+    compiled == eager BIT-identical        (one fused executable ==
+                                            the per-op eager bytes)
+    requant folds happened; activations travel int8 between layers
+    int8_serve_batches booked in the `quantize` profiler section
+
+Exit code 0 = every invariant holds.  Runs on the CPU backend so it is
+chip-independent.
+"""
+import json
+import sys
+
+
+def _train_classifier(mx, nd, nn, steps=150):
+    import numpy as np
+
+    from mxnet_tpu import autograd, gluon
+
+    rs = np.random.RandomState(0)
+    centers = rs.randn(10, 32).astype(np.float32) * 2.0
+
+    def sample(n, rng):
+        y = rng.randint(0, 10, n)
+        x = (centers[y] + rng.randn(n, 32)).astype(np.float32)
+        return x, y.astype(np.int32)
+
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, activation="relu", in_units=32, flatten=False),
+            nn.Dense(64, activation="relu", in_units=64, flatten=False),
+            nn.Dense(10, in_units=64, flatten=False))
+    net.initialize(mx.init.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    for _ in range(steps):
+        x, y = sample(64, rs)
+        with autograd.record():
+            loss = loss_fn(net(nd.array(x)), nd.array(y))
+        loss.backward()
+        trainer.step(64)
+    return net, sample
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import _imperative, nd, profiler, serve
+    from mxnet_tpu.contrib import quantization as qz
+    from mxnet_tpu.gluon import nn
+
+    failures = []
+
+    def check(name, cond):
+        if not cond:
+            failures.append(name)
+
+    # ---- calibrate -> quantize ------------------------------------------
+    fp32, sample = _train_classifier(mx, nd, nn)
+    rs = np.random.RandomState(1)
+    calib, _ = sample(256, rs)
+    qz.reset_quantize_stats()
+    qnet = qz.quantize_net(_copy_net(mx, nn, fp32), calib_data=calib,
+                           calib_mode="entropy")
+    st = qz.quantize_stats()
+    check("3 layers quantized", st["layers_quantized"] == 3)
+    check("requantize folds happened", st["requant_folds"] == 2)
+    check("calibration cost visible", st["calib_ms"] > 0
+          and st["calib_batches"] >= 1)
+
+    # int8 boundary really is int8 between folded layers
+    probe = qnet._layers[0](nd.array(calib[:2]))
+    check("folded boundary carries int8", probe.dtype == np.int8)
+
+    # compiled-vs-eager bit parity on one bucket-shaped batch
+    xb, _ = sample(8, rs)
+    eager = qnet(nd.array(xb)).asnumpy()
+    qnet.hybridize()
+    compiled = qnet(nd.array(xb)).asnumpy()
+    check("compiled == eager bit-identical",
+          np.array_equal(eager, compiled))
+
+    # ---- quality band (held-out) ----------------------------------------
+    xe, _ = sample(500, np.random.RandomState(42))
+    ref = fp32(nd.array(xe)).asnumpy()
+    got = qnet(nd.array(xe)).asnumpy()
+    agreement = float((got.argmax(1) == ref.argmax(1)).mean())
+    check("argmax agreement >= 99% vs fp32", agreement >= 0.99)
+
+    # ---- serve burst through ModelServer --------------------------------
+    attempts = 60
+    spec = serve.BucketSpec(batch_sizes=(1, 2, 4, 8),
+                            example_shape=(32,))
+    srv = serve.ModelServer(qnet, spec, max_queue=attempts + 8,
+                            linger_ms=1.0)
+    srv.start()
+    d0 = _imperative.device_dispatch_count()
+    xs, _ = sample(attempts, rs)
+    futs = [srv.submit(x) for x in xs]
+    for f in futs:
+        f.result(timeout=300)
+    srv.drain()
+    d1 = _imperative.device_dispatch_count()
+    s = srv.stats()
+    check("zero post-warmup compiles (ModelServer)",
+          s["graph"]["post_warmup_compiles"] == 0)
+    check("exact dispatch accounting: one executable per batch",
+          d1 - d0 == s["batches"])
+    check("every request served", s["served"] == s["submitted"]
+          == attempts)
+    check("accounting invariant",
+          s["served"] + s["expired_deadline"] + s["failed"]
+          + s["cancelled"] == s["submitted"])
+    sec = profiler.sections().get("quantize", {})
+    check("int8 batches booked in the quantize section",
+          sec.get("int8_serve_batches") == s["batches"] > 0)
+
+    # ---- INT8 decode path through DecodeServer --------------------------
+    mx.random.seed(0)
+    model = serve.TinyDecoder(vocab=64, embed=16, proj_block=True)
+    model.initialize(mx.init.Xavier())
+    dcal = rs.randint(0, 64, size=(16, 8)).astype(np.int32)
+
+    def calib_fwd(m, x):
+        b, length = x.shape
+        m.prefill(x, nd.array(np.full(b, length, np.int32)))
+
+    qz.quantize_net(model, calib_data=dcal, calib_mode="naive",
+                    calib_forward=calib_fwd)
+    dspec = serve.BucketSpec(batch_sizes=(1, 2, 4), example_shape=(None,),
+                             lengths=(4, 8), dtype="int32")
+    dsrv = serve.DecodeServer(model, dspec, max_slots=4, max_len=32,
+                              max_queue=64)
+    dsrv.start()
+    d0 = _imperative.device_dispatch_count()
+    handles = [dsrv.submit(
+        rs.randint(0, 64, size=int(rs.randint(2, 9))).astype(np.int32),
+        max_new_tokens=int(rs.randint(1, 10))) for _ in range(24)]
+    for h in handles:
+        h.result(timeout=300)
+    dsrv.drain()
+    d1 = _imperative.device_dispatch_count()
+    ds = dsrv.stats()
+    check("zero post-warmup compiles (DecodeServer)",
+          ds["graph"]["post_warmup_compiles"] == 0)
+    check("exact decode dispatch accounting (steps + admissions)",
+          d1 - d0 == ds["decode_steps"] + ds["batches"])
+    check("every decode request served",
+          ds["served"] == ds["submitted"] == 24)
+
+    print(json.dumps({
+        "agreement_argmax": agreement,
+        "serve": {k: s[k] for k in ("served", "batches",
+                                    "batch_fill_ratio")},
+        "serve_graph": s["graph"],
+        "decode": {k: ds[k] for k in ("served", "decode_steps",
+                                      "batches", "tokens")},
+        "decode_graph": ds["graph"],
+        "quantize_section": profiler.sections().get("quantize"),
+    }, default=str))
+
+    if failures:
+        print("int8-smoke FAILED: " + "; ".join(failures),
+              file=sys.stderr)
+        return 1
+    print(f"int8-smoke OK: {s['served']} requests + {ds['tokens']} "
+          f"decode tokens served int8, agreement={agreement}, "
+          f"0 post-warmup compiles, "
+          f"{s['batches']} + {ds['decode_steps'] + ds['batches']} "
+          f"dispatches accounted")
+    return 0
+
+
+def _copy_net(mx, nn, src):
+    """Fresh identical architecture carrying src's exact weights."""
+    mx.random.seed(123)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, activation="relu", in_units=32, flatten=False),
+            nn.Dense(64, activation="relu", in_units=64, flatten=False),
+            nn.Dense(10, in_units=64, flatten=False))
+    net.initialize(mx.init.Xavier())
+    for dst_p, src_p in zip(net.collect_params().values(),
+                            src.collect_params().values()):
+        dst_p.set_data(src_p.data())
+    return net
+
+
+if __name__ == "__main__":
+    sys.exit(main())
