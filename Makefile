@@ -102,6 +102,16 @@ pipeline-smoke:
 chaos-smoke:
 	env PYTHONPATH=. python tools/chaos_smoke.py
 
+# elastic world-size gate: kill k of N virtual ranks mid-run — the
+# supervisor resizes to N-k (the resize itself surviving an injected
+# transient failure), the resharding restore repartitions the latest
+# checkpoint, and the resumed run is bit-identical to a fresh job
+# started at N-k, at exactly one resize recompile then 1 dispatch /
+# 0 compiles per step — see tools/elastic_smoke.py /
+# docs/checkpointing.md "Elastic restore"
+elastic-smoke:
+	env PYTHONPATH=. python tools/elastic_smoke.py
+
 # observability gate: one traced train+serve run emits spans from all
 # five subsystems into valid Chrome trace-event JSON, an injected
 # watchdog fire leaves a loadable flight-recorder dump, /metrics
@@ -120,7 +130,7 @@ analyze:
 
 # the ROADMAP tier-1 gate, verbatim ($$ = make-escaped shell $)
 verify: SHELL := /bin/bash
-verify: analyze serve-smoke decode-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke trace-smoke
+verify: analyze serve-smoke decode-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke elastic-smoke trace-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
-.PHONY: all clean test verify analyze serve-smoke decode-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke trace-smoke
+.PHONY: all clean test verify analyze serve-smoke decode-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke elastic-smoke trace-smoke

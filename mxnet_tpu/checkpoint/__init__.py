@@ -5,3 +5,5 @@ multi-process layout, retention, and the SIGTERM preemption hook.
 """
 from .atomic import atomic_file, fsync_dir, fsync_file, write_json  # noqa: F401
 from .manager import MANIFEST, CheckpointManager, latest  # noqa: F401
+from .reshard import (merge_pipeline_states,  # noqa: F401
+                      reshard_zero_snapshot, source_rank)

@@ -39,7 +39,7 @@ import threading
 from .. import engine, profiler
 from .. import random as _random
 from ..base import MXNetError
-from . import atomic
+from . import atomic, reshard as _reshard
 
 MANIFEST = "MANIFEST.json"
 
@@ -446,7 +446,7 @@ class CheckpointManager:
     # -- restore ------------------------------------------------------------
 
     def restore(self, step=None, params=None, trainer=None, pipeline=None,
-                restore_rng=True):
+                restore_rng=True, strict_topology=False):
         """Load checkpoint `step` (default: ``latest()``) in place.
 
         params/trainer/pipeline mirror ``save()`` targets; parameters
@@ -461,6 +461,17 @@ class CheckpointManager:
         "params"}`` — "params" is the loaded name->NDArray dict only
         when no target was given.
 
+        A checkpoint saved by a DIFFERENT world size (a 16-rank job
+        preempted down to 8, or scaled up) is RESHARDED onto this
+        job's topology: rank-replicated param/RNG shards remap, ZeRO-1
+        optimizer flat shards gather and re-slice onto the new layout,
+        and per-rank pipeline cursors merge under the rank-symmetric
+        ``shard()`` contract (see :mod:`.reshard` /
+        docs/checkpointing.md "Elastic restore").  Jobs that must NOT
+        silently reshard — model-parallel layouts with genuinely
+        rank-distinct parameters — pass ``strict_topology=True`` to
+        restore the loud world-size rejection.
+
         With ``step=None`` a corrupt or truncated newest step does NOT
         raise: it is logged loudly and the previous retained step is
         restored instead (checkpoints exist to survive exactly this),
@@ -471,7 +482,8 @@ class CheckpointManager:
         self.wait_until_finished()
         if step is not None:
             return self._restore_step(int(step), params, trainer,
-                                      pipeline, restore_rng)
+                                      pipeline, restore_rng,
+                                      strict_topology)
         steps = self.steps()
         if not steps:
             raise MXNetError(
@@ -482,7 +494,7 @@ class CheckpointManager:
         for s in reversed(steps):
             try:
                 meta = self._restore_step(s, params, trainer, pipeline,
-                                          restore_rng)
+                                          restore_rng, strict_topology)
             except Exception as e:  # noqa: BLE001 — filtered below
                 if not _is_fallback_skippable(e):
                     if failures:
@@ -520,7 +532,8 @@ class CheckpointManager:
             + "; ".join(f"step {s}: {_first_line(e)[:150]}"
                         for s, e in failures))
 
-    def _restore_step(self, step, params, trainer, pipeline, restore_rng):
+    def _restore_step(self, step, params, trainer, pipeline, restore_rng,
+                      strict_topology=False):
         d = self._dir_for(int(step))
         mpath = os.path.join(d, MANIFEST)
         if not os.path.isfile(mpath):
@@ -543,31 +556,47 @@ class CheckpointManager:
                 f"{mpath}: checkpoint format v{ver} was written by a "
                 f"newer mxnet_tpu (this build reads <= "
                 f"v{self.FORMAT_VERSION}); upgrade to restore it")
-        saved_procs = manifest.get("num_processes", 1)
-        if saved_procs != _num_processes():
+        saved_procs = int(manifest.get("num_processes", 1))
+        procs = _num_processes()
+        resharding = saved_procs != procs
+        if resharding and strict_topology:
             raise MXNetError(
                 f"{mpath}: world-size mismatch — checkpoint was saved "
                 f"by a {saved_procs}-process job but this job runs "
-                f"{_num_processes()} process(es). Per-rank parameter/"
-                "pipeline shards do not re-partition across world "
-                "sizes yet (elastic resharding is ROADMAP item 2); "
-                "restore with the original topology. ZeRO-1 sharded "
-                "optimizer state alone CAN cross world sizes: every "
-                "trainer-shard<r>.states file is gathered on restore "
-                "into canonical per-param states "
-                "(Trainer.load_states_dict gather path, see "
-                "docs/checkpointing.md), so a job restarted at the "
-                "saved world size may flip zero_shard freely")
+                f"{procs} process(es), and strict_topology=True "
+                "forbids elastic resharding. Drop strict_topology to "
+                "repartition the checkpoint onto this topology "
+                "(rank-replicated param/RNG shards remap, ZeRO-1 "
+                "optimizer shards gather and re-slice, per-rank "
+                "pipeline cursors merge under the rank-symmetric "
+                "shard() contract), or restore with the original "
+                "world size. See docs/checkpointing.md, 'Elastic "
+                "restore'.")
         rank = _rank()
+        src = _reshard.source_rank(rank, saved_procs) if resharding \
+            else rank
+        if resharding:
+            # chaos site: a 'raise' fault here makes the RESHARD itself
+            # fail transiently — the elastic supervisor must retry the
+            # resize, not die (the resize-is-retried regression test)
+            engine.fault_point("checkpoint.reshard", kind="topology",
+                              saved_world=saved_procs, world=procs)
+            _get_logger().warning(
+                "elastic restore: repartitioning checkpoint step %s "
+                "saved at world %d onto world %d (rank %d reads saved "
+                "shard %d; pass strict_topology=True to forbid this)",
+                step, saved_procs, procs, rank, src)
         with profiler.op_scope("checkpoint.restore", cat="checkpoint"):
-            loaded = self._restore_params(d, rank, params)
-            self._restore_trainer(d, rank, trainer)
+            loaded = self._restore_params(d, src, params)
+            self._restore_trainer(d, src, trainer)
             if restore_rng:
-                rpath = os.path.join(d, f"rng-shard{rank}.json")
+                rpath = os.path.join(d, f"rng-shard{src}.json")
                 if os.path.isfile(rpath):
                     with open(rpath) as f:
                         _random.set_state(json.load(f))
-            self._restore_pipeline(d, rank, pipeline)
+            self._restore_pipeline(
+                d, src, pipeline,
+                saved_world=saved_procs if resharding else None)
         return {"step": int(manifest["step"]),
                 "epoch": manifest.get("epoch"),
                 "extra": manifest.get("extra"),
@@ -627,10 +656,35 @@ class CheckpointManager:
                 tgt._data = arr._data
         return None
 
-    def _restore_pipeline(self, d, rank, pipeline):
-        pfile = os.path.join(d, f"pipeline-shard{rank}.state")
+    def _restore_pipeline(self, d, rank, pipeline, saved_world=None):
         if pipeline is None:
             return
+        if saved_world is not None:
+            # elastic reshard: read EVERY saved rank's cursor state and
+            # merge under the rank-symmetric shard() contract (the
+            # merge is agreement verification — see reshard.py); the
+            # merged state loads into this rank's rebuilt shard(M, r)
+            # pipeline
+            import time as _time
+
+            t0 = _time.perf_counter()
+            blobs = []
+            for r in range(saved_world):
+                pfile = os.path.join(d, f"pipeline-shard{r}.state")
+                if not os.path.isfile(pfile):
+                    raise MXNetError(
+                        f"{d}: cannot reshard the input pipeline — "
+                        f"saved rank {r}'s pipeline-shard{r}.state is "
+                        f"missing (saved world {saved_world}); was "
+                        "this step saved without pipeline= on every "
+                        "rank?")
+                with open(pfile, "rb") as f:
+                    blobs.append(pickle.load(f))
+            pipeline.load_state_dict(
+                _reshard.merge_pipeline_states(blobs, where=d))
+            _reshard._book_reshard_ms(_time.perf_counter() - t0)
+            return
+        pfile = os.path.join(d, f"pipeline-shard{rank}.state")
         if not os.path.isfile(pfile):
             raise MXNetError(
                 f"{d}: checkpoint has no input-pipeline state for "
@@ -650,7 +704,38 @@ class CheckpointManager:
         with open(tfile, "rb") as f:
             blob = pickle.load(f)
         self._merge_zero_shards(d, blob, own=f"trainer-shard{rank}.states")
+        self._reshard_zero_for(trainer, blob, tfile)
         trainer.load_states_dict(blob, source=tfile)
+
+    @staticmethod
+    def _reshard_zero_for(trainer, blob, tfile):
+        """Elastic ZeRO leg: when the snapshot's shard world differs
+        from the target trainer's replica world AND the trainer runs
+        sharded, re-slice the flat shards onto the new layout on host
+        (``reshard.reshard_zero_snapshot`` — gather, re-pad to the new
+        ``zero_padded_size``, re-slice) so ``load_states_dict`` adopts
+        them directly instead of materializing full per-param states.
+        An unsharded target keeps the gather-on-load path unchanged."""
+        zero = blob.get("zero") if isinstance(blob, dict) else None
+        if not zero or not getattr(trainer, "_zero_shard", False):
+            return
+        try:
+            world = len(trainer._params[0].list_ctx())
+        except Exception:  # no params / uninitialized: gather path
+            return
+        if world <= 1 or int(zero["world"]) == world:
+            return
+        import time as _time
+
+        t0 = _time.perf_counter()
+        engine.fault_point("checkpoint.reshard", kind="zero",
+                          saved_world=int(zero["world"]), world=world)
+        _get_logger().warning(
+            "elastic restore: re-slicing ZeRO-1 optimizer shards from "
+            "world %d onto world %d (%s)",
+            int(zero["world"]), world, tfile)
+        blob["zero"] = _reshard.reshard_zero_snapshot(zero, world)
+        _reshard._book_reshard_ms(_time.perf_counter() - t0)
 
     @staticmethod
     def _merge_zero_shards(d, blob, own=None):

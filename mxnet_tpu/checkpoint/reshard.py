@@ -1,0 +1,204 @@
+"""Elastic checkpoint resharding — repartition a checkpoint saved at
+world size N onto a job running at world size M (ROADMAP "elastic
+world-size"; the redistribution idioms follow arXiv 2112.01075, the
+ZeRO shard-file substrate arXiv 2004.13336).
+
+Three legs, one per saved artifact kind:
+
+- **Parameters / RNG** (``params-shard<r>.params``,
+  ``rng-shard<r>.json``): data-parallel training replicates these
+  across process ranks (every rank commits the same post-allreduce
+  values, every rank seeds the same RNG stream), so the reshard is a
+  shard-file REMAP — rank ``r`` of the new world reads saved shard
+  :func:`source_rank`\\ ``(r, saved_world)``.
+- **ZeRO-1 optimizer flat shards** (the ``"zero"`` snapshot inside
+  ``trainer-shard<r>.states``): genuinely partitioned 1/world per
+  rank.  :func:`reshard_zero_snapshot` gathers each chunk's rank
+  shards on host, drops the old zero-pad, re-pads to the NEW world's
+  ``zero_padded_size`` and re-slices per the new layout — pure
+  reshaping, bit-exact, so N→M→N round-trips to the identical bytes.
+  (Host-side gather is always possible here: the shards were
+  serialized FROM host.  The device-side leg — landing the new shard
+  straight on its replica — is the very next step's traced allgather
+  in ``kvstore``; the restore path never materializes device copies
+  of peers' shards.)
+- **Input-pipeline state** (``pipeline-shard<r>.state``): the
+  ``shard(num_replicas, rank)`` stage contract is rank-symmetric
+  (every rank advances an identically-seeded upstream by identical
+  group counts), so every rank's saved source cursor / shuffle ring /
+  RNG state must AGREE.  :func:`merge_pipeline_states` verifies that
+  agreement stage by stage and returns the merged (common) state,
+  which loads into a pipeline rebuilt with ``shard(M, r)``.  Per-rank
+  in-flight buffers that diverge (a batch-stage rollover remainder
+  mid-group) cannot be repartitioned and raise loudly — checkpoint at
+  a shard-group boundary (``ctx.step_done(save=...)`` does) or rebuild
+  the pipeline from the epoch start.
+
+``CheckpointManager.restore(strict_topology=True)`` disables all of
+this and restores the old loud world-size rejection.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+
+
+def source_rank(rank, saved_world):
+    """The saved shard file rank ``r`` of the new world reads: its own
+    when the saved world covers it, else ``r % saved_world`` (valid
+    because data-parallel param/RNG shards are rank-replicated and
+    pipeline state is rank-symmetric — see the module docstring)."""
+    saved_world = max(int(saved_world), 1)
+    rank = int(rank)
+    return rank if rank < saved_world else rank % saved_world
+
+
+def _book_reshard_ms(dt_s):
+    """Book resharding wall time into the resilience telemetry
+    (``reshard_ms`` in the profiler ``resilience`` section) when that
+    tier is loaded; never a hard dependency."""
+    try:
+        from ..resilience import stats as _rstats
+
+        _rstats.add("reshard_ms", float(dt_s) * 1e3)
+    except Exception:  # pragma: no cover - resilience tier absent
+        pass
+
+
+# -- ZeRO-1 optimizer shards ------------------------------------------------
+
+
+def _shard_np(s):
+    """A shard slot as numpy (snapshots hold NDArrays live, numpy after
+    a pickle round trip)."""
+    return s.asnumpy() if hasattr(s, "asnumpy") else np.asarray(s)
+
+
+def _chunk_of(rank_chunks, c):
+    """Chunk ``c`` of one rank's shard dict (int or str keys — JSON
+    round trips stringify them)."""
+    if c in rank_chunks:
+        return rank_chunks[c]
+    return rank_chunks[str(c)]
+
+
+def reshard_zero_snapshot(zero, new_world):
+    """Repartition a ZeRO-1 optimizer-state snapshot (the ``"zero"``
+    dict of ``Trainer.states_dict()``: world / chunks / per-rank flat
+    shards) from its saved world onto ``new_world`` ranks.
+
+    Per chunk: concatenate the old ranks' shard slots (host-side
+    gather), drop the old zero-pad at ``total``, re-pad to the new
+    world's ``zero_padded_size`` and re-slice into ``new_world`` equal
+    shards — the exact layout a fresh ``new_world`` job's own plan
+    allocates, so ``Trainer.load_states_dict`` adopts the shards
+    directly.  Pure reshaping: bit-exact, and N→M→N round-trips to
+    identical bytes.  Requires every saved rank's shards (a
+    multi-process restore goes through ``CheckpointManager``, which
+    merges the per-rank blobs first)."""
+    from ..kvstore import zero_padded_size
+
+    old_world = int(zero["world"])
+    new_world = int(new_world)
+    if new_world < 1:
+        raise MXNetError(f"cannot reshard ZeRO snapshot onto "
+                         f"{new_world} rank(s)")
+    if old_world == new_world:
+        return zero
+    shards = {int(r): v for r, v in zero["shards"].items()}
+    have = set(shards)
+    if have != set(range(old_world)):
+        raise MXNetError(
+            f"ZeRO snapshot is sharded across {old_world} rank(s) but "
+            f"only rank(s) {sorted(have)} are present — gather every "
+            "trainer-shard<r>.states first (CheckpointManager does)")
+    new_chunks, new_shards = [], {r: {} for r in range(new_world)}
+    for c, chunk in enumerate(zero["chunks"]):
+        total = int(chunk["total"])
+        n_states = int(chunk["n_states"])
+        padded = zero_padded_size(total, new_world)
+        shard_n = padded // new_world
+        new_chunks.append(dict(chunk, padded=padded))
+        slots_per_rank = [[] for _ in range(new_world)]
+        for slot in range(n_states):
+            full = np.concatenate(
+                [_shard_np(_chunk_of(shards[r], c)[slot])
+                 for r in range(old_world)])[:total]
+            pad = padded - full.shape[0]
+            if pad:
+                full = np.concatenate(
+                    [full, np.zeros(pad, dtype=full.dtype)])
+            for r in range(new_world):
+                slots_per_rank[r].append(
+                    full[r * shard_n:(r + 1) * shard_n])
+        for r in range(new_world):
+            new_shards[r][c] = slots_per_rank[r]
+    return {"world": new_world, "chunks": new_chunks,
+            "shards": new_shards}
+
+
+# -- pipeline state ---------------------------------------------------------
+
+
+def _tree_equal(a, b):
+    """Structural equality over the host trees pipeline states are made
+    of (dicts/lists/tuples/numpy/scalars)."""
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        return (isinstance(a, np.ndarray) and isinstance(b, np.ndarray)
+                and a.shape == b.shape and a.dtype == b.dtype
+                and np.array_equal(a, b))
+    if isinstance(a, dict) and isinstance(b, dict):
+        return a.keys() == b.keys() and all(
+            _tree_equal(a[k], b[k]) for k in a)
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        return len(a) == len(b) and all(
+            _tree_equal(x, y) for x, y in zip(a, b))
+    try:
+        return bool(a == b)
+    except Exception:  # exotic leaf: identity is the best we can do
+        return a is b
+
+
+def merge_pipeline_states(blobs, where="<checkpoint>"):
+    """Merge the per-rank ``pipeline-shard<r>.state`` blobs of a saved
+    world into the ONE rank-symmetric state a resized job loads.
+
+    The ``shard(num_replicas, rank)`` contract makes every rank's
+    state identical by construction (same source cursor, same shuffle
+    ring + RNG, same rollover) — so the merge is agreement
+    VERIFICATION: stage by stage, every rank's saved state must be
+    equal; the common value is the merged cursor.  A disagreeing stage
+    means per-rank in-flight data that cannot be repartitioned across
+    a different world — that raises loudly, naming the stage."""
+    if not blobs:
+        raise MXNetError(f"{where}: no pipeline shard states to merge")
+    first = blobs[0]
+    stages0 = (first or {}).get("stages")
+    if stages0 is None:
+        raise MXNetError(
+            f"{where}: unrecognized pipeline state (no stages) — was "
+            "it saved by a newer build?")
+    for r, blob in enumerate(blobs[1:], start=1):
+        stages = (blob or {}).get("stages")
+        if stages is None or len(stages) != len(stages0) or any(
+                s["type"] != s0["type"]
+                for s, s0 in zip(stages, stages0)):
+            raise MXNetError(
+                f"{where}: pipeline compositions differ across saved "
+                f"ranks (rank 0 vs rank {r}) — the per-rank pipelines "
+                "of one job must be built identically to reshard")
+        for s, s0 in zip(stages, stages0):
+            if not _tree_equal(s["state"], s0["state"]):
+                raise MXNetError(
+                    f"{where}: pipeline stage {s['type']} state "
+                    f"differs between saved rank 0 and rank {r} — "
+                    "per-rank in-flight data cannot be repartitioned "
+                    "across world sizes. Checkpoint at a shard-group "
+                    "boundary (Supervisor ctx.step_done(save=...) "
+                    "saves are), or rebuild the input pipeline from "
+                    "the epoch start (restore with pipeline=None and "
+                    "re-create it). strict_topology=True restores the "
+                    "plain world-size rejection. See "
+                    "docs/checkpointing.md, 'Elastic restore'.")
+    return first

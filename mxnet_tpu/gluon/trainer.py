@@ -379,10 +379,13 @@ class Trainer:
         unsharded mid-run)."""
         if not self._zero_states:
             return
+        # adopt=False: the unshard MUST materialize canonical per-param
+        # states — direct shard adoption would hand the shards straight
+        # back and leave the unsharded update with nothing to read
         self._load_zero_states(
             self._zero_snapshot(),
             source="<live ZeRO-1 shards: an unsharded update "
-            "path engaged after sharded steps>")
+            "path engaged after sharded steps>", adopt=False)
 
     def _zero_snapshot(self):
         """The ZeRO state-snapshot dict (world / chunks / per-rank
@@ -827,18 +830,110 @@ class Trainer:
                 v = vals[j] if j < len(vals) else vals[0]
                 self._states[i][ctx] = _states_from_np(v)
 
-    def _load_zero_states(self, zero, source):
+    def _zero_plan_probe(self, world):
+        """Build the zero plan this trainer WOULD run at ``world``
+        replicas, with the step-counter ticks the build performs
+        contained (saved and restored) — a layout probe, not a step.
+        Returns the plan tuple, or None when the configuration has no
+        fused/sharded form."""
+        opt = self._optimizer
+        saved = (opt.num_update, dict(opt._index_update_count))
+        try:
+            ctx0 = self._params[0].list_ctx()[0]
+            plan, _svals, reason = opt.whole_step_plan(
+                list(range(len(self._params))),
+                [p.data(ctx0) for p in self._params],
+                [None] * len(self._params), zero_world=world)
+        except Exception:  # uninitialized params etc: no probe
+            plan, reason = None, "probe failed"
+        finally:
+            opt.num_update = saved[0]
+            opt._index_update_count = saved[1]
+        return None if reason is not None else plan
+
+    def _try_adopt_zero_snapshot(self, zero):
+        """Elastic fast path: when the snapshot's shard world equals
+        this trainer's replica world AND its chunk layout matches the
+        plan this trainer would build, install the flat shards
+        DIRECTLY as the live per-rank optimizer state — bit-identical
+        to gather-then-lazy-reshard (both are pure reshaping of the
+        same bytes) without materializing full per-param states on the
+        resume path.  Returns True on adoption; False falls back to
+        the gather path."""
+        from ..checkpoint.reshard import _chunk_of, _shard_np
+        from ..ndarray import ndarray as _nd_mod
+
+        if not self._zero_shard or not self._params:
+            return False
+        ctxs = self._params[0].list_ctx()
+        world = int(zero["world"])
+        if world <= 1 or len(ctxs) != world:
+            return False
+        try:
+            shards = {int(r): v for r, v in zero["shards"].items()}
+        except (TypeError, ValueError):
+            return False
+        if set(shards) != set(range(world)):
+            return False
+        plan = self._zero_plan_probe(world)
+        if plan is None or len(plan) != len(zero["chunks"]):
+            return False
+        for chunk, (_k, _s, n_states, dt, idxs, total, padded) in \
+                zip(zero["chunks"], plan):
+            if (int(chunk["n_states"]) != n_states
+                    or str(chunk["dtype"]) != str(dt)
+                    or [int(j) for j in chunk["indices"]] != list(idxs)
+                    or int(chunk["total"]) != total
+                    or int(chunk["padded"]) != padded):
+                return False
+        new_states = {}
+        for c, (_k, _s, n_states, dt, idxs, _total, padded) in \
+                enumerate(plan):
+            shard_n = padded // world
+            entry = {}
+            for r, ctx in enumerate(ctxs):
+                try:
+                    sh = _chunk_of(shards[r], c)
+                    arrs = [_shard_np(sh[slot])
+                            for slot in range(n_states)]
+                except (KeyError, IndexError, TypeError):
+                    # truncated/partial snapshot: the gather path's
+                    # missing-shard diagnosis beats a bare KeyError
+                    return False
+                slots = []
+                for arr in arrs:
+                    if arr.shape != (shard_n,):
+                        return False
+                    slots.append(_nd_mod.array(arr, dtype=dt, ctx=ctx))
+                entry[r] = tuple(slots)
+            new_states[c] = entry
+        self._zero_states = new_states
+        self._zero_layout = self._zero_layout_of(plan, world)
+        for (_k, _s, _n, _dt, idxs, _t, _p) in plan:
+            for j in idxs:
+                self._states[j] = None
+        return True
+
+    def _load_zero_states(self, zero, source, adopt=True):
         """Gather a ZeRO-1 state snapshot (per-rank flat shards) into
         canonical per-param optimizer states at ctx0 — the gather-on-
         restore path: concatenate the rank shards of every chunk, drop
         the zero pad, and unflatten along the chunk's param layout.
         Requires every rank's shards (a multi-process restore goes
-        through CheckpointManager, which merges the per-rank blobs)."""
+        through CheckpointManager, which merges the per-rank blobs).
+
+        With ``adopt=True`` (the restore path) a snapshot whose shard
+        world and chunk layout already match this sharded trainer is
+        installed directly as live shards instead — the elastic resume
+        fast path (``CheckpointManager`` re-slices a foreign-world
+        snapshot onto this world first, see checkpoint/reshard.py)."""
         import numpy as np
 
         from ..ndarray import ndarray as _nd_mod
         from ..ndarray.ndarray import NDArray as _ND
 
+        if adopt and self._try_adopt_zero_snapshot(zero):
+            return
         world = int(zero["world"])
         have = {int(r) for r in zero["shards"]}
         if have != set(range(world)):

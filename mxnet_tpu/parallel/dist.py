@@ -14,6 +14,7 @@ one machine (SURVEY §4).
 from __future__ import annotations
 
 import os
+import re
 import threading
 
 from .. import engine as _engine
@@ -133,10 +134,13 @@ def init(coordinator_address=None, num_processes=None, process_id=None):
             "NUM_WORKER", int(os.environ.get("DMLC_NUM_WORKER", "1")), int)
         process_id = getenv(
             "WORKER_ID", int(os.environ.get("DMLC_WORKER_ID", "0")), int)
+    if coordinator_address:
+        # the port append applies to EVERY init form — including an
+        # elastic reinit(num_processes=M, process_id=r), which passes
+        # explicit sizes but still dials the launcher's coordinator
         port = os.environ.get("DMLC_PS_ROOT_PORT")
         if port and ":" not in coordinator_address:
             coordinator_address = f"{coordinator_address}:{port}"
-    if coordinator_address:
         jax.distributed.initialize(coordinator_address, num_processes,
                                    process_id)
     _initialized = True
@@ -328,12 +332,17 @@ def allgather_bytes(data):
                                  jax.process_index(), data)
 
 
-def reinit():
+def reinit(num_processes=None, process_id=None):
     """Tear down and re-create the process group — the supervisor's
     peer-death recovery attempt.  Only succeeds when every SURVIVING
     peer (plus any replacement worker) calls it under the same
     coordinator; callers treat any exception as "not possible
-    in-process" and fall back to clean exit + resume marker."""
+    in-process" and fall back to clean exit + resume marker.
+
+    With explicit ``num_processes``/``process_id`` the group re-forms
+    at a NEW world size — the elastic-shrink leg: :func:`shrink` passes
+    the agreed survivor count and this rank's new index, overriding the
+    stale launcher env (MXTPU_NUM_WORKER still names the old world)."""
     global _initialized, _world_mesh_cache
     import jax
 
@@ -345,7 +354,163 @@ def reinit():
     _allreduce_jit_cache.clear()
     _gather_jit_cache.clear()
     _initialized = False
-    init()
+    if num_processes is not None:
+        init(num_processes=int(num_processes),
+             process_id=int(process_id))
+    else:
+        init()
+
+
+def _rendezvous_timeout(timeout):
+    """MXTPU_RENDEZVOUS_TIMEOUT (seconds) bounds the elastic-shrink
+    survivor rendezvous; the explicit argument wins."""
+    if timeout is not None:
+        return float(timeout)
+    return getenv("RENDEZVOUS_TIMEOUT", 60.0, float)
+
+
+def shrink(dead_ranks=None, *, world=None, timeout=None,
+           rendezvous_dir=None, round_index=0, retry=None):
+    """Coordinated world shrink after peer death — survivors agree on
+    the new world size and the process group re-forms at it.  Returns
+    ``(new_world, new_rank)``.
+
+    Two modes share the ``dist.rendezvous`` fault point (so chaos
+    plans can fail the resize itself — the supervisor retries it):
+
+    - **single process** (chaos rehearsals, the virtual device mesh):
+      the "world" is virtual — replica contexts standing in for ranks
+      — so the caller supplies ``world`` and the failure's
+      ``dead_ranks``; survivors are everyone else and this process is
+      rank 0 of the shrunken world.  Nothing to re-initialize.
+    - **multi-process**: a shared-storage rendezvous (the checkpoint
+      directory — multi-process checkpointing already requires it):
+      every survivor writes ``elastic-rendezvous/round-<k>/rank-<r>``
+      and polls (seeded :class:`~..resilience.retry.RetryPolicy`
+      backoff) until the survivor set holds still or
+      ``MXTPU_RENDEZVOUS_TIMEOUT`` expires; the agreed new world is
+      the survivor count, new ranks their sorted order, and
+      :func:`reinit` re-forms the group at that size under the same
+      coordinator (rank 0's coordinator service must itself have
+      survived — when IT died, the rendezvous raises and the
+      supervisor falls back to clean exit + resume marker).
+    """
+    import jax
+
+    dead = sorted({int(r) for r in (dead_ranks or ())})
+    _engine.fault_point("dist.rendezvous",
+                        world=int(world) if world is not None else -1,
+                        dead=len(dead), round_index=int(round_index))
+    if jax.process_count() <= 1:
+        if world is None or not dead:
+            raise MXNetError(
+                "elastic shrink in a single process is a VIRTUAL-world "
+                "rehearsal: it needs the current world size and the "
+                "failure's dead rank list (a real multi-process job "
+                "discovers survivors through the rendezvous instead)")
+        survivors = [r for r in range(int(world)) if r not in set(dead)]
+        if not survivors:
+            raise MXNetError(
+                f"elastic shrink left no survivors (world {world}, "
+                f"dead {dead})")
+        return len(survivors), 0
+    return _shrink_multiprocess(dead, timeout, rendezvous_dir,
+                                round_index, retry)
+
+
+def _shrink_multiprocess(dead, timeout, rendezvous_dir, round_index,
+                         retry):
+    import json as _json
+    import time as _time
+
+    if not rendezvous_dir:
+        raise MXNetError(
+            "elastic shrink needs a shared rendezvous directory "
+            "(normally the CheckpointManager directory) for survivors "
+            "to discover each other; construct the Supervisor with "
+            "manager= or pass rendezvous_dir=")
+    if retry is None:
+        from ..resilience.retry import RetryPolicy
+
+        retry = RetryPolicy(max_retries=10 ** 6, base_delay=0.05,
+                            max_delay=1.0, jitter=0.25, seed=rank())
+    my = rank()
+    old_world = num_workers()
+    d = os.path.join(os.fspath(rendezvous_dir), "elastic-rendezvous",
+                     f"round-{int(round_index):04d}")
+    os.makedirs(d, exist_ok=True)
+    from ..checkpoint import atomic as _atomic
+
+    own = os.path.join(d, f"rank-{my}.json")
+    budget = _rendezvous_timeout(timeout)
+    # the survivor set must hold still for a settle window (a quarter
+    # of the budget, capped) so a straggler writing its marker late
+    # does not split the agreed world
+    settle = min(2.0, max(0.25, budget / 4))
+    # rank files are LEASES: each survivor rewrites its own file every
+    # poll, and only files fresher than the lease window count —
+    # measured against this rank's own just-refreshed mtime so the
+    # shared storage stamps both sides and clock skew cancels.  A
+    # previous job incarnation's round-<k> leftovers (the round index
+    # restarts at 0 after a relaunch) age out instead of being agreed
+    # into the new world as phantom survivors.
+    lease = max(10.0, 4 * settle)
+    deadline = _time.monotonic() + budget
+    seen, stable_since, attempt = set(), None, 0
+    rx = re.compile(r"^rank-(\d+)\.json$")
+    while True:
+        _atomic.write_json(own, {"old_rank": my,
+                                 "old_world": old_world})
+        try:
+            ref = os.path.getmtime(own)
+        except OSError:
+            ref = _time.time()
+        now = _time.monotonic()
+        present = set()
+        for name in os.listdir(d):
+            m = rx.match(name)
+            if not m:
+                continue
+            try:
+                mt = os.path.getmtime(os.path.join(d, name))
+            except OSError:  # lost a race with cleanup
+                continue
+            if ref - mt <= lease:
+                present.add(int(m.group(1)))
+        present -= set(dead)
+        if present != seen:
+            seen, stable_since = present, now
+        if seen and stable_since is not None \
+                and now - stable_since >= settle:
+            break
+        if now >= deadline:
+            raise MXNetError(_peer_death_msg(
+                f"elastic rendezvous did not settle within "
+                f"MXTPU_RENDEZVOUS_TIMEOUT={budget:g}s "
+                f"(survivors seen: {sorted(seen)})"))
+        attempt += 1
+        _time.sleep(min(retry.delay_for(attempt),
+                        max(deadline - now, 0.0)))
+    survivors = sorted(seen)
+    if my not in survivors:
+        raise MXNetError(
+            f"rank {my} was declared dead by the failure being "
+            f"recovered (dead ranks {dead}) — exiting instead of "
+            "rejoining a world that excludes it")
+    new_world, new_rank = len(survivors), survivors.index(my)
+    reinit(num_processes=new_world, process_id=new_rank)
+    if new_rank == 0:
+        # the agreed world has re-formed (reinit is collective) — drop
+        # this round's rank files so a relaunched job reusing the
+        # round index starts from an empty rendezvous
+        try:
+            for name in os.listdir(d):
+                if rx.match(name):
+                    os.unlink(os.path.join(d, name))
+            os.rmdir(d)
+        except OSError:
+            pass
+    return new_world, new_rank
 
 
 def barrier(name="kvstore"):

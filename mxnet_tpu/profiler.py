@@ -244,8 +244,9 @@ def _data_pipeline_counters(reset=False):
 
 def _resilience_counters(reset=False):
     """Supervisor/fault-recovery counters (restarts, retries by fault
-    class, fallback_restores, watchdog_fires, time_lost_ms) —
-    window-scoped under reset=True exactly like cachedGraph/trainerStep/
+    class, fallback_restores, watchdog_fires, time_lost_ms, and the
+    elastic-resize trio resizes/ranks_lost/reshard_ms) — window-scoped
+    under reset=True exactly like cachedGraph/trainerStep/
     dataPipeline; only present when the resilience tier is loaded."""
     import sys
 
@@ -380,7 +381,10 @@ def _resilience_table(stats):
     for label, key in (("restarts", "restarts"),
                        ("fallback restores", "fallback_restores"),
                        ("watchdog fires", "watchdog_fires"),
-                       ("time lost (ms)", "time_lost_ms")):
+                       ("time lost (ms)", "time_lost_ms"),
+                       ("elastic resizes", "resizes"),
+                       ("ranks lost", "ranks_lost"),
+                       ("reshard (ms)", "reshard_ms")):
         out.append(f"{label:<40}{stats[key]:>12}")
     for cls in sorted(stats["retries"]):
         out.append(f"{'retries[' + cls + ']':<40}"

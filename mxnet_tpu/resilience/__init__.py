@@ -17,8 +17,8 @@ Recovery telemetry lands in the profiler's ``resilience`` section
 (:func:`resilience_stats`).
 """
 from .faults import (FaultInjected, FaultPlan, FaultSpec,  # noqa: F401
-                     TransientFault, armed, clear_plan, install_from_env,
-                     install_plan, parse_plan)
+                     PeerDeathFault, TransientFault, armed, clear_plan,
+                     install_from_env, install_plan, parse_plan)
 from .retry import RetryPolicy  # noqa: F401
 from .stats import resilience_stats, reset_resilience_stats  # noqa: F401
 from .supervisor import (Preempted, ResumeRequired, RunContext,  # noqa: F401

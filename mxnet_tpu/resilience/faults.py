@@ -17,9 +17,15 @@ Fault-point catalog (site -> where it fires -> ctx keys):
 ``kvstore.pushpull``      top of ``KVStore.pushpull``            —
 ``dist.allreduce``        top of ``parallel.dist.allreduce``     —
 ``dist.barrier``          top of ``parallel.dist.barrier``       ``name``
+``dist.rendezvous``       top of ``parallel.dist.shrink``        ``world,
+                          (elastic survivor rendezvous)          dead,
+                                                                 round_index``
 ``engine.h2d``            ``engine.batched_put``                 ``n, device``
 ``engine.d2h``            checkpoint d2h readback                —
 ``checkpoint.commit``     after shard writes, pre-manifest       ``dir, step``
+``checkpoint.reshard``    elastic restore, before the            ``kind,
+                          repartition is applied                 saved_world,
+                                                                 world``
 ``pipeline.map``          ``MapStage`` worker, before the fn     —
 ``serve.decode``          ``DecodeServer`` token loop, pre-step  ``step, live``
 ========================  =====================================  ==========
@@ -31,6 +37,11 @@ Actions:
   supervisor's preemption path.
 - ``raise``     — raise :class:`TransientFault` (classified by the
   supervisor as retriable: backoff + re-run from the last checkpoint).
+- ``peer_death`` — raise :class:`PeerDeathFault` carrying the spec's
+  ``dead_ranks``: the rank-loss rehearsal for the elastic supervisor
+  (classified ``peer_death``; with elastic resize on, the supervisor
+  shrinks the world by the dead ranks and resumes from the latest
+  checkpoint through the resharding restore).
 - ``delay`` / ``stall`` — sleep ``delay_s`` at the site (exercises the
   pipeline map timeout and the progress watchdog).
 - ``truncate``  — truncate a shard file inside the in-flight checkpoint
@@ -59,7 +70,7 @@ import numpy as np
 from .. import engine
 from ..base import MXNetError, getenv
 
-_ACTIONS = ("kill", "raise", "delay", "stall", "truncate")
+_ACTIONS = ("kill", "raise", "peer_death", "delay", "stall", "truncate")
 
 
 class FaultInjected(MXNetError):
@@ -69,6 +80,17 @@ class FaultInjected(MXNetError):
 class TransientFault(FaultInjected):
     """Injected retriable failure (the supervisor's 'transient' class —
     same recovery path as a real flaky collective / transport error)."""
+
+
+class PeerDeathFault(FaultInjected):
+    """Injected rank loss (the supervisor's 'peer_death' class — the
+    message carries the stable peer-death signature, and
+    ``dead_ranks`` names the ranks the rehearsal declares lost so an
+    elastic supervisor can shrink the virtual world by exactly them)."""
+
+    def __init__(self, msg, dead_ranks=()):
+        super().__init__(msg)
+        self.dead_ranks = [int(r) for r in dead_ranks]
 
 
 class FaultSpec:
@@ -87,10 +109,13 @@ class FaultSpec:
               ``None`` = unbounded)
     delay_s : sleep for 'delay'/'stall' actions (default 0.05)
     signum  : signal for 'kill' (default SIGTERM)
+    dead_ranks : ranks the 'peer_death' action declares lost (the
+              elastic supervisor shrinks the virtual world by them)
     """
 
     def __init__(self, site, action, on_hit=None, match=None, prob=None,
-                 times=1, delay_s=0.05, signum=signal.SIGTERM):
+                 times=1, delay_s=0.05, signum=signal.SIGTERM,
+                 dead_ranks=None):
         if action not in _ACTIONS:
             raise MXNetError(
                 f"unknown fault action {action!r}; valid: {_ACTIONS}")
@@ -108,6 +133,11 @@ class FaultSpec:
         self.times = None if times is None else int(times)
         self.delay_s = float(delay_s)
         self.signum = int(signum)
+        self.dead_ranks = [int(r) for r in (dead_ranks or ())]
+        if self.action == "peer_death" and not self.dead_ranks:
+            raise MXNetError(
+                "a 'peer_death' fault needs dead_ranks=[...] — the "
+                "rehearsal must name which ranks the failure kills")
         self._left = self.times  # None = unbounded
         self._rng = None         # seeded by the owning plan
 
@@ -219,6 +249,16 @@ class FaultPlan:
                 f"injected transient fault at {site!r} (hit {hit}) — "
                 "armed by the active FaultPlan (chaos rehearsal, not a "
                 "real failure)")
+        if spec.action == "peer_death":
+            # the stable phrase below is dist._peer_death_msg's
+            # signature, so classify() routes this like a real dead peer
+            raise PeerDeathFault(
+                f"injected peer death at {site!r} (hit {hit}): rank(s) "
+                f"{spec.dead_ranks} likely dead or partitioned — armed "
+                "by the active FaultPlan (chaos rehearsal, not a real "
+                "failure); an elastic Supervisor treats this as a "
+                "resize event",
+                dead_ranks=spec.dead_ranks)
         if spec.action == "kill":
             os.kill(os.getpid(), spec.signum)
             return

@@ -21,6 +21,9 @@ _stats = {
     "fallback_restores": 0,  # restore() fell back past a corrupt newest
     "watchdog_fires": 0,    # progress watchdog expiries
     "time_lost_ms": 0.0,    # failure -> re-invocation wall time
+    "resizes": 0,           # elastic world shrinks (peer death -> M)
+    "ranks_lost": 0,        # ranks dropped across those resizes
+    "reshard_ms": 0.0,      # checkpoint repartition wall time
 }
 
 
@@ -43,6 +46,7 @@ def resilience_stats():
         s = dict(_stats)
         s["retries"] = dict(_stats["retries"])
     s["time_lost_ms"] = round(s["time_lost_ms"], 3)
+    s["reshard_ms"] = round(s["reshard_ms"], 3)
     return s
 
 

@@ -43,8 +43,21 @@ The supervisor classifies every failure that escapes ``train_fn``:
   ``"exit"`` (default, the real-preemption behavior) writes a resume
   marker and re-raises as :class:`ResumeRequired`.
 - **peer_death** (the ``parallel.dist`` bounded-failure-detector
-  message) — attempt ``dist.reinit()`` where possible, else clean exit
-  with the resume marker.
+  message) — with elastic resize on (``MXTPU_ELASTIC``, the default),
+  a RESIZE event: survivors agree on the new world through
+  ``dist.shrink`` (the ``dist.rendezvous`` fault point; the rendezvous
+  itself is retried under the :class:`RetryPolicy`, so a transient
+  failure inside the resize is not fatal), the process group re-forms
+  at the surviving size, and ``train_fn`` is re-invoked — it reads
+  ``ctx.world``, rebuilds its model/trainer/pipeline for the new mesh
+  (exactly one recompile per resize event), and resumes from the
+  latest checkpoint through the manager's resharding restore.  A
+  surviving world below ``MXTPU_MIN_WORLD`` exits cleanly with the
+  resume marker instead.  When the resize is unavailable (no
+  dead-rank information in a single process, rendezvous failure, the
+  coordinator itself died), fall back to the legacy path: attempt
+  ``dist.reinit()`` where possible, else clean exit with the resume
+  marker.
 - **corrupt_checkpoint** — restart; ``CheckpointManager.restore()``
   itself falls back to the previous retained step (loudly).
 - **watchdog** — no ``ctx.step_done`` within ``watchdog_sec``: the
@@ -155,6 +168,11 @@ class RunContext:
 
     attempt : 0 on the first invocation, +1 per recovery
     manager : the supervisor's CheckpointManager (or None)
+    world   : the CURRENT world size — after an elastic resize this is
+              the surviving size; an elastic ``train_fn`` sizes its
+              replica mesh / shard stages from it on every invocation
+    dead_ranks : ranks lost so far (as numbered at failure time)
+    resizes : elastic resize events so far
     """
 
     def __init__(self, supervisor):
@@ -164,6 +182,18 @@ class RunContext:
     @property
     def manager(self):
         return self._sup.manager
+
+    @property
+    def world(self):
+        return self._sup._world
+
+    @property
+    def dead_ranks(self):
+        return list(self._sup._dead_ranks)
+
+    @property
+    def resizes(self):
+        return self._sup._resizes
 
     def step_done(self, step, save=None):
         """Report step ``step`` completed: feeds the progress watchdog,
@@ -216,11 +246,27 @@ class Supervisor:
                     raise :class:`ResumeRequired`, the real-preemption
                     behavior) or ``'resume'`` (restart in-process, the
                     chaos-rehearsal behavior)
+    elastic       : treat classified peer death as a RESIZE event —
+                    shrink the world to the survivors and resume from
+                    the latest checkpoint via the resharding restore
+                    (``MXTPU_ELASTIC``, default on; degrades to the
+                    legacy reinit-or-exit path when the resize is
+                    unavailable)
+    world         : the job's world size; defaults to
+                    ``dist.num_workers()``.  Chaos rehearsals pass the
+                    VIRTUAL world here (replica contexts standing in
+                    for ranks on the virtual device mesh)
+    min_world     : never resize below this many ranks — exit with the
+                    resume marker instead (``MXTPU_MIN_WORLD``,
+                    default 1)
+    rendezvous_timeout : elastic survivor-rendezvous bound, seconds
+                    (``MXTPU_RENDEZVOUS_TIMEOUT``, default 60)
     """
 
     def __init__(self, manager=None, *, max_restarts=None,
                  watchdog_sec=None, retry=None, on_preemption="exit",
-                 resume_marker=None):
+                 resume_marker=None, elastic=None, world=None,
+                 min_world=None, rendezvous_timeout=None):
         if on_preemption not in ("exit", "resume"):
             raise MXNetError(
                 f"on_preemption must be 'exit' or 'resume', got "
@@ -235,6 +281,16 @@ class Supervisor:
         self.resume_marker = resume_marker or (
             os.path.join(manager.directory, RESUME_MARKER)
             if manager is not None else RESUME_MARKER)
+        self.elastic = bool(getenv("ELASTIC", True, bool)
+                            if elastic is None else elastic)
+        self.min_world = int(getenv("MIN_WORLD", 1, int)
+                             if min_world is None else min_world)
+        self.rendezvous_timeout = float(
+            getenv("RENDEZVOUS_TIMEOUT", 60.0, float)
+            if rendezvous_timeout is None else rendezvous_timeout)
+        self._world = None if world is None else int(world)
+        self._dead_ranks = []
+        self._resizes = 0
         self._state_fn = None
         self._last_step = None
         self._progress = time.monotonic()
@@ -248,6 +304,13 @@ class Supervisor:
         returns its result.  See the module docstring for the policy per
         fault class."""
         is_main = threading.current_thread() is threading.main_thread()
+        if self._world is None:
+            from ..parallel import dist
+
+            try:
+                self._world = dist.num_workers()
+            except Exception:  # jax not initialized: single process
+                self._world = 1
         ctx = RunContext(self)
         restarts = 0
         transient_failures = 0
@@ -351,7 +414,17 @@ class Supervisor:
                     "preempted; restarting in-process (restart %d/%d)",
                     restarts, self.max_restarts)
             elif kind == "peer_death":
-                if restarts >= self.max_restarts or not self._try_reinit():
+                resized = False
+                if self.elastic and restarts < self.max_restarts:
+                    resized = self._try_resize(exc)
+                if resized:
+                    restarts += 1
+                    logger.warning(
+                        "peer death; world resized to %d survivor(s), "
+                        "restarting (restart %d/%d): %s",
+                        self._world, restarts, self.max_restarts, exc)
+                elif restarts >= self.max_restarts \
+                        or not self._try_reinit():
                     self._write_resume_marker("peer_death", exc)
                     raise ResumeRequired(
                         f"peer death and the process group could not be "
@@ -359,11 +432,12 @@ class Supervisor:
                         f"written to {self.resume_marker} — restart the "
                         f"whole job to resume from the last checkpoint "
                         f"(original failure: {exc})") from exc
-                restarts += 1
-                logger.warning(
-                    "peer death; process group re-initialized, "
-                    "restarting (restart %d/%d): %s",
-                    restarts, self.max_restarts, exc)
+                else:
+                    restarts += 1
+                    logger.warning(
+                        "peer death; process group re-initialized, "
+                        "restarting (restart %d/%d): %s",
+                        restarts, self.max_restarts, exc)
             else:  # watchdog / corrupt_checkpoint
                 if restarts >= self.max_restarts:
                     raise exc
@@ -423,16 +497,36 @@ class Supervisor:
 
     # -- resume marker -------------------------------------------------------
 
-    def _write_resume_marker(self, reason, exc):
+    def _write_resume_marker(self, reason, exc, dead_applied=False):
+        # surviving topology: dead_applied says whether the caller
+        # already shrank _world for THIS failure's dead ranks (the
+        # min-world path does; a non-elastic peer death does not).
+        # An explicit flag, not a membership test against the historic
+        # _dead_ranks — those ids are from PRE-resize numberings, so a
+        # re-used rank number must still be subtracted here
+        dead_now = sorted({int(r) for r in
+                           getattr(exc, "dead_ranks", ()) or ()})
+        world = self._world
+        surviving = ((world if dead_applied
+                      else max(world - len(dead_now), 0))
+                     if world is not None else None)
         marker = {
             "reason": reason,
             "error": str(exc)[:500],
             "last_step": self._last_step,
             "latest_checkpoint": (self.manager.latest()
                                   if self.manager is not None else None),
+            "topology": {
+                "world": surviving,
+                "dead_ranks": sorted(set(self._dead_ranks) | set(dead_now)),
+                "resizes": self._resizes,
+            },
             "resume": "restart the job; a train_fn that restores from "
                       "CheckpointManager.latest() continues from "
-                      "latest_checkpoint",
+                      "latest_checkpoint. topology.world is the "
+                      "surviving world size — an on_preemption='exit' "
+                      "relauncher sizes the next job with it (the "
+                      "resharding restore repartitions the checkpoint)",
         }
         try:
             # atomic (tmp+fsync+rename): this path runs in the SIGKILL
@@ -444,6 +538,70 @@ class Supervisor:
         except OSError as e:  # the marker is advisory, never fatal
             logger.warning("could not write resume marker %s: %s",
                            self.resume_marker, e)
+
+    # -- elastic resize ------------------------------------------------------
+
+    def _try_resize(self, exc):
+        """Shrink the world to the survivors of ``exc`` and arrange the
+        next ``train_fn`` invocation to run at the new size.  Returns
+        True when the resize happened, False to fall back to the
+        legacy reinit-or-exit path (no dead-rank information in a
+        single process, rendezvous failure, coordinator death).  The
+        whole shrink — rendezvous plus re-init — is retried under the
+        supervisor's :class:`RetryPolicy`, so a TRANSIENT failure
+        inside the resize (an injected ``dist.rendezvous`` fault, a
+        flaky shared-storage listing) is itself recovered, not fatal.
+        A surviving world below ``min_world`` raises
+        :class:`ResumeRequired` after writing the resume marker (whose
+        ``topology`` section sizes the relaunch)."""
+        from ..parallel import dist
+
+        dead = sorted({int(r) for r in
+                       getattr(exc, "dead_ranks", ()) or ()})
+
+        def _attempt():
+            return dist.shrink(
+                dead_ranks=dead, world=self._world,
+                timeout=self.rendezvous_timeout,
+                rendezvous_dir=(self.manager.directory
+                                if self.manager is not None else None),
+                round_index=self._resizes)
+
+        def _on_retry(attempt, e):
+            _stats.add_retry("transient")
+            logger.warning(
+                "transient failure inside the elastic resize (retry "
+                "%d/%d): %s", attempt, self.retry.max_retries, e)
+
+        try:
+            new_world, new_rank = self.retry.call(
+                _attempt, retriable=(TransientFault,),
+                on_retry=_on_retry)
+        except Exception as e:  # noqa: BLE001 — any failure = fallback
+            logger.warning("elastic resize unavailable (%s); falling "
+                           "back to reinit-or-exit", e)
+            return False
+        lost = max((self._world or 0) - new_world, 0) or len(dead)
+        self._dead_ranks.extend(dead)
+        if new_world < max(1, self.min_world):
+            self._world = new_world
+            self._write_resume_marker("peer_death", exc,
+                                      dead_applied=True)
+            raise ResumeRequired(
+                f"elastic resize would leave {new_world} rank(s), "
+                f"below MXTPU_MIN_WORLD={self.min_world}; resume "
+                f"marker (with the surviving topology) written to "
+                f"{self.resume_marker} — relaunch at an acceptable "
+                "world size to resume from the last checkpoint") \
+                from exc
+        self._world = new_world
+        self._resizes += 1
+        _stats.add("resizes")
+        _stats.add("ranks_lost", lost)
+        _tracer.instant("resilience.resize", cat="resilience",
+                        world=new_world, new_rank=new_rank,
+                        ranks_lost=lost, resizes=self._resizes)
+        return True
 
     # -- peer-death re-init --------------------------------------------------
 

@@ -1,0 +1,566 @@
+"""Elastic world-size: checkpoint resharding + resize-on-failure.
+
+The contract under test (docs/checkpointing.md "Elastic restore",
+docs/resilience.md "Elastic resize"): a checkpoint saved at world N
+restores onto a job running at world M — rank-replicated param/RNG
+shards remap, ZeRO-1 optimizer flat shards re-pad and re-slice onto
+the new layout (bit-exact N→M→N round trips across {8,4,2,1}),
+per-rank pipeline cursors merge under the rank-symmetric ``shard()``
+contract — and a supervised job treats classified peer death as a
+RESIZE event: survivors agree on the new world, ``train_fn`` rebuilds
+at ``ctx.world``, and training resumes from the latest checkpoint
+bit-identically to a fresh job started at the surviving size.
+``strict_topology=True`` restores the loud world-size rejection.
+"""
+import json
+import os
+import pickle
+import time as _time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint, gluon, pipeline, resilience
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.checkpoint import (CheckpointManager,
+                                  merge_pipeline_states,
+                                  reshard_zero_snapshot, source_rank)
+from mxnet_tpu.checkpoint import manager as manager_mod
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.resilience import (FaultPlan, FaultSpec, PeerDeathFault,
+                                  ResumeRequired, RetryPolicy,
+                                  Supervisor, classify,
+                                  reset_resilience_stats,
+                                  resilience_stats)
+from mxnet_tpu.utils import serialization
+
+WORLDS = (8, 4, 2, 1)
+CTXS = [mx.xla(i) for i in range(8)]
+X = np.random.RandomState(1).rand(8, 16).astype(np.float32)
+Y = np.random.RandomState(2).rand(8, 4).astype(np.float32)
+
+
+def loss_fn(out, y):
+    return (out - y) ** 2
+
+
+def build(world, zero=True, opt="adam"):
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    units = 16
+    for _ in range(2):
+        # 13 units: flat buckets are never a multiple of any world in
+        # {8,4,2}, so every reshard exercises the re-pad path
+        net.add(nn.Dense(13, in_units=units, activation="tanh"))
+        units = 13
+    net.add(nn.Dense(4, in_units=units))
+    net.initialize(mx.init.Xavier(), ctx=CTXS[:world])
+    tr = gluon.Trainer(net.collect_params(), opt,
+                       {"learning_rate": 0.01},
+                       whole_step=True, zero_shard=zero)
+    return net, tr
+
+
+def weights(net):
+    return [p.data(CTXS[0]).asnumpy()
+            for p in net.collect_params().values()]
+
+
+# ---------------------------------------------------------------------------
+# the reshard primitives
+
+
+def test_source_rank_remap():
+    assert source_rank(0, 4) == 0
+    assert source_rank(3, 4) == 3
+    assert source_rank(5, 4) == 1    # grown world wraps
+    assert source_rank(7, 1) == 0
+    assert source_rank(0, 1) == 0
+
+
+@pytest.mark.parametrize("n", WORLDS)
+@pytest.mark.parametrize("m", WORLDS)
+def test_zero_snapshot_reshard_round_trip_bit_exact(n, m):
+    """reshard_zero_snapshot is pure reshaping: N→M→N returns the
+    identical bytes for every (N, M) over the virtual-mesh worlds."""
+    if n == 1:
+        pytest.skip("a world-1 trainer never shards (identity)")
+    net, tr = build(n)
+    for _ in range(2):
+        tr.whole_step(net, loss_fn, X, Y)
+    zero = tr.states_dict()["zero"]
+    assert int(zero["world"]) == n
+    back = reshard_zero_snapshot(reshard_zero_snapshot(zero, m), n)
+
+    def flat(z):
+        out = []
+        for c, chunk in enumerate(z["chunks"]):
+            for slot in range(int(chunk["n_states"])):
+                parts = []
+                for r in range(int(z["world"])):
+                    rc = z["shards"][r] if r in z["shards"] \
+                        else z["shards"][str(r)]
+                    sh = rc[c] if c in rc else rc[str(c)]
+                    s = sh[slot]
+                    parts.append(s.asnumpy() if hasattr(s, "asnumpy")
+                                 else np.asarray(s))
+                out.append(np.concatenate(parts))
+        return out
+    for a, b in zip(flat(zero), flat(back)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_zero_snapshot_reshard_requires_all_ranks():
+    net, tr = build(4)
+    tr.whole_step(net, loss_fn, X, Y)
+    zero = tr.states_dict()["zero"]
+    zero = dict(zero, shards={0: zero["shards"][0]})
+    with pytest.raises(MXNetError, match="gather every"):
+        reshard_zero_snapshot(zero, 2)
+
+
+# ---------------------------------------------------------------------------
+# restore() across device worlds (the virtual-mesh resize path)
+
+
+@pytest.mark.parametrize("n,m", [(8, 4), (8, 2), (4, 2), (2, 8)])
+def test_manager_restore_across_replica_worlds_bit_exact(n, m, tmp_path):
+    """Save sharded at world N, restore sharded at world M through the
+    manager (re-slice + direct shard adoption), continue — bit
+    identical to a fresh world-M job restored from the same step."""
+    a_net, a_tr = build(n)
+    for _ in range(3):
+        a_tr.whole_step(a_net, loss_fn, X, Y)
+    d = str(tmp_path)
+    CheckpointManager(d, keep_n=2).save(3, params=a_net, trainer=a_tr,
+                                        sync=True)
+    b_net, b_tr = build(m)
+    CheckpointManager(d, keep_n=2).restore(params=b_net, trainer=b_tr)
+    # the elastic fast path engaged: live shards, no canonical states
+    assert b_tr._zero_states
+    assert all(s is None for s in b_tr._states)
+    for _ in range(2):
+        b_tr.whole_step(b_net, loss_fn, X, Y)
+    ref_net, ref_tr = build(m)
+    CheckpointManager(d, keep_n=2).restore(params=ref_net,
+                                           trainer=ref_tr)
+    for _ in range(2):
+        ref_tr.whole_step(ref_net, loss_fn, X, Y)
+    for a, b in zip(weights(b_net), weights(ref_net)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_manager_replica_world_round_trip_chain(tmp_path):
+    """8 → 4 → 2 → 1 → 8 through save/restore at each world: the
+    trajectory continued at 8 after the full chain is bit-identical to
+    one that never left world 8."""
+    net, tr = build(8)
+    for _ in range(3):
+        tr.whole_step(net, loss_fn, X, Y)
+    prev_dir = str(tmp_path / "w8")
+    CheckpointManager(prev_dir, keep_n=2).save(
+        3, params=net, trainer=tr, sync=True)
+    for i, w in enumerate((4, 2, 1, 8)):
+        n2, t2 = build(w)
+        CheckpointManager(prev_dir, keep_n=2).restore(params=n2,
+                                                      trainer=t2)
+        prev_dir = str(tmp_path / f"hop{i}")
+        CheckpointManager(prev_dir, keep_n=2).save(
+            3, params=n2, trainer=t2, sync=True)
+    end_net, end_tr = build(8)
+    CheckpointManager(prev_dir, keep_n=2).restore(params=end_net,
+                                                  trainer=end_tr)
+    for _ in range(2):
+        end_tr.whole_step(end_net, loss_fn, X, Y)
+    cont_net, cont_tr = build(8)
+    for _ in range(5):
+        cont_tr.whole_step(cont_net, loss_fn, X, Y)
+    for a, b in zip(weights(cont_net), weights(end_net)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_reshard_fault_point_fires_and_books_time(tmp_path):
+    a_net, a_tr = build(4)
+    a_tr.whole_step(a_net, loss_fn, X, Y)
+    d = str(tmp_path)
+    CheckpointManager(d, keep_n=2).save(1, params=a_net, trainer=a_tr,
+                                        sync=True)
+    reset_resilience_stats()
+    plan = FaultPlan([{"site": "checkpoint.reshard", "action": "delay",
+                       "delay_s": 0.0}])
+    with resilience.armed(plan):
+        b_net, b_tr = build(2)
+        CheckpointManager(d, keep_n=2).restore(params=b_net,
+                                               trainer=b_tr)
+    assert plan.hits("checkpoint.reshard") == 1
+    assert plan.fired()[0]["ctx"]["kind"] == "zero"
+    assert resilience_stats()["reshard_ms"] > 0
+
+
+# ---------------------------------------------------------------------------
+# restore() across PROCESS worlds (crafted multi-rank checkpoints)
+
+
+def _craft_ckpt(d, step, world, params_np, pipe_states=None, rng=None,
+                trainer_blobs=None):
+    """Write a committed checkpoint directory exactly as a world-N save
+    lays it out (per-rank shard files + manifest)."""
+    ck = os.path.join(d, f"ckpt-{step:08d}")
+    os.makedirs(ck, exist_ok=True)
+    for r in range(world):
+        serialization.save_ndarrays(
+            os.path.join(ck, f"params-shard{r}.params"),
+            {k: mx.nd.array(v) for k, v in params_np.items()})
+        if pipe_states is not None:
+            with open(os.path.join(ck, f"pipeline-shard{r}.state"),
+                      "wb") as f:
+                pickle.dump(pipe_states[r], f)
+        if trainer_blobs is not None:
+            with open(os.path.join(ck, f"trainer-shard{r}.states"),
+                      "wb") as f:
+                pickle.dump(trainer_blobs[r], f)
+        if rng is not None:
+            checkpoint.write_json(
+                os.path.join(ck, f"rng-shard{r}.json"), rng)
+    checkpoint.write_json(os.path.join(ck, "MANIFEST.json"), {
+        "format_version": 1, "step": step, "epoch": None,
+        "extra": None, "num_processes": world,
+        "files": sorted(os.listdir(ck))})
+    return ck
+
+
+def _fake_topology(monkeypatch, rank, world):
+    monkeypatch.setattr(manager_mod, "_rank", lambda: rank)
+    monkeypatch.setattr(manager_mod, "_num_processes", lambda: world)
+
+
+def _mlp_params():
+    rng = np.random.RandomState(3)
+    return {"w0": rng.rand(5, 4).astype(np.float32),
+            "b0": rng.rand(5).astype(np.float32)}
+
+
+def test_process_world_reshard_remaps_param_shards(monkeypatch,
+                                                   tmp_path):
+    """A 4-rank checkpoint restores at worlds 1, 2 and 6: every new
+    rank loads the rank-replicated params bit-exactly (shard remap),
+    and strict_topology=True keeps the loud rejection."""
+    d = str(tmp_path)
+    pnp = _mlp_params()
+    _craft_ckpt(d, 5, 4, pnp)
+    for new_world in (1, 2, 6):
+        for r in range(new_world):
+            _fake_topology(monkeypatch, r, new_world)
+            meta = CheckpointManager(d, keep_n=2).restore(step=5)
+            assert meta["step"] == 5
+            for k, v in pnp.items():
+                np.testing.assert_array_equal(
+                    meta["params"][k].asnumpy(), v)
+    _fake_topology(monkeypatch, 0, 2)
+    with pytest.raises(MXNetError) as ei:
+        CheckpointManager(d, keep_n=2).restore(step=5,
+                                               strict_topology=True)
+    msg = str(ei.value)
+    assert "4-process" in msg and "2 process" in msg
+    assert "strict_topology" in msg
+
+
+def _rank_pipes(world, data, batches):
+    """World identically-seeded per-rank pipelines advanced `batches`
+    steps each (the rank-symmetric shard contract)."""
+    pipes = []
+    for r in range(world):
+        p = pipeline.Pipeline(data).shard(world, r).batch(2)
+        it = iter(p)
+        for _ in range(batches):
+            next(it)
+        pipes.append(p)
+    return pipes
+
+
+def test_process_world_reshard_merges_pipeline_cursors(monkeypatch,
+                                                       tmp_path):
+    """N=4 per-rank pipeline cursors merge onto M=2: the union of the
+    resumed ranks' elements is exactly the unconsumed remainder — no
+    loss, no duplication — and a divergent rank raises loudly."""
+    data = list(range(32))
+    pipes = _rank_pipes(4, data, 3)    # each rank consumed 3 batches
+    d = str(tmp_path)
+    _craft_ckpt(d, 7, 4, _mlp_params(),
+                pipe_states=[p.state_dict() for p in pipes])
+    got = []
+    for r in range(2):
+        _fake_topology(monkeypatch, r, 2)
+        fresh = pipeline.Pipeline(data).shard(2, r).batch(2)
+        CheckpointManager(d, keep_n=2).restore(step=7, pipeline=fresh)
+        got.extend(int(v) for x in fresh for v in x.asnumpy().ravel())
+    # 3 batches of 2 per rank at world 4 = 6 groups of 4 consumed
+    assert sorted(got) == list(range(24, 32))
+    # N→M→N: re-crafting at world 2 and restoring back at world 4
+    # replays the SAME remainder
+    pipes2 = _rank_pipes(2, data, 0)
+    for r in range(2):
+        _fake_topology(monkeypatch, r, 2)
+        CheckpointManager(d, keep_n=2).restore(step=7,
+                                               pipeline=pipes2[r])
+    d2 = str(tmp_path / "back")
+    _craft_ckpt(d2, 7, 2, _mlp_params(),
+                pipe_states=[p.state_dict() for p in pipes2])
+    got4 = []
+    for r in range(4):
+        _fake_topology(monkeypatch, r, 4)
+        fresh = pipeline.Pipeline(data).shard(4, r).batch(2)
+        CheckpointManager(d2, keep_n=2).restore(step=7, pipeline=fresh)
+        got4.extend(int(v) for x in fresh for v in x.asnumpy().ravel())
+    assert sorted(got4) == list(range(24, 32))
+    # divergence: one rank's cursor off by a batch -> actionable error
+    bad = [p.state_dict() for p in _rank_pipes(4, data, 3)]
+    bad[2] = _rank_pipes(4, data, 4)[2].state_dict()
+    d3 = str(tmp_path / "bad")
+    _craft_ckpt(d3, 7, 4, _mlp_params(), pipe_states=bad)
+    _fake_topology(monkeypatch, 0, 2)
+    fresh = pipeline.Pipeline(data).shard(2, 0).batch(2)
+    with pytest.raises(MXNetError,
+                       match="cannot be repartitioned"):
+        CheckpointManager(d3, keep_n=2).restore(step=7, pipeline=fresh)
+
+
+def test_merge_pipeline_states_direct():
+    data = list(range(16))
+    states = [p.state_dict() for p in _rank_pipes(4, data, 2)]
+    merged = merge_pipeline_states(states)
+    assert merged == states[0]
+    with pytest.raises(MXNetError, match="compositions differ"):
+        merge_pipeline_states(
+            [states[0],
+             pipeline.Pipeline(data).batch(2).state_dict()])
+
+
+# ---------------------------------------------------------------------------
+# the elastic supervisor (virtual-world rehearsals)
+
+FEAT, BS, NSTEP = 16, 8, 6
+DX = np.random.RandomState(5).rand(NSTEP, BS, FEAT).astype(np.float32)
+DY = np.random.RandomState(6).rand(NSTEP, BS, 4).astype(np.float32)
+
+
+def _supervised_elastic(ckdir, plan=None, world=4, **sup_kwargs):
+    if plan is not None:
+        resilience.install_plan(plan)
+    losses, worlds = {}, {}
+    try:
+        mgr = CheckpointManager(str(ckdir), keep_n=3)
+        sup_kwargs.setdefault("retry", RetryPolicy(max_retries=3,
+                                                   base_delay=0.001))
+        sup_kwargs.setdefault("max_restarts", 3)
+        sup = Supervisor(mgr, on_preemption="resume", world=world,
+                         **sup_kwargs)
+
+        def train(ctx):
+            net, tr = build(ctx.world)
+            start = 0
+            if ctx.manager.latest() is not None:
+                meta = ctx.manager.restore(params=net, trainer=tr)
+                start = meta["step"] + 1
+            for step in range(start, NSTEP):
+                loss = tr.whole_step(net, loss_fn, DX[step], DY[step])
+                losses[step] = loss.asnumpy().tobytes()
+                worlds[step] = ctx.world
+                ctx.step_done(step, save=dict(params=net, trainer=tr,
+                                              sync=True))
+            return {k: v.data(CTXS[0]).asnumpy()
+                    for k, v in
+                    net._collect_params_with_prefix().items()}
+
+        return sup.run(train), losses, worlds, sup
+    finally:
+        if plan is not None:
+            resilience.clear_plan()
+
+
+def test_supervisor_resizes_on_peer_death(tmp_path):
+    """Kill ranks {1, 3} of a 4-rank virtual world at step 2: the
+    supervisor resizes to 2 survivors, train_fn rebuilds at ctx.world,
+    the run completes, and the recovery is booked."""
+    reset_resilience_stats()
+    plan = FaultPlan([
+        {"site": "train.step", "action": "peer_death",
+         "match": {"step": 2}, "dead_ranks": [1, 3]}])
+    params, losses, worlds, sup = _supervised_elastic(
+        tmp_path / "ck", plan)
+    assert sorted(losses) == list(range(NSTEP))
+    assert worlds[1] == 4 and worlds[2] == 2 and worlds[NSTEP - 1] == 2
+    assert sup._world == 2 and sup._resizes == 1
+    assert sup._dead_ranks == [1, 3]
+    assert not os.path.isfile(sup.resume_marker)
+    stats = resilience_stats()
+    assert stats["resizes"] == 1
+    assert stats["ranks_lost"] == 2
+    assert stats["reshard_ms"] > 0
+    assert stats["retries"].get("peer_death") == 1
+
+
+def test_resize_itself_is_retried_on_transient(tmp_path):
+    """A transient failure injected INSIDE the resize rendezvous is
+    retried under the RetryPolicy — the resize still succeeds."""
+    reset_resilience_stats()
+    plan = FaultPlan([
+        {"site": "train.step", "action": "peer_death",
+         "match": {"step": 2}, "dead_ranks": [1, 3]},
+        {"site": "dist.rendezvous", "action": "raise", "on_hit": 1}])
+    _params, losses, _worlds, sup = _supervised_elastic(
+        tmp_path / "ck", plan)
+    assert sorted(losses) == list(range(NSTEP))
+    assert sup._world == 2 and sup._resizes == 1
+    fired = [(f["site"], f["action"]) for f in plan.fired()]
+    assert ("dist.rendezvous", "raise") in fired
+    assert resilience_stats()["retries"].get("transient", 0) >= 1
+
+
+def test_resize_exhausted_falls_back_to_legacy_path(tmp_path):
+    """When the rendezvous keeps failing past the retry budget the
+    supervisor falls back to the legacy reinit path (which restarts at
+    the ORIGINAL world in a single process) instead of dying."""
+    plan = FaultPlan([
+        {"site": "train.step", "action": "peer_death",
+         "match": {"step": 2}, "dead_ranks": [3]},
+        {"site": "dist.rendezvous", "action": "raise", "times": None}])
+    _params, losses, worlds, sup = _supervised_elastic(
+        tmp_path / "ck", plan,
+        retry=RetryPolicy(max_retries=1, base_delay=0.001))
+    assert sorted(losses) == list(range(NSTEP))
+    assert sup._world == 4 and sup._resizes == 0
+    assert worlds[NSTEP - 1] == 4
+
+
+def test_min_world_floor_exits_with_topology_marker(tmp_path):
+    """A resize below MXTPU_MIN_WORLD exits cleanly: ResumeRequired +
+    a resume marker whose topology section sizes the relaunch — the
+    marker schema regression test."""
+    plan = FaultPlan([
+        {"site": "train.step", "action": "peer_death",
+         "match": {"step": 2}, "dead_ranks": [2, 3]}])
+    with pytest.raises(ResumeRequired, match="MXTPU_MIN_WORLD"):
+        _supervised_elastic(tmp_path / "ck", plan, min_world=3)
+    marker_path = os.path.join(str(tmp_path / "ck"), "RESUME.json")
+    assert os.path.isfile(marker_path)
+    with open(marker_path) as f:
+        marker = json.load(f)
+    assert marker["reason"] == "peer_death"
+    topo = marker["topology"]
+    assert set(topo) == {"world", "dead_ranks", "resizes"}
+    assert topo["world"] == 2            # the surviving size
+    assert topo["dead_ranks"] == [2, 3]
+    assert topo["resizes"] == 0          # floor hit before any resize
+    assert isinstance(marker["latest_checkpoint"], int)
+
+
+def test_non_elastic_marker_still_carries_topology(tmp_path):
+    """elastic=False keeps the legacy exit path, but the marker still
+    records the surviving topology for the relauncher."""
+    plan = FaultPlan([
+        {"site": "train.step", "action": "peer_death",
+         "match": {"step": 2}, "dead_ranks": [1]}])
+    with pytest.raises(ResumeRequired):
+        _supervised_elastic(tmp_path / "ck", plan, elastic=False,
+                            max_restarts=0)
+    with open(os.path.join(str(tmp_path / "ck"), "RESUME.json")) as f:
+        topo = json.load(f)["topology"]
+    assert topo == {"world": 3, "dead_ranks": [1], "resizes": 0}
+
+
+def test_marker_subtracts_renumbered_dead_rank(tmp_path):
+    """Ranks renumber 0..M-1 after a resize, so a rank NUMBER that
+    already appears in the historical dead list must still be
+    subtracted from the marker's surviving world: after a 4->2 resize
+    that consumed old-ranks {1, 2}, losing NEW-rank 1 (resize
+    unavailable) must record world=1, not 2."""
+    sup = Supervisor(CheckpointManager(str(tmp_path / "ck")), world=4)
+    sup._world = 2          # state after an elastic 4->2 resize
+    sup._dead_ranks = [1, 2]
+    sup._resizes = 1
+    exc = PeerDeathFault("rank(s) [1] likely dead or partitioned",
+                         dead_ranks=[1])
+    sup._write_resume_marker("peer_death", exc)
+    with open(sup.resume_marker) as f:
+        topo = json.load(f)["topology"]
+    assert topo["world"] == 1
+    assert topo["dead_ranks"] == [1, 2]
+    assert topo["resizes"] == 1
+
+
+def test_elastic_env_knobs(monkeypatch):
+    monkeypatch.setenv("MXTPU_ELASTIC", "0")
+    monkeypatch.setenv("MXTPU_MIN_WORLD", "3")
+    monkeypatch.setenv("MXTPU_RENDEZVOUS_TIMEOUT", "5")
+    sup = Supervisor()
+    assert sup.elastic is False
+    assert sup.min_world == 3
+    assert sup.rendezvous_timeout == 5.0
+    # ctor args beat the env
+    sup = Supervisor(elastic=True, min_world=1, rendezvous_timeout=9)
+    assert sup.elastic is True and sup.min_world == 1
+    assert sup.rendezvous_timeout == 9.0
+
+
+def test_peer_death_fault_spec_and_classification():
+    with pytest.raises(MXNetError, match="dead_ranks"):
+        FaultSpec("train.step", "peer_death")
+    e = PeerDeathFault("rank(s) [2] likely dead or partitioned",
+                       dead_ranks=[2])
+    assert classify(e) == "peer_death"
+    assert e.dead_ranks == [2]
+    # JSON plan form parses too
+    plan = resilience.parse_plan(json.dumps({"faults": [
+        {"site": "train.step", "action": "peer_death",
+         "dead_ranks": [1, 2]}]}))
+    assert plan._specs[0].dead_ranks == [1, 2]
+
+
+def test_virtual_shrink_requires_dead_rank_info():
+    from mxnet_tpu.parallel import dist
+
+    with pytest.raises(MXNetError, match="dead rank"):
+        dist.shrink(world=4)
+    assert dist.shrink(dead_ranks=[1, 2], world=4) == (2, 0)
+    with pytest.raises(MXNetError, match="no survivors"):
+        dist.shrink(dead_ranks=[0, 1], world=2)
+
+
+def test_multiprocess_rendezvous_ignores_stale_incarnation(
+        monkeypatch, tmp_path):
+    """Rank files are leases: a relaunched job reuses round-0000, so a
+    previous incarnation's leftover rank files (hours-old mtimes) must
+    age out of the survivor set instead of being agreed into the new
+    world as phantom ranks — and the agreed round's files are removed
+    once the group re-forms."""
+    from mxnet_tpu.parallel import dist
+    from mxnet_tpu.resilience.retry import RetryPolicy
+
+    reinits = []
+    monkeypatch.setattr(dist, "rank", lambda: 0)
+    monkeypatch.setattr(dist, "num_workers", lambda: 3)
+    monkeypatch.setattr(
+        dist, "reinit",
+        lambda num_processes=None, process_id=None:
+        reinits.append((num_processes, process_id)))
+    d = os.path.join(str(tmp_path), "elastic-rendezvous", "round-0000")
+    os.makedirs(d)
+    stale = _time.time() - 3600
+    for r in range(8):  # the dead incarnation ran at world 8
+        p = os.path.join(d, f"rank-{r}.json")
+        with open(p, "w") as f:
+            json.dump({"old_rank": r, "old_world": 8}, f)
+        os.utime(p, (stale, stale))
+    # live peer rank 1 already wrote its fresh marker
+    with open(os.path.join(d, "rank-1.json"), "w") as f:
+        json.dump({"old_rank": 1, "old_world": 3}, f)
+    new_world, new_rank = dist._shrink_multiprocess(
+        dead=[2], timeout=4.0, rendezvous_dir=str(tmp_path),
+        round_index=0,
+        retry=RetryPolicy(max_retries=10, base_delay=0.01, seed=0))
+    assert (new_world, new_rank) == (2, 0)
+    assert reinits == [(2, 0)]
+    assert not os.path.isdir(d)  # new rank 0 cleaned the agreed round

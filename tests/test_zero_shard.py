@@ -411,8 +411,10 @@ def test_partial_shard_blob_raises_actionable_error():
         tr2.load_states_dict(blob)
 
 
-def test_world_size_mismatch_error_names_sizes_and_gather_path(
-        tmp_path):
+def test_world_size_mismatch_strict_topology_names_sizes(tmp_path):
+    """A world-size mismatch RESHARDS by default now (elastic restore);
+    strict_topology=True restores the loud rejection, naming both
+    sizes and the escape hatch."""
     from mxnet_tpu.checkpoint import CheckpointManager
 
     net, tr = build(True, ctx=CTXS)
@@ -429,11 +431,19 @@ def test_world_size_mismatch_error_names_sizes_and_gather_path(
     net2, tr2 = build(True, ctx=CTXS)
     with pytest.raises(mx.MXNetError) as ei:
         CheckpointManager(str(tmp_path), keep_n=2).restore(
-            step=1, params=net2, trainer=tr2)
+            step=1, params=net2, trainer=tr2, strict_topology=True)
     msg = str(ei.value)
     assert "16-process" in msg or "by a 16" in msg
     assert "1 process" in msg
-    assert "trainer-shard<r>.states" in msg  # the gather path pointer
+    assert "strict_topology" in msg
+    # default: the SAME restore reshards instead of raising (rank 0
+    # reads saved shard 0 — the rank-replicated remap)
+    net3, tr3 = build(True, ctx=CTXS)
+    meta = CheckpointManager(str(tmp_path), keep_n=2).restore(
+        step=1, params=net3, trainer=tr3)
+    assert meta["step"] == 1
+    for a, b in zip(weights(net, CTXS[0]), weights(net3, CTXS[0])):
+        np.testing.assert_array_equal(a, b)
 
 
 def test_unsharded_snapshot_supersedes_live_shards():
