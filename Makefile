@@ -50,6 +50,15 @@ test: all
 serve-smoke:
 	env PYTHONPATH=. python tools/serve_smoke.py
 
+# fault-tolerant-serving gate: a 3-replica Router pool survives an
+# injected replica kill + health-probe stall mid-burst — every admitted
+# request resolves or fails classified, the pool heals back to 3 with
+# zero in-traffic compiles on survivors, and a rolling reload under
+# load drops zero requests — see tools/router_smoke.py /
+# docs/serving.md
+router-smoke:
+	env PYTHONPATH=. python tools/router_smoke.py
+
 # continuous-batching gate: a staggered 50-request burst through a
 # 4-slot DecodeServer arena — zero post-warmup compiles, exact
 # dispatch-per-token accounting, every admitted request resolves, and
@@ -130,7 +139,7 @@ analyze:
 
 # the ROADMAP tier-1 gate, verbatim ($$ = make-escaped shell $)
 verify: SHELL := /bin/bash
-verify: analyze serve-smoke decode-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke elastic-smoke trace-smoke
+verify: analyze serve-smoke router-smoke decode-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke elastic-smoke trace-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
-.PHONY: all clean test verify analyze serve-smoke decode-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke elastic-smoke trace-smoke
+.PHONY: all clean test verify analyze serve-smoke router-smoke decode-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke elastic-smoke trace-smoke

@@ -451,6 +451,117 @@ def _leaf_serve(platform):
     }))
 
 
+def _leaf_serve_router(platform):
+    """Fault-tolerant-serving record (serve.Router): offered-load
+    rps + p50/p99 for a 1-replica baseline vs a routed 3-replica pool,
+    with an IN-RUN eviction event on the pooled arm — a seeded fault
+    plan kills one replica mid-burst, the circuit breaker evicts it,
+    and a warm spare rejoins.  The record carries requests_lost (must
+    be 0) and the eviction->readmission recovery time: the pool's
+    robustness priced under load, not just its throughput.  (On a
+    CPU-bound host the 3-replica arm measures fault tolerance, not
+    speedup — XLA:CPU anti-scales against concurrent replicas, see the
+    input_pipeline leaf's note.)"""
+    _leaf_setup(platform)
+    if platform == "cpu":
+        n_requests, feat = 120, 32
+    else:
+        n_requests, feat = 400, 64
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import serve
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.resilience import RetryPolicy, faults
+
+    lengths = (16, 32, 64)
+    spec = serve.BucketSpec(batch_sizes=(1, 2, 4, 8, 16),
+                            example_shape=(None, feat), lengths=lengths)
+
+    def make_net():
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(128, flatten=False, in_units=feat,
+                         activation="relu"),
+                nn.Dense(128, flatten=False, in_units=128,
+                         activation="relu"),
+                nn.Dense(32, flatten=False, in_units=128))
+        net.initialize(mx.init.Xavier())
+        return net
+
+    def factory(rid):
+        return serve.ModelServer(make_net(), spec,
+                                 max_queue=n_requests + 8,
+                                 linger_ms=1.0)
+
+    rng = np.random.RandomState(0)
+    requests = [rng.rand(int(rng.choice(lengths)) - int(rng.choice(5)),
+                         feat).astype(np.float32)
+                for _ in range(n_requests)]
+
+    def run_arm(n_replicas, plan=None):
+        router = serve.Router(
+            factory, n_replicas, health_sec=0.25, evict_after=3,
+            retry=RetryPolicy(max_retries=3, base_delay=0.01,
+                              max_delay=0.05, seed=7))
+        router.start()
+        if plan is not None:
+            plan.reset().arm()
+        t0 = time.perf_counter()
+        futs = [router.submit(x, deadline_ms=120_000)
+                for x in requests]
+        for f in futs:
+            f.result(timeout=300)
+        dt = time.perf_counter() - t0
+        if plan is not None:
+            # wait for the warm spare so recovery time is on record
+            t_heal = time.monotonic() + 120
+            while time.monotonic() < t_heal:
+                s = router.stats()
+                if s["healthy"] == n_replicas \
+                        and s["replacements"] >= 1:
+                    break
+                time.sleep(0.02)
+            plan.disarm()
+        router.drain(timeout=120)
+        s = router.stats()
+        compiles = sum(r.server.stats()["graph"]["post_warmup_compiles"]
+                       for r in router.replicas)
+        return dt, s, compiles
+
+    single_dt, single_s, single_compiles = run_arm(1)
+    plan = faults.FaultPlan([
+        {"site": "serve.replica.submit", "action": "raise",
+         "match": {"replica": 1}, "times": None}], seed=7)
+    pool_dt, pool_s, pool_compiles = run_arm(3, plan=plan)
+
+    import jax
+
+    dev = jax.devices()[0]
+    print(json.dumps({
+        "metric": "serve_router_pool_throughput",
+        "value": round(n_requests / pool_dt, 2),
+        "unit": "requests/sec",
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "n_requests": n_requests,
+        "pool_replicas": 3,
+        "pool_p50_ms": pool_s["latency"]["p50_ms"],
+        "pool_p99_ms": pool_s["latency"]["p99_ms"],
+        "single_rps": round(n_requests / single_dt, 2),
+        "single_p50_ms": single_s["latency"]["p50_ms"],
+        "single_p99_ms": single_s["latency"]["p99_ms"],
+        "evictions": pool_s["evictions"],
+        "replacements": pool_s["replacements"],
+        "retries": pool_s["retries"],
+        "requests_lost": pool_s["requests_lost"]
+        + single_s["requests_lost"],
+        "recovery_ms": pool_s["last_recovery_ms"],
+        "post_warmup_compiles": single_compiles + pool_compiles,
+    }))
+
+
 def _leaf_serve_int8(platform):
     """Compiled-INT8 serving A/B (contrib.quantization + ModelServer):
     the same trained classifier served three ways through identically
@@ -1107,6 +1218,7 @@ def _leaf_recovery(platform):
 _LEAVES = {"resnet": _leaf_resnet, "bert": _leaf_bert,
            "serve": _leaf_serve, "serve_decode": _leaf_serve_decode,
            "serve_int8": _leaf_serve_int8,
+           "serve_router": _leaf_serve_router,
            "trainer_step": _leaf_trainer_step,
            "input_pipeline": _leaf_input_pipeline,
            "recovery": _leaf_recovery}
@@ -1273,8 +1385,8 @@ def main():
     # are satellites of the two north-star workloads and must never
     # delay or demote them
     for model in ("bert", "resnet", "serve", "serve_decode",
-                  "serve_int8", "trainer_step", "input_pipeline",
-                  "recovery"):
+                  "serve_int8", "serve_router", "trainer_step",
+                  "input_pipeline", "recovery"):
         rec, tpu_ok = _measure(model, tpu_ok, note)
         if rec is not None:
             records[model] = rec

@@ -276,6 +276,22 @@ def _decode_serve_counters(reset=False):
     return stats
 
 
+def _router_counters(reset=False):
+    """Serve-router replica-pool counters (dispatches, retries, hedges,
+    evictions/replacements, health probes, rolling reloads) —
+    window-scoped under reset=True exactly like every other section;
+    only present when the routing tier is loaded."""
+    import sys
+
+    rt = sys.modules.get(__package__ + ".serve.router")
+    if rt is None:
+        return None
+    stats = rt.router_stats()
+    if reset:
+        rt.reset_router_stats()
+    return stats
+
+
 def _quantize_counters(reset=False):
     """INT8 quantization counters (layers quantized, calibration
     batches + wall time, requantize folds, compiled int8 serve
@@ -425,6 +441,17 @@ register_section("decodeServe", _decode_serve_counters, _rows_table(
      ("requests finished", "finished"),
      ("deadline expiries", "expired_deadlines"),
      ("slot occupancy (mean live/max)", "slot_occupancy"))))
+register_section("router", _router_counters, _rows_table(
+    "Serve Router (replica pool)",
+    (("requests dispatched", "dispatched"),
+     ("re-dispatches (retries)", "retries"),
+     ("hedged dispatches", "hedges"),
+     ("hedge wins", "hedge_wins"),
+     ("replica evictions", "evictions"),
+     ("warm replacements admitted", "replacements"),
+     ("health probes", "probes"),
+     ("health probe failures", "probe_failures"),
+     ("rolling-reload legs", "reloads"))))
 register_section("quantize", _quantize_counters, _rows_table(
     "INT8 Quantization",
     (("layers quantized", "layers_quantized"),

@@ -28,6 +28,10 @@ Fault-point catalog (site -> where it fires -> ctx keys):
                                                                  world``
 ``pipeline.map``          ``MapStage`` worker, before the fn     —
 ``serve.decode``          ``DecodeServer`` token loop, pre-step  ``step, live``
+``serve.replica.submit``  ``Router`` dispatch, before the        ``replica,
+                          replica's ``submit()``                 attempt``
+``serve.replica.health``  ``Router`` health prober, before the   ``replica``
+                          probe request
 ========================  =====================================  ==========
 
 Actions:

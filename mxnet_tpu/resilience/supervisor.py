@@ -130,23 +130,59 @@ _UNRECOVERABLE_SIGNATURES = ("no retained checkpoint",
                              "every step failed",
                              "partially mutated")
 _CORRUPT_SIGNATURES = ("corrupt", "truncated")
+# serving shed-don't-retry shapes, checked BEFORE the transient list:
+# both read "try again later", but retrying an overloaded pool is
+# exactly how a retry loop turns one slow replica into a meltdown, and
+# an exhausted deadline budget cannot be retried back into existence
+_OVERLOAD_SIGNATURES = ("queue full", "overloaded", "quota exceeded")
+_DEADLINE_SIGNATURES = ("deadline exceeded", "deadline_exceeded",
+                        "deadline passed", "deadline budget")
 _TRANSIENT_SIGNATURES = (
     "injected transient", "transient", "unavailable",
-    "resource exhausted", "resource_exhausted", "deadline exceeded",
-    "deadline_exceeded", "try again", "temporarily", "aborted",
+    "resource exhausted", "resource_exhausted",
+    "try again", "temporarily", "aborted",
 )
+
+
+def _serve_request_class(exc):
+    """``'overloaded'`` / ``'deadline'`` for the serve tier's
+    backpressure and deadline errors — resolved through ``sys.modules``
+    so classifying never imports the serving stack (if serve was never
+    imported, ``exc`` cannot be one of its exception types)."""
+    import sys
+
+    batcher = sys.modules.get(
+        __package__.rsplit(".", 1)[0] + ".serve.batcher")
+    if batcher is None:
+        return None
+    if isinstance(exc, batcher.ServerOverloadedError):
+        return "overloaded"
+    if isinstance(exc, batcher.DeadlineExceededError):
+        return "deadline"
+    return None
 
 
 def classify(exc):
     """Map an exception to its fault class: ``'transient'``,
     ``'preemption'``, ``'peer_death'``, ``'corrupt_checkpoint'``,
-    ``'watchdog'`` or ``'fatal'``."""
+    ``'watchdog'``, ``'overloaded'``, ``'deadline'`` or ``'fatal'``.
+
+    ``overloaded`` (a full bounded queue / exhausted tenant quota) and
+    ``deadline`` (an expired request budget) are NON-RETRYABLE: the
+    right reaction is shedding load (or spilling to a less-loaded
+    replica) and failing the request, respectively — a naive retry
+    loop treating their "try again"-shaped messages as ``transient``
+    burns its whole budget hammering a pool that needs the opposite.
+    """
     if isinstance(exc, TransientFault):
         return "transient"
     if isinstance(exc, Preempted):
         return "preemption"
     if isinstance(exc, WatchdogTimeout):
         return "watchdog"
+    kind = _serve_request_class(exc)
+    if kind is not None:
+        return kind
     if isinstance(exc, MXNetError):
         text = str(exc).lower()
         if any(s in text for s in _PEER_SIGNATURES):
@@ -155,6 +191,10 @@ def classify(exc):
             return "fatal"
         if any(s in text for s in _CORRUPT_SIGNATURES):
             return "corrupt_checkpoint"
+        if any(s in text for s in _OVERLOAD_SIGNATURES):
+            return "overloaded"
+        if any(s in text for s in _DEADLINE_SIGNATURES):
+            return "deadline"
         if any(s in text for s in _TRANSIENT_SIGNATURES):
             return "transient"
     return "fatal"
@@ -438,13 +478,27 @@ class Supervisor:
                         "peer death; process group re-initialized, "
                         "restarting (restart %d/%d): %s",
                         restarts, self.max_restarts, exc)
-            else:  # watchdog / corrupt_checkpoint
+            else:  # watchdog / corrupt_checkpoint / overloaded / deadline
                 if restarts >= self.max_restarts:
                     raise exc
                 restarts += 1
-                logger.warning(
-                    "%s failure; restarting (restart %d/%d): %s",
-                    kind, restarts, self.max_restarts, exc)
+                if kind in ("overloaded", "deadline"):
+                    # non-retryable at the REQUEST level (the serve
+                    # router sheds), but a training job seeing these
+                    # shapes from a collective/RPC must restart PACED:
+                    # an instant restart hammers the very resource the
+                    # error names, and back-to-back restarts would burn
+                    # the whole budget inside one network blip
+                    delay = self.retry.delay_for(restarts)
+                    logger.warning(
+                        "%s failure; backing off %.3fs before restart "
+                        "(restart %d/%d): %s", kind, delay, restarts,
+                        self.max_restarts, exc)
+                    time.sleep(delay)
+                else:
+                    logger.warning(
+                        "%s failure; restarting (restart %d/%d): %s",
+                        kind, restarts, self.max_restarts, exc)
 
             _stats.add("restarts")
             _stats.add_retry(kind)

@@ -17,5 +17,7 @@ from .batcher import (Batcher, DeadlineExceededError,  # noqa: F401
                       ServerClosedError, ServerOverloadedError)
 from .buckets import BucketOverflowError, BucketSpec  # noqa: F401
 from .decode import DecodeHandle, DecodeServer, TinyDecoder  # noqa: F401
+from .router import (NoHealthyReplicaError, Replica,  # noqa: F401
+                     ReplicaPool, Router, TenantQuotaExceededError)
 from .server import ModelServer  # noqa: F401
 from .stats import LatencyWindow, ServerStats  # noqa: F401

@@ -550,6 +550,12 @@ class DecodeServer:
         """Synchronous convenience wrapper: the full token sequence."""
         handle = self.submit(prompt, max_new_tokens=max_new_tokens,
                              deadline_ms=deadline_ms)
+        if timeout is None and deadline_ms is not None:
+            # same contract as ModelServer.predict: a deadline-only
+            # call never blocks indefinitely on a wedged server
+            from .server import PREDICT_GRACE_S
+
+            timeout = deadline_ms / 1e3 + PREDICT_GRACE_S
         try:
             return handle.result(timeout)
         except _FutureTimeout:
@@ -866,6 +872,18 @@ class DecodeServer:
 
     def live_slots(self):
         return int(self._active.sum())
+
+    def pending(self):
+        """Live load gauge for the router's least-loaded dispatch:
+        queued admissions + occupied decode slots."""
+        return len(self._batcher) + self.live_slots()
+
+    def probe_example(self):
+        """A minimal valid prompt (the smallest bucket's shape) — the
+        router's health-probe payload (probed with
+        ``max_new_tokens=1``)."""
+        shape = self._spec.bucket_shapes()[0][1:]
+        return np.full(shape, 0, dtype=self._spec.dtype)
 
     def stats(self, reset=False):
         """One snapshot of the decode tier, same window-scoping contract

@@ -45,6 +45,13 @@ from .batcher import (Batcher, DeadlineExceededError, _Request,
 from .buckets import BucketSpec
 from .stats import ServerStats
 
+#: compute + readback allowance added to a deadline-derived predict()
+#: wait: the deadline bounds QUEUE time (checked at dequeue), so an
+#: admitted batch still needs room to execute before the caller-side
+#: wait may conclude the server is wedged
+PREDICT_GRACE_S = 5.0
+
+
 def _int8_batch_hook(block):
     """The `quantize`-section booking hook for a served net, or None
     for fp32 nets (call sites guard on the server's ``_int8`` flag).
@@ -250,8 +257,17 @@ class ModelServer:
         computing its answer is pure waste, exactly like an expired
         deadline).  The batcher thread voids cancelled requests at
         dequeue, counted as ``cancelled``.
+
+        With only ``deadline_ms`` given, the wait derives its bound
+        from the deadline (``deadline_ms/1e3 + PREDICT_GRACE_S``)
+        instead of blocking indefinitely — a wedged server then fails
+        the call instead of hanging a caller who explicitly said how
+        long the answer is worth waiting for.  An explicit ``timeout``
+        always wins.
         """
         fut = self.submit(example, deadline_ms=deadline_ms)
+        if timeout is None and deadline_ms is not None:
+            timeout = deadline_ms / 1e3 + PREDICT_GRACE_S
         try:
             return fut.result(timeout)
         except _FutureTimeout:
@@ -414,6 +430,20 @@ class ModelServer:
         return {"step": meta["step"], "epoch": meta.get("epoch")}
 
     # -- observability ------------------------------------------------------
+
+    def pending(self):
+        """Live load gauge for the router's least-loaded dispatch:
+        queued + in-flight requests (cheap — no graph-stats walk)."""
+        with self._if_lock:
+            in_flight = self._in_flight
+        return len(self._batcher) + in_flight
+
+    def probe_example(self):
+        """A minimal valid request (the smallest bucket's shape, pad
+        values) — the router's health-probe payload."""
+        shape = self._spec.bucket_shapes()[0][1:]
+        return np.full(shape, self._spec.pad_value,
+                       dtype=self._spec.dtype)
 
     def _graph_stats(self):
         op = getattr(self._net, "_cached_op", None)

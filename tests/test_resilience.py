@@ -181,6 +181,18 @@ def test_classification_routes_every_fault_class():
         == "transient"
     assert classify(MXNetError("shape mismatch for 'w'")) == "fatal"
     assert classify(ValueError("boom")) == "fatal"
+    # serving shed-don't-retry classes (ISSUE 14): their "try again"-
+    # shaped messages must NOT classify as transient — a retry loop
+    # would hammer an overloaded pool / re-spend an exhausted budget
+    from mxnet_tpu.serve.batcher import (DeadlineExceededError,
+                                         ServerOverloadedError)
+
+    assert classify(ServerOverloadedError(
+        "request queue full (8); retry with backoff")) == "overloaded"
+    assert classify(DeadlineExceededError(
+        "deadline passed while queued")) == "deadline"
+    assert classify(MXNetError("DEADLINE_EXCEEDED: deadline exceeded")) \
+        == "deadline"
 
 
 def test_peer_death_msg_names_rank_and_supervisor():

@@ -1,0 +1,738 @@
+"""mxnet_tpu.serve.Router — the fault-tolerant replica pool.
+
+Covers ISSUE 14's contract: least-loaded dispatch off live queue/
+compute attribution; per-request deadline BUDGET propagation (a
+replica sees the remaining ms, not the original); transient dispatch
+failures classified through resilience.classify and retried on a
+different replica; overload spills then sheds (never burns retry
+budget hammering a full pool); health-based eviction with a warm
+spare admitted only after its full AOT warmup (zero in-traffic
+compiles on survivors — the chaos gate); per-tenant quota admission;
+tail-latency hedging; and zero-downtime rolling reload (every request
+served entirely by pre- or post-reload weights).
+"""
+import json
+import threading
+import time
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FutureTimeout
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint, serve
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.resilience import RetryPolicy, faults
+from mxnet_tpu.resilience.supervisor import classify
+from mxnet_tpu.serve.batcher import (DeadlineExceededError,
+                                     ServerOverloadedError)
+
+FEAT = 6
+
+
+def _make_net(seed=3, out_units=5):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, flatten=False, in_units=FEAT, activation="relu"),
+            nn.Dense(out_units, flatten=False, in_units=8))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def _spec(batches=(1, 2, 4), lengths=(4, 8)):
+    return serve.BucketSpec(batch_sizes=batches,
+                            example_shape=(None, FEAT), lengths=lengths)
+
+
+def _factory(seed=3, checkpoint=None, **server_kw):
+    server_kw.setdefault("max_queue", 64)
+    server_kw.setdefault("linger_ms", 0.5)
+
+    def factory(rid):
+        return serve.ModelServer(_make_net(seed=seed), _spec(),
+                                 checkpoint=checkpoint, **server_kw)
+    return factory
+
+
+def _requests(n, rng, lengths=(2, 3, 4, 7, 8)):
+    return [rng.rand(int(rng.choice(lengths)), FEAT).astype(np.float32)
+            for _ in range(n)]
+
+
+def _router(n=3, seed=3, health_sec=0.0, **kw):
+    return serve.Router(_factory(seed=seed), n, health_sec=health_sec,
+                        **kw)
+
+
+def _ref(net, x):
+    """Single-request reference forward (no server in the loop)."""
+    return net(mx.nd.array(x[None])).asnumpy()[0]
+
+
+# ---------------------------------------------------------------------------
+# routing basics
+
+
+def test_pool_serves_and_spreads_load():
+    """A 3-replica pool serves a mixed burst with results identical to
+    the single-net reference, spreads dispatches across replicas, and
+    accounts for every admitted request (requests_lost == 0)."""
+    ref_net = _make_net(seed=3)
+    router = _router(3)
+    router.start()
+    try:
+        rng = np.random.RandomState(0)
+        reqs = _requests(30, rng)
+        futs = [router.submit(x) for x in reqs]
+        outs = [f.result(timeout=120) for f in futs]
+        for x, out in zip(reqs, outs):
+            np.testing.assert_allclose(out, _ref(ref_net, x),
+                                       rtol=2e-5, atol=2e-5)
+        s = router.stats()
+        assert s["submitted"] == s["served"] == 30
+        assert s["requests_lost"] == 0
+        assert s["healthy"] == s["pool_size"] == 3
+        assert sum(r["dispatched"] for r in s["replicas"].values()) \
+            == s["dispatched"] >= 30
+        # least-loaded + tie-break rotation puts work on >1 replica
+        assert sum(1 for r in s["replicas"].values()
+                   if r["dispatched"] > 0) >= 2
+        assert s["latency"]["count"] == 30
+    finally:
+        router.shutdown()
+    for rep in router.replicas:
+        assert rep.server.stats()["graph"]["post_warmup_compiles"] == 0
+
+
+def test_least_loaded_pick_prefers_idle_replica():
+    router = _router(3)
+    router.start()
+    try:
+        a, b, c = router.replicas
+        a.ewma_ms = b.ewma_ms = c.ewma_ms = 10.0
+        b.server.pending = lambda: 5
+        c.server.pending = lambda: 2
+        a.server.pending = lambda: 0
+        assert router._pick(frozenset()) is a
+        assert router._pick({a.id}) is c
+        a.ewma_ms = 1000.0   # idle but very slow loses to short queue
+        assert router._pick(frozenset()) is c
+    finally:
+        router.shutdown(drain=False)
+
+
+def test_deadline_budget_propagation_on_retry():
+    """The replica sees the REMAINING deadline budget: after a failed
+    first dispatch and a backoff, the retry replica's deadline_ms is
+    measurably smaller than the caller's original figure."""
+    seen = []
+    router = _router(2, retry=RetryPolicy(max_retries=2, base_delay=0.15,
+                                          max_delay=0.15))
+    router.start()
+    try:
+        for rep in router.replicas:
+            orig = rep.server.submit
+
+            def spy(example, _orig=orig, deadline_ms=None, **kw):
+                seen.append(deadline_ms)
+                return _orig(example, deadline_ms=deadline_ms, **kw)
+            rep.server.submit = spy
+        plan = faults.FaultPlan([{"site": "serve.replica.submit",
+                                  "action": "raise", "on_hit": 1}])
+        x = np.zeros((4, FEAT), np.float32)
+        with faults.armed(plan):
+            out = router.submit(x, deadline_ms=2000).result(timeout=60)
+        assert out is not None
+        # the faulted first dispatch raises BEFORE reaching submit, so
+        # the spy sees exactly the retry — carrying the caller's budget
+        # MINUS the 150 ms backoff, not the original 2000
+        assert len(seen) == 1
+        assert seen[0] is not None
+        assert 0 < seen[0] <= 2000 - 140
+        s = router.stats()
+        assert s["retries"] == 1 and s["served"] == 1
+        assert s["requests_lost"] == 0
+    finally:
+        router.shutdown()
+
+
+def test_transient_dispatch_failure_retries_on_other_replica():
+    """An injected serve.replica.submit fault is classified transient
+    and re-dispatched on a DIFFERENT replica; the fault plan's fired()
+    record makes the whole scenario bit-replayable."""
+    router = _router(2)
+    router.start()
+    try:
+        plan = faults.FaultPlan([{"site": "serve.replica.submit",
+                                  "action": "raise", "on_hit": 1}],
+                                seed=5)
+        x = np.zeros((4, FEAT), np.float32)
+        with faults.armed(plan):
+            out = router.submit(x).result(timeout=60)
+        assert out.shape == (4, 5)
+        fired = plan.fired()
+        assert [f["site"] for f in fired] == ["serve.replica.submit"]
+        failed_replica = fired[0]["ctx"]["replica"]
+        s = router.stats()
+        assert s["retries"] == 1
+        served_on = [i for i, r in s["replicas"].items()
+                     if r["served"] > 0]
+        assert served_on and failed_replica not in served_on
+        assert s["requests_lost"] == 0
+    finally:
+        router.shutdown()
+
+
+def test_retry_budget_exhaustion_fails_classified():
+    """A replica failing persistently exhausts the seeded RetryPolicy;
+    the caller gets a classified error naming the attempts, never a
+    hang or a silent loss."""
+    router = _router(2, retry=RetryPolicy(max_retries=1, base_delay=0.0))
+    router.start()
+    try:
+        plan = faults.FaultPlan([{"site": "serve.replica.submit",
+                                  "action": "raise", "times": None}])
+        x = np.zeros((4, FEAT), np.float32)
+        with faults.armed(plan):
+            fut = router.submit(x)
+            with pytest.raises(mx.MXNetError, match="retry budget"):
+                fut.result(timeout=60)
+        s = router.stats()
+        assert s["failed"] == 1 and s["requests_lost"] == 0
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# overload + deadline classification (ISSUE 14 satellite)
+
+
+def test_classify_overload_and_deadline_are_not_transient():
+    """ServerOverloadedError / DeadlineExceededError get their own
+    NON-retryable classes — their 'try again'-shaped messages must not
+    read as transient, or a retry loop hammers an overloaded pool."""
+    assert classify(ServerOverloadedError(
+        "request queue full (64); retry with backoff")) == "overloaded"
+    assert classify(serve.TenantQuotaExceededError(
+        "tenant quota exceeded")) == "overloaded"
+    assert classify(DeadlineExceededError(
+        "deadline passed while queued")) == "deadline"
+    # message-shape fallback for foreign (e.g. RPC) errors
+    assert classify(mx.MXNetError(
+        "rpc error DEADLINE_EXCEEDED: deadline exceeded")) == "deadline"
+    assert classify(mx.MXNetError(
+        "backend queue full, try again")) == "overloaded"
+    # genuinely transient shapes still retry
+    assert classify(mx.MXNetError(
+        "collective UNAVAILABLE: try again")) == "transient"
+
+
+def test_overload_spills_then_sheds_without_retries():
+    """Every replica full -> the router spills across the pool once,
+    then rejects with a classified overload error; the retry budget is
+    untouched (shed load, don't hammer)."""
+    router = _router(2)
+    router.start()
+    try:
+        for rep in router.replicas:
+            def full(example, deadline_ms=None, **kw):
+                raise ServerOverloadedError("request queue full (0)")
+            rep.server.submit = full
+        fut = router.submit(np.zeros((4, FEAT), np.float32))
+        with pytest.raises(serve.NoHealthyReplicaError) as ei:
+            fut.result(timeout=30)
+        assert classify(ei.value) == "overloaded"
+        s = router.stats()
+        assert s["rejected_overload"] == 1
+        assert s["retries"] == 0        # overload burned NO retries
+        assert s["requests_lost"] == 0
+    finally:
+        router.shutdown(drain=False)
+
+
+def test_supervisor_paces_overloaded_restarts():
+    """A TRAINING job seeing overloaded/deadline-shaped failures must
+    restart with backoff, not back-to-back — instant restarts would
+    hammer the overloaded resource and burn the whole max_restarts
+    budget inside one blip."""
+    from mxnet_tpu.resilience import Supervisor
+
+    calls = []
+
+    def train(ctx):
+        calls.append(time.monotonic())
+        if len(calls) < 3:
+            raise mx.MXNetError("collective DEADLINE_EXCEEDED: "
+                                "deadline exceeded")
+        return "done"
+
+    sup = Supervisor(max_restarts=3, retry=RetryPolicy(
+        max_retries=5, base_delay=0.1, max_delay=0.1))
+    assert sup.run(train) == "done"
+    assert len(calls) == 3
+    # each re-invocation waited ~base_delay: paced, not instant
+    assert calls[1] - calls[0] >= 0.09
+    assert calls[2] - calls[1] >= 0.09
+
+
+def test_ctor_rejects_unfillable_pool():
+    srv = serve.ModelServer(_make_net(), _spec())
+    with pytest.raises(mx.MXNetError, match="no factory"):
+        serve.Router(servers=[srv], n_replicas=3)
+
+
+def test_expired_budget_fails_without_dispatch():
+    router = _router(2)
+    router.start()
+    try:
+        fut = router.submit(np.zeros((4, FEAT), np.float32),
+                            deadline_ms=-1.0)   # already exhausted
+        with pytest.raises(DeadlineExceededError, match="budget"):
+            fut.result(timeout=30)
+        s = router.stats()
+        assert s["expired_deadline"] == 1 and s["dispatched"] == 0
+        assert s["requests_lost"] == 0
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# tenant quota + hedging
+
+
+def test_tenant_quota_admission_control():
+    router = _router(2, tenant_quota=2)
+    router.start()
+    try:
+        for rep in router.replicas:
+            rep.server.submit = \
+                lambda example, deadline_ms=None, **kw: Future()
+        x = np.zeros((4, FEAT), np.float32)
+        f1 = router.submit(x, tenant="a")
+        f2 = router.submit(x, tenant="a")
+        with pytest.raises(serve.TenantQuotaExceededError) as ei:
+            router.submit(x, tenant="a")
+        assert classify(ei.value) == "overloaded"
+        f3 = router.submit(x, tenant="b")   # other tenants unaffected
+        f4 = router.submit(x)               # untenanted: no quota
+        f1.cancel()                          # resolution frees the slot
+        f5 = router.submit(x, tenant="a")
+        s = router.stats()
+        assert s["rejected_quota"] == 1
+        assert s["submitted"] == 5
+    finally:
+        router.shutdown(drain=False)
+    for f in (f2, f3, f4, f5):
+        assert f.done()
+    assert router.stats()["requests_lost"] == 0
+
+
+def test_hedge_near_deadline():
+    """A request dispatched with less budget than hedge_ms runs on two
+    replicas; the first result wins, exactly one is delivered."""
+    ref_net = _make_net(seed=3)
+    router = _router(2, hedge_ms=60_000)
+    router.start()
+    try:
+        x = np.random.RandomState(1).rand(4, FEAT).astype(np.float32)
+        out = router.submit(x, deadline_ms=30_000).result(timeout=60)
+        np.testing.assert_allclose(out, _ref(ref_net, x),
+                                   rtol=2e-5, atol=2e-5)
+        s = router.stats()
+        assert s["hedges"] == 1 and s["dispatched"] == 2
+        assert s["served"] == 1
+        assert s["requests_lost"] == 0
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the chaos gate: eviction + warm replacement under a seeded fault plan
+
+
+def test_chaos_replica_death_evicts_heals_and_loses_nothing():
+    """ISSUE 14 acceptance: a seeded plan kills 1 of 3 replicas
+    mid-burst (every dispatch to it fails) and stalls a health probe.
+    Zero admitted requests are lost (each resolves via re-dispatch),
+    the sick replica is evicted and its warm replacement rejoins after
+    a full AOT warmup, and survivors serve the whole episode with zero
+    in-traffic compiles."""
+    ref_net = _make_net(seed=3)
+    router = _router(3, health_sec=0.25, evict_after=3,
+                     retry=RetryPolicy(max_retries=3, base_delay=0.01,
+                                       max_delay=0.05))
+    router.start()
+    try:
+        survivor_ids = {r.id for r in router.replicas if r.id != 1}
+        plan = faults.FaultPlan([
+            {"site": "serve.replica.submit", "action": "raise",
+             "match": {"replica": 1}, "times": None},
+            {"site": "serve.replica.health", "action": "stall",
+             "on_hit": 2, "delay_s": 0.02, "times": 1},
+        ], seed=7)
+        rng = np.random.RandomState(0)
+        reqs = _requests(40, rng)
+        with faults.armed(plan):
+            futs = [router.submit(x, deadline_ms=30_000) for x in reqs]
+            outs = [f.result(timeout=120) for f in futs]
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                s = router.stats()
+                if s["healthy"] == 3 and s["replacements"] >= 1:
+                    break
+                time.sleep(0.02)
+        for x, out in zip(reqs, outs):
+            np.testing.assert_allclose(out, _ref(ref_net, x),
+                                       rtol=2e-5, atol=2e-5)
+        s = router.stats()
+        assert s["served"] == 40
+        assert s["requests_lost"] == 0
+        assert s["evictions"] == 1 and s["replacements"] == 1
+        assert s["healthy"] == s["pool_size"] == 3
+        assert s["retries"] >= 1
+        assert s["last_recovery_ms"] is not None
+        assert 1 not in {r.id for r in router.replicas}
+        # the replay record is deterministic and names the dead replica
+        assert all(f["ctx"].get("replica") in (1, 0, 2)
+                   for f in plan.fired())
+        assert any(f["site"] == "serve.replica.submit"
+                   and f["ctx"]["replica"] == 1 for f in plan.fired())
+        # zero in-traffic compiles on survivors AND on the warm spare
+        for rep in router.replicas:
+            assert rep.server.stats()["graph"][
+                "post_warmup_compiles"] == 0, rep.id
+            assert rep.id in survivor_ids or rep.id >= 3
+        router.drain(timeout=60)
+    finally:
+        router.shutdown(drain=False)
+
+
+def test_probe_failures_alone_evict_a_wedged_replica():
+    """Health probing catches a replica that accepts requests but
+    never answers them (a wedged batcher): consecutive probe failures
+    trip the circuit breaker without any caller traffic."""
+    router = _router(2, health_sec=0.15, evict_after=2)
+    router.start()
+    try:
+        victim = router.replicas[0]
+        victim.server.submit = \
+            lambda example, deadline_ms=None, **kw: Future()  # wedged
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            s = router.stats()
+            if s["evictions"] >= 1 and s["healthy"] >= 2:
+                break
+            time.sleep(0.02)
+        s = router.stats()
+        assert s["probe_failures"] >= 2
+        assert s["evictions"] == 1 and s["replacements"] == 1
+        assert s["healthy"] == 2
+        # the pool still serves
+        out = router.submit(
+            np.zeros((4, FEAT), np.float32)).result(timeout=60)
+        assert out.shape == (4, 5)
+    finally:
+        router.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# rolling reload (ISSUE 14 satellite: under load, old XOR new weights)
+
+
+def test_rolling_reload_under_load_serves_old_xor_new(tmp_path):
+    """A mid-burst rolling_reload() across a 3-replica pool serves
+    EVERY admitted request — each with pre-reload weights or
+    post-reload weights, never a mix within one request — at zero
+    post-warmup compiles and zero drops."""
+    trained = _make_net(seed=11)
+    mgr = checkpoint.CheckpointManager(str(tmp_path))
+    mgr.save(7, params=trained, sync=True)
+    mgr.wait_until_finished()
+
+    serving_ref = _make_net(seed=99)
+    router = serve.Router(_factory(seed=99, checkpoint=mgr), 3,
+                          health_sec=0.0)
+    router.start()
+    try:
+        rng = np.random.RandomState(2)
+        reqs = _requests(60, rng)
+        futs = [None] * len(reqs)
+
+        def submitter():
+            for i, x in enumerate(reqs):
+                futs[i] = router.submit(x, deadline_ms=60_000)
+                time.sleep(0.002)
+
+        th = threading.Thread(target=submitter)
+        th.start()
+        time.sleep(0.04)                       # mid-burst
+        metas = router.rolling_reload(timeout=60)
+        th.join()
+        # a few guaranteed-post-rollout requests (rolling_reload has
+        # returned, so every replica now holds the new weights)
+        extras = _requests(3, rng)
+        reqs += extras
+        futs += [router.submit(x, deadline_ms=60_000) for x in extras]
+        outs = [f.result(timeout=120) for f in futs]
+
+        assert [m["step"] for m in metas] == [7, 7, 7]
+        n_old = n_new = 0
+        for x, out in zip(reqs, outs):
+            old = _ref(serving_ref, x)
+            new = _ref(trained, x)
+            is_old = np.allclose(out, old, rtol=2e-5, atol=2e-5)
+            is_new = np.allclose(out, new, rtol=2e-5, atol=2e-5)
+            assert is_old != is_new     # exactly one weight set, no mix
+            n_old += is_old
+            n_new += is_new
+        assert n_new >= 3                # the rollout really landed
+        s = router.stats()
+        assert s["served"] == 63 and s["requests_lost"] == 0
+        assert s["reloads"] == 3
+        router.drain(timeout=60)
+        for rep in router.replicas:
+            st = rep.server.stats()
+            assert st["graph"]["post_warmup_compiles"] == 0
+            assert st["reloads"] == 1
+    finally:
+        router.shutdown(drain=False)
+
+
+def test_rolling_reload_single_replica_reloads_in_place(tmp_path):
+    trained = _make_net(seed=11)
+    mgr = checkpoint.CheckpointManager(str(tmp_path))
+    mgr.save(3, params=trained, sync=True)
+    mgr.wait_until_finished()
+    router = serve.Router(_factory(seed=99, checkpoint=mgr), 1,
+                          health_sec=0.0)
+    router.start()
+    try:
+        x = np.random.RandomState(5).rand(4, FEAT).astype(np.float32)
+        metas = router.rolling_reload()
+        out = router.submit(x).result(timeout=60)
+        np.testing.assert_allclose(out, _ref(trained, x),
+                                   rtol=2e-5, atol=2e-5)
+        assert [m["step"] for m in metas] == [3]
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# observability
+
+
+def test_router_section_window_scoped():
+    from mxnet_tpu import profiler
+
+    profiler.dumps(reset=True)
+    router = _router(2)
+    router.start()
+    try:
+        futs = [router.submit(np.zeros((4, FEAT), np.float32))
+                for _ in range(4)]
+        for f in futs:
+            f.result(timeout=60)
+    finally:
+        router.shutdown()
+    d = json.loads(profiler.dumps(reset=True))
+    assert d["router"]["dispatched"] >= 4
+    d2 = json.loads(profiler.dumps())
+    assert d2["router"]["dispatched"] == 0      # window rewound
+
+
+def test_router_metrics_export():
+    from mxnet_tpu.telemetry import metrics
+
+    reg = metrics.Registry()
+    router = _router(2)
+    router.start()
+    try:
+        collector = metrics.register_router(router, registry=reg)
+        futs = [router.submit(np.zeros((4, FEAT), np.float32))
+                for _ in range(3)]
+        for f in futs:
+            f.result(timeout=60)
+        page = reg.render()
+        assert 'mxtpu_router_served{router="' in page
+        assert "mxtpu_router_requests_lost" in page
+        assert "mxtpu_router_healthy" in page
+        assert 'mxtpu_router_replica_healthy{replica="0",router="' \
+            in page
+        assert "mxtpu_router_latency_ms_bucket" in page
+        reg.unregister_collector(collector)
+        assert "mxtpu_router_served" not in reg.render()
+    finally:
+        router.shutdown()
+
+
+def test_router_request_span_hop_attribution(tmp_path):
+    """A traced pooled request leaves a balanced serve.router.request
+    async span whose dispatch-hop instants attribute each attempt to a
+    replica with the remaining budget at that hop."""
+    from mxnet_tpu import telemetry
+
+    router = _router(2)
+    router.start()
+    trace_path = str(tmp_path / "router.trace.json")
+    try:
+        plan = faults.FaultPlan([{"site": "serve.replica.submit",
+                                  "action": "raise", "on_hit": 1}])
+        with telemetry.trace(trace_path):
+            with faults.armed(plan):
+                router.submit(np.zeros((4, FEAT), np.float32),
+                              deadline_ms=30_000).result(timeout=60)
+    finally:
+        router.shutdown()
+    events = json.load(open(trace_path))["traceEvents"]
+    begins = [e for e in events if e["ph"] == "b"
+              and e["name"] == "serve.router.request"]
+    ends = [e for e in events if e["ph"] == "e"
+            and e["name"] == "serve.router.request"]
+    hops = [e for e in events if e["ph"] == "n"
+            and e["name"] == "serve.router.dispatch"]
+    assert len(begins) == len(ends) == 1
+    assert ends[0]["args"]["outcome"] == "served"
+    assert ends[0]["args"]["attempts"] == 2
+    assert len(hops) == 1    # the faulted attempt never reached submit
+    assert hops[0]["args"]["replica"] in (0, 1)
+    assert 0 < hops[0]["args"]["remaining_ms"] <= 30_000
+
+
+# ---------------------------------------------------------------------------
+# decode pool
+
+
+def test_decode_pool_routes():
+    """The router fronts DecodeServer replicas through the same edge:
+    submit kwargs (max_new_tokens) pass through, results are the full
+    token sequences, and probing auto-adapts (one-token probes)."""
+    VOCAB = 32
+
+    def make_model():
+        mx.random.seed(4)
+        m = serve.TinyDecoder(vocab=VOCAB, embed=8)
+        m.initialize(mx.init.Xavier())
+        return m
+
+    dspec = serve.BucketSpec(batch_sizes=(1, 2), example_shape=(None,),
+                             lengths=(4, 8), dtype="int32")
+
+    def factory(rid):
+        return serve.DecodeServer(make_model(), dspec, max_slots=2,
+                                  max_len=16)
+
+    ref_srv = serve.DecodeServer(make_model(), dspec, max_slots=2,
+                                 max_len=16)
+    ref_srv.start()
+    router = serve.Router(factory, 2, health_sec=0.0)
+    router.start()
+    try:
+        rng = np.random.RandomState(3)
+        prompts = [rng.randint(0, VOCAB, size=int(rng.randint(2, 7)))
+                   .astype(np.int32) for _ in range(6)]
+        futs = [router.submit(p, max_new_tokens=4) for p in prompts]
+        outs = [f.result(timeout=120) for f in futs]
+        for p, out in zip(prompts, outs):
+            ref = ref_srv.generate(p, max_new_tokens=4, timeout=120)
+            np.testing.assert_array_equal(out, ref)
+        s = router.stats()
+        assert s["served"] == 6 and s["requests_lost"] == 0
+    finally:
+        router.shutdown()
+        ref_srv.shutdown()
+    for rep in router.replicas:
+        assert rep.server.stats()["graph"]["post_warmup_compiles"] == 0
+
+
+# ---------------------------------------------------------------------------
+# lifecycle
+
+
+@pytest.mark.slow
+def test_router_concurrent_stress_under_lock_checker(tmp_path):
+    """8 submitter threads, an injected replica death mid-stream, and a
+    rolling reload — all under the runtime lock-order checker
+    (raise-on-inversion): every request resolves or fails classified,
+    the pool heals, zero requests lost, zero inversions observed."""
+    from mxnet_tpu.analysis import runtime as lockrt
+    from mxnet_tpu.resilience.supervisor import classify as _classify
+
+    trained = _make_net(seed=11)
+    mgr = checkpoint.CheckpointManager(str(tmp_path))
+    mgr.save(1, params=trained, sync=True)
+    mgr.wait_until_finished()
+
+    lockrt.enable(raise_on_inversion=True)
+    lockrt.wrap_existing()
+    try:
+        router = serve.Router(
+            _factory(seed=3, checkpoint=mgr), 3, health_sec=0.2,
+            evict_after=3,
+            retry=RetryPolicy(max_retries=3, base_delay=0.01,
+                              max_delay=0.05))
+        router.start()
+        plan = faults.FaultPlan([
+            {"site": "serve.replica.submit", "action": "raise",
+             "match": {"replica": 2}, "times": None}], seed=11)
+        results, errors = [], []
+        lock = threading.Lock()
+
+        def submitter(seed):
+            rng = np.random.RandomState(seed)
+            for x in _requests(25, rng):
+                try:
+                    out = router.submit(
+                        x, deadline_ms=60_000).result(timeout=120)
+                    with lock:
+                        results.append(out)
+                except Exception as e:  # noqa: BLE001 — audited below
+                    with lock:
+                        errors.append(e)
+        with faults.armed(plan):
+            threads = [threading.Thread(target=submitter, args=(i,))
+                       for i in range(8)]
+            for t in threads:
+                t.start()
+            time.sleep(0.05)
+            router.rolling_reload(timeout=120)
+            for t in threads:
+                t.join()
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                s = router.stats()
+                if s["healthy"] == 3 and s["replacements"] >= 1:
+                    break
+                time.sleep(0.02)
+        assert len(results) + len(errors) == 8 * 25
+        for e in errors:     # every failure classified, none mysterious
+            assert _classify(e) in ("transient", "overloaded",
+                                    "deadline")
+        s = router.stats()
+        assert s["requests_lost"] == 0
+        assert s["evictions"] == 1 and s["healthy"] == 3
+        router.drain(timeout=120)
+        for rep in router.replicas:
+            assert rep.server.stats()["graph"][
+                "post_warmup_compiles"] == 0
+        assert lockrt.stats()["inversions"] == 0
+    finally:
+        lockrt.disable()
+
+
+def test_shutdown_abrupt_resolves_everything():
+    router = _router(2)
+    router.start()
+    for rep in router.replicas:
+        rep.server.submit = \
+            lambda example, deadline_ms=None, **kw: Future()
+    futs = [router.submit(np.zeros((4, FEAT), np.float32))
+            for _ in range(3)]
+    router.shutdown(drain=False)
+    for f in futs:
+        assert f.done()
+        with pytest.raises(serve.ServerClosedError):
+            f.result(timeout=0)
+    assert router.stats()["requests_lost"] == 0
+    with pytest.raises(serve.ServerClosedError):
+        router.submit(np.zeros((4, FEAT), np.float32))

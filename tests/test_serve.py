@@ -271,6 +271,39 @@ def test_predict_timeout_voids_queued_request():
     assert s["in_flight"] == 0 and s["queue_depth"] == 0
 
 
+def test_predict_deadline_derives_wait_bound(monkeypatch):
+    """ISSUE 14 satellite: predict(deadline_ms=) without an explicit
+    timeout derives the caller-side wait from the deadline (plus a
+    compute grace) instead of blocking indefinitely — a wedged server
+    fails the call in bounded time.  An explicit timeout still wins."""
+    from concurrent.futures import Future
+    from concurrent.futures import TimeoutError as FutTimeout
+
+    from mxnet_tpu.serve import server as server_mod
+
+    monkeypatch.setattr(server_mod, "PREDICT_GRACE_S", 0.2)
+    srv = serve.ModelServer(_make_net(), _spec(), max_queue=16,
+                            linger_ms=0.5)
+    srv.start()
+    try:
+        # a wedged submit path: the future never resolves
+        monkeypatch.setattr(
+            srv, "submit", lambda example, deadline_ms=None: Future())
+        x = np.zeros((4, FEAT), np.float32)
+        t0 = time.monotonic()
+        with pytest.raises(FutTimeout):
+            srv.predict(x, deadline_ms=100)     # would hang before
+        dt = time.monotonic() - t0
+        assert 0.1 <= dt < 2.0                  # ~deadline + grace
+        t0 = time.monotonic()
+        with pytest.raises(FutTimeout):
+            srv.predict(x, deadline_ms=60_000, timeout=0.05)
+        assert time.monotonic() - t0 < 1.0      # explicit timeout wins
+    finally:
+        monkeypatch.undo()
+        srv.drain()
+
+
 def test_per_bucket_padding_and_fill_stats():
     """ISSUE 11 satellite: stats() exposes per-bucket fill-ratio and
     padding-overhead splits (not just the aggregates), and the /metrics

@@ -329,10 +329,11 @@ def test_registered_sections_cover_all_subsystems():
     import mxnet_tpu.pipeline  # noqa: F401
     import mxnet_tpu.resilience  # noqa: F401
     import mxnet_tpu.serve.decode  # noqa: F401
+    import mxnet_tpu.serve.router  # noqa: F401
 
     d = json.loads(profiler.dumps())
     for section in ("cachedGraph", "trainerStep", "dataPipeline",
-                    "resilience", "telemetry", "decodeServe"):
+                    "resilience", "telemetry", "decodeServe", "router"):
         assert section in d, sorted(d)
 
 

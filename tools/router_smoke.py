@@ -1,0 +1,181 @@
+"""`make router-smoke`: fault-tolerant-serving CI gate (ISSUE 14).
+
+A 3-replica pool takes a mixed-length burst while a seeded fault plan
+kills one replica (every dispatch to it fails) and stalls a health
+probe mid-burst.  Asserts the chaos-gate contract from docs/serving.md:
+
+    every admitted request resolves via re-dispatch, or fails with a
+    CLASSIFIED error carrying its attempt attribution   (none lost)
+    the sick replica is evicted and a warm spare rejoins -> healthy==3
+    zero post-warmup compiles on survivors AND on the spare
+    a subsequent rolling_reload() under load drops zero requests
+    requests_lost == 0 through the whole episode
+
+Exit code 0 = every invariant holds.  Runs on the CPU backend so it is
+chip-independent.
+"""
+import json
+import sys
+import threading
+import time
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import checkpoint, serve
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.resilience import RetryPolicy, faults
+    from mxnet_tpu.resilience.supervisor import classify
+
+    feat, burst = 8, 120
+    lengths = (4, 8, 16)
+
+    def make_net(seed=0):
+        mx.random.seed(seed)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, flatten=False, in_units=feat,
+                         activation="relu"),
+                nn.Dense(4, flatten=False, in_units=16))
+        net.initialize(mx.init.Xavier())
+        return net
+
+    import tempfile
+
+    spec = serve.BucketSpec(batch_sizes=(1, 2, 4),
+                            example_shape=(None, feat), lengths=lengths)
+    ckpt_dir = tempfile.mkdtemp(prefix="router-smoke-")
+    mgr = checkpoint.CheckpointManager(ckpt_dir)
+    mgr.save(1, params=make_net(seed=0), sync=True)
+    mgr.wait_until_finished()
+
+    def factory(rid):
+        return serve.ModelServer(make_net(seed=0), spec, max_queue=64,
+                                 linger_ms=1.0, checkpoint=mgr)
+
+    router = serve.Router(
+        factory, 3, health_sec=0.25, evict_after=3,
+        retry=RetryPolicy(max_retries=3, base_delay=0.01,
+                          max_delay=0.05, seed=7))
+    router.start()
+    survivors = [r for r in router.replicas if r.id != 1]
+
+    # replica 1 dies mid-burst (every dispatch to it raises) and one
+    # health probe stalls — both seeded, both bit-replayable
+    plan = faults.FaultPlan([
+        {"site": "serve.replica.submit", "action": "raise",
+         "match": {"replica": 1}, "times": None},
+        {"site": "serve.replica.health", "action": "stall",
+         "on_hit": 2, "delay_s": 0.05, "times": 1},
+    ], seed=7)
+
+    rng = np.random.RandomState(0)
+    failures = []
+
+    def check(name, cond):
+        if not cond:
+            failures.append(name)
+
+    resolved, classified_failures = 0, 0
+    with faults.armed(plan):
+        futs = []
+        for _ in range(burst):
+            x = rng.rand(int(rng.choice(lengths)),
+                         feat).astype(np.float32)
+            futs.append(router.submit(x, deadline_ms=30_000))
+        for f in futs:
+            try:
+                f.result(timeout=120)
+                resolved += 1
+            except mx.MXNetError as e:
+                # acceptable ONLY when classified with attribution
+                check("failure is classified",
+                      classify(e) in ("transient", "overloaded",
+                                      "deadline"))
+                check("failure names its attempts",
+                      "replica" in str(e) or "attempt" in str(e))
+                classified_failures += 1
+        # pool heals back to 3 with a fully-warmed spare
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            s = router.stats()
+            if s["healthy"] == 3 and s["replacements"] >= 1:
+                break
+            time.sleep(0.02)
+
+    s = router.stats()
+    check("every admitted request resolved or failed classified",
+          resolved + classified_failures == burst)
+    check("zero requests silently lost", s["requests_lost"] == 0)
+    check("the sick replica was evicted", s["evictions"] == 1)
+    check("a warm spare was admitted", s["replacements"] == 1)
+    check("pool healed back to 3 replicas",
+          s["healthy"] == s["pool_size"] == 3)
+    check("re-dispatches happened", s["retries"] >= 1)
+    check("health probes ran", s["probes"] >= 1)
+    check("recovery time recorded", s["last_recovery_ms"] is not None)
+    check("fault plan fired deterministically",
+          any(f["site"] == "serve.replica.submit"
+              and f["ctx"]["replica"] == 1 for f in plan.fired()))
+    for rep in router.replicas:
+        check(f"zero in-traffic compiles on replica {rep.id}",
+              rep.server.stats()["graph"]["post_warmup_compiles"] == 0)
+
+    # rolling reload UNDER LOAD: a second burst in flight while every
+    # replica drains -> reloads -> rejoins; zero drops, zero compiles
+    reload_burst = 60
+    futs2 = [None] * reload_burst
+
+    def submitter():
+        for i in range(reload_burst):
+            x = rng.rand(int(rng.choice(lengths)),
+                         feat).astype(np.float32)
+            futs2[i] = router.submit(x, deadline_ms=30_000)
+            time.sleep(0.002)
+
+    th = threading.Thread(target=submitter)
+    th.start()
+    time.sleep(0.04)
+    metas = router.rolling_reload(timeout=60)
+    th.join()
+    dropped = 0
+    for f in futs2:
+        try:
+            f.result(timeout=120)
+        except Exception:  # noqa: BLE001 — any failure = a drop
+            dropped += 1
+    check("rolling reload dropped zero requests", dropped == 0)
+    check("every replica reloaded",
+          len(metas) == 3 and all(m["step"] == 1 for m in metas))
+    s2 = router.stats()
+    check("zero requests lost through the reload",
+          s2["requests_lost"] == 0)
+    for rep in router.replicas:
+        check(f"zero post-reload compiles on replica {rep.id}",
+              rep.server.stats()["graph"]["post_warmup_compiles"] == 0)
+
+    router.drain(timeout=60)
+    print(json.dumps({k: s2[k] for k in
+                      ("served", "failed", "retries", "evictions",
+                       "replacements", "reloads", "requests_lost",
+                       "healthy", "last_recovery_ms")}))
+
+    if failures:
+        print("router-smoke FAILED: " + "; ".join(failures),
+              file=sys.stderr)
+        return 1
+    print(f"router-smoke OK: {s2['served']} served across the kill + "
+          f"reload episodes, {s2['retries']} re-dispatches, eviction "
+          f"healed in {s['last_recovery_ms']}ms, "
+          f"{len(metas)} rolling-reload legs, 0 lost, 0 in-traffic "
+          "compiles")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
