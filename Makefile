@@ -130,6 +130,17 @@ elastic-smoke:
 trace-smoke:
 	env PYTHONPATH=. python tools/trace_smoke.py
 
+# health-monitor gate: a supervised pipeline-fed run under an armed
+# HealthMonitor — an injected straggler stall is named (rank + phase)
+# within K ticks, a deliberately input-starved phase fires the SLO
+# rule and flips /healthz degraded->ok, goodput debits injected
+# restart time, MFU is reported for the whole-step path,
+# mxtpu_health_* scrapes agree with dumps, zero post-warmup compiles,
+# and the disarmed hook costs ~nothing — see tools/health_smoke.py /
+# docs/observability.md "Health monitor"
+health-smoke:
+	env PYTHONPATH=. python tools/health_smoke.py
+
 # static-analysis gate: the mxtpu-analyze pass families (lock-order
 # races, trace-safety, determinism, repo invariants) must run clean
 # modulo the justified baseline, within the ~30s latency budget — see
@@ -139,7 +150,7 @@ analyze:
 
 # the ROADMAP tier-1 gate, verbatim ($$ = make-escaped shell $)
 verify: SHELL := /bin/bash
-verify: analyze serve-smoke router-smoke decode-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke elastic-smoke trace-smoke
+verify: analyze serve-smoke router-smoke decode-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke elastic-smoke trace-smoke health-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
-.PHONY: all clean test verify analyze serve-smoke router-smoke decode-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke elastic-smoke trace-smoke
+.PHONY: all clean test verify analyze serve-smoke router-smoke decode-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke elastic-smoke trace-smoke health-smoke

@@ -1426,6 +1426,25 @@ def main():
     if note:
         result["note"] = "; ".join(note)
     print(json.dumps(result))
+    _append_history(result)
+
+
+def _append_history(result):
+    """Append this run's full record to BENCH_HISTORY.jsonl (newest
+    last; MXTPU_BENCH_HISTORY moves the file) — the trajectory
+    tools/bench_diff.py reads to flag per-leaf regressions between
+    consecutive runs.  Best-effort: a read-only checkout must not fail
+    the bench."""
+    path = os.environ.get("MXTPU_BENCH_HISTORY") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_HISTORY.jsonl")
+    try:
+        entry = dict(result)
+        entry["ts"] = time.time()
+        with open(path, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except OSError:
+        pass
 
 
 if __name__ == "__main__":

@@ -13,6 +13,7 @@ from .. import kvstore as _kvstore
 from .. import optimizer as _opt
 from .. import profiler as _profiler
 from ..base import MXNetError
+from ..telemetry import health as _health
 from .parameter import Parameter, ParameterDict
 
 # ---------------------------------------------------------------------------
@@ -509,6 +510,10 @@ class Trainer:
                 _step_stats["whole_step_compiles"] += wstats["compiles"]
                 if wstats.get("zero"):
                     _step_stats["zero_steps"] += 1
+                # health-monitor FLOP geometry (batch size + param
+                # elements -> the analytic MFU fallback); disarmed
+                # this is the module no-op
+                _health.note_whole_step(self, batch_size)
                 return loss
         return self._eager_whole_step(block, loss_fn, inputs, y,
                                       batch_size)

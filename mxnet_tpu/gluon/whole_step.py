@@ -45,6 +45,7 @@ from .. import random as _random
 from ..base import MXNetError
 from ..log import get_logger
 from ..ndarray.ndarray import NDArray, _wrap
+from ..telemetry import health as _health
 from . import block as _block_mod
 
 _log = get_logger("mxnet_tpu.whole_step")
@@ -212,6 +213,14 @@ class WholeStepCompiler:
                     new_ws, new_sts, other_params, loss_raw,
                     zero=zero_world is not None)
         _engine.track(loss_out)
+        if compiles and donate is None:
+            # fresh NON-donating executable (the warmup call, so the
+            # buffers in `args` are still live): let an armed health
+            # monitor read the whole-step FLOP count from the lowered
+            # cost analysis — disarmed this is the module no-op
+            _health.note_whole_step_compiled(
+                jitted, (key_raw, train_ws, sts, other_ws, xs, y_raw,
+                         sval_raws))
         stats = {"compiles": compiles,
                  "buckets": meta.get("buckets", 0),
                  "zero": zero_world is not None}

@@ -167,24 +167,32 @@ def num_workers():
 _world_mesh_cache = None
 _allreduce_jit_cache = {}
 _gather_jit_cache = {}
+# mesh/jit-cache guard: aggregate() now also runs from background
+# threads (the HealthMonitor ticker), so the lazy builds below must
+# not race a concurrent first call or a reinit() teardown
+_cache_lock = threading.Lock()
 
 
 def _world_mesh():
     """One device per process on a 'world' axis — the DCN reduction mesh
-    (ref: ps-lite's worker group; here XLA owns the transport)."""
+    (ref: ps-lite's worker group; here XLA owns the transport).  Check
+    AND build under the lock: a build that merely installed under it
+    could still enumerate the old world's devices concurrently with a
+    reinit() teardown and cache a mesh over a dead backend."""
     global _world_mesh_cache
-    if _world_mesh_cache is None:
-        import numpy as np
+    with _cache_lock:
+        if _world_mesh_cache is None:
+            import numpy as np
 
-        import jax
-        from jax.sharding import Mesh
+            import jax
+            from jax.sharding import Mesh
 
-        per_proc = {}
-        for d in jax.devices():
-            per_proc.setdefault(d.process_index, d)
-        devs = [per_proc[i] for i in sorted(per_proc)]
-        _world_mesh_cache = Mesh(np.array(devs), ("world",))
-    return _world_mesh_cache
+            per_proc = {}
+            for d in jax.devices():
+                per_proc.setdefault(d.process_index, d)
+            devs = [per_proc[i] for i in sorted(per_proc)]
+            _world_mesh_cache = Mesh(np.array(devs), ("world",))
+        return _world_mesh_cache
 
 
 def world_mesh():
@@ -230,12 +238,16 @@ def allreduce(value):
         gshape, sharded,
         [jax.device_put(jnp.asarray(x)[None], my_dev)])
 
-    key = (gshape, str(x.dtype))
+    # keyed on the MESH too (like _gather_jit_cache) and installed
+    # under the same lock reinit() clears under, so an entry can never
+    # outlive its mesh bound to a torn-down backend's NamedSharding
+    key = (mesh, gshape, str(x.dtype))
     fn = _allreduce_jit_cache.get(key)
     if fn is None:
         repl = NamedSharding(mesh, PartitionSpec())
         fn = jax.jit(lambda a: a.sum(axis=0), out_shardings=repl)
-        _allreduce_jit_cache[key] = fn
+        with _cache_lock:
+            fn = _allreduce_jit_cache.setdefault(key, fn)
     out = _bounded(
         lambda: jnp.asarray(fn(garr).addressable_data(0)),
         f"dist_sync all-reduce of {gshape[1:]} {x.dtype}")
@@ -280,7 +292,8 @@ def _allgather_rows(mesh, axis_size, my_index, row, _local_rows=None):
     if fn is None:
         repl = NamedSharding(mesh, PartitionSpec())
         fn = jax.jit(lambda a: a, out_shardings=repl)
-        _gather_jit_cache[key] = fn
+        with _cache_lock:
+            fn = _gather_jit_cache.setdefault(key, fn)
     out = fn(garr)
     return np.asarray(_bounded(lambda: out.addressable_data(0),
                                f"allgather of {gshape}"))
@@ -350,9 +363,10 @@ def reinit(num_processes=None, process_id=None):
         jax.distributed.shutdown()
     except Exception:  # noqa: BLE001 — already dead is fine
         pass
-    _world_mesh_cache = None
-    _allreduce_jit_cache.clear()
-    _gather_jit_cache.clear()
+    with _cache_lock:
+        _world_mesh_cache = None
+        _allreduce_jit_cache.clear()
+        _gather_jit_cache.clear()
     _initialized = False
     if num_processes is not None:
         init(num_processes=int(num_processes),

@@ -15,6 +15,7 @@ import threading
 import time
 
 from .base import getenv
+from .telemetry import health as _health
 from .telemetry import tracer as _tracer
 
 _state = threading.local()
@@ -175,10 +176,16 @@ class _OpScope:
         self.t0 = time.perf_counter() * 1e6
         return self
 
-    def __exit__(self, *a):
-        record_op(self.name, self.t0, time.perf_counter() * 1e6,
-                  cat=self.cat)
+    def __exit__(self, exc_type, *a):
+        t1 = time.perf_counter() * 1e6
+        record_op(self.name, self.t0, t1, cat=self.cat)
         _tracer.span_end(self.name, self.cat)
+        if exc_type is None:
+            # health-monitor phase sink (telemetry.health): disarmed
+            # it IS the module no-op, same ~ns contract as the tracer
+            # hook above; a scope aborted by an exception books no
+            # phase time (a failed step is not a completed step)
+            _health.scope_end(self.name, self.cat, self.t0, t1)
         if _scope_track:
             with _scope_lock:
                 stack = _open_scopes.get(threading.get_ident())
@@ -305,6 +312,19 @@ def _quantize_counters(reset=False):
     stats = qz.quantize_stats()
     if reset:
         qz.reset_quantize_stats()
+    return stats
+
+
+def _health_counters(reset=False):
+    """Health-monitor counters (per-step phase breakdown ms, goodput/
+    MFU gauges, SLO alerts, straggler flags) — window-scoped under
+    reset=True exactly like every other section; only present once a
+    HealthMonitor has been armed (telemetry.health)."""
+    stats = _health.health_stats()
+    if stats is None:
+        return None
+    if reset:
+        _health.reset_health_stats()
     return stats
 
 
@@ -459,6 +479,26 @@ register_section("quantize", _quantize_counters, _rows_table(
      ("calibration time (ms)", "calib_ms"),
      ("requantize folds", "requant_folds"),
      ("int8 serve batches", "int8_serve_batches"))))
+register_section("health", _health_counters, _rows_table(
+    "Health Monitor",
+    (("steps observed", "steps"),
+     ("step time (ms)", "step_ms"),
+     ("input wait (ms)", "input_wait_ms"),
+     ("h2d staging (ms)", "h2d_ms"),
+     ("compute (ms)", "compute_ms"),
+     ("collective (ms)", "collective_ms"),
+     ("optimizer (ms)", "optimizer_ms"),
+     ("checkpoint stall (ms)", "checkpoint_ms"),
+     ("compile (ms)", "compile_ms"),
+     ("lost to recovery (ms)", "lost_ms"),
+     ("monitor ticks", "ticks"),
+     ("SLO alerts fired", "alerts"),
+     ("stragglers flagged", "stragglers"),
+     ("rules firing now", "rules_firing"),
+     ("goodput (last window)", "goodput"),
+     ("MFU (last window)", "mfu"),
+     ("FLOPs per step", "flops_per_step"),
+     ("step p95 (ms)", "step_p95_ms"))))
 register_section("telemetry", _telemetry_counters, _rows_table(
     "Telemetry (tracer / flight recorder / metrics)",
     (("spans recorded", "spans"),

@@ -731,6 +731,15 @@ class Supervisor:
                  f"{', '.join(phases)}" if phases else
                  "no profiler section is open — the stall is in user "
                  "code between instrumented phases")
+        # an armed HealthMonitor knows what was SLOW before the hang
+        # (phase breakdown + firing SLO rules), not just which scope is
+        # open now — append its last window to the diagnostic
+        try:
+            from ..telemetry import health as _health
+
+            where += _health.describe_for_diagnostic()
+        except Exception:  # noqa: BLE001 — diagnosis must never fail
+            pass
         return (
             f"watchdog: no training step completed in {idle:.1f}s "
             f"(MXTPU_WATCHDOG_SEC={self.watchdog_sec:g}; last completed "

@@ -26,7 +26,8 @@ import contextlib
 import json
 
 from ..base import getenv
-from . import flight, httpd, metrics, tracer  # noqa: F401
+from . import flight, health, httpd, metrics, tracer  # noqa: F401
+from .health import HealthMonitor, SLORule, active_monitor  # noqa: F401
 from .httpd import (MetricsServer, metrics_server,  # noqa: F401
                     start_metrics_server, stop_metrics_server)
 from .metrics import Registry, default_registry, register_server  # noqa: F401
@@ -34,7 +35,8 @@ from .tracer import armed, start_trace, stop_trace  # noqa: F401
 
 __all__ = [
     "trace", "start_trace", "stop_trace", "armed", "tracing",
-    "sections", "aggregate", "tracer", "flight", "metrics", "httpd",
+    "sections", "aggregate", "tracer", "flight", "health", "metrics",
+    "httpd", "HealthMonitor", "SLORule", "active_monitor",
     "MetricsServer", "Registry", "default_registry", "register_server",
     "metrics_server", "start_metrics_server", "stop_metrics_server",
 ]

@@ -61,12 +61,20 @@ class MetricsServer:
                     body = registry.render().encode()
                     self._reply(200, _CONTENT_TYPE, body)
                 elif path in ("/healthz", "/health", "/healthz/"):
-                    body = (json.dumps({
+                    payload = {
                         "status": "ok",
                         "uptime_s": round(time.monotonic() - t0, 3),
                         "pid": os.getpid(),
                         "rank": _rank(),
-                    }) + "\n").encode()
+                    }
+                    hz = _health_payload()
+                    if hz is not None:
+                        # an armed HealthMonitor owns the verdict:
+                        # status flips ok <-> degraded with its SLO
+                        # rules; with no monitor this stays the plain
+                        # liveness 200 above
+                        payload.update(hz)
+                    body = (json.dumps(payload) + "\n").encode()
                     self._reply(200, "application/json", body)
                 else:
                     self._reply(404, "text/plain",
@@ -99,6 +107,18 @@ class MetricsServer:
         self._thread.join(timeout=2.0)
         self._httpd = None
         self._thread = None
+
+
+def _health_payload():
+    """The armed HealthMonitor's status dict, or None (no monitor) —
+    a liveness probe must never fail because the interpretation layer
+    hiccuped."""
+    try:
+        from . import health
+
+        return health.healthz()
+    except Exception:  # noqa: BLE001 — liveness answers regardless
+        return None
 
 
 def _rank():
