@@ -141,6 +141,16 @@ trace-smoke:
 health-smoke:
 	env PYTHONPATH=. python tools/health_smoke.py
 
+# autotuner gate: from a deliberately bad config (1 MB buckets,
+# aggregate_num=1, no prefetch, zero linger, one giant serve bucket)
+# the closed loop must escape by a gated margin on a real
+# training+serving rehearsal, beat-or-tie the hand-tuned defaults,
+# leave a bench_diff-readable evidence trail, and settle on a config
+# whose serving surface is closed (zero post-warmup compiles) — see
+# tools/tune_smoke.py / docs/tuning.md
+tune-smoke:
+	env PYTHONPATH=. python tools/tune_smoke.py
+
 # static-analysis gate: the mxtpu-analyze pass families (lock-order
 # races, trace-safety, determinism, repo invariants) must run clean
 # modulo the justified baseline, within the ~30s latency budget — see
@@ -150,7 +160,7 @@ analyze:
 
 # the ROADMAP tier-1 gate, verbatim ($$ = make-escaped shell $)
 verify: SHELL := /bin/bash
-verify: analyze serve-smoke router-smoke decode-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke elastic-smoke trace-smoke health-smoke
+verify: analyze serve-smoke router-smoke decode-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke elastic-smoke trace-smoke health-smoke tune-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
-.PHONY: all clean test verify analyze serve-smoke router-smoke decode-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke elastic-smoke trace-smoke health-smoke
+.PHONY: all clean test verify analyze serve-smoke router-smoke decode-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke elastic-smoke trace-smoke health-smoke tune-smoke

@@ -1,7 +1,7 @@
 """mxtpu-analyze: framework-aware static analysis over the mxnet_tpu
 package (docs/static-analysis.md has the pass catalog).
 
-Four pass families, each a plain ``run(index) -> [Finding]``:
+Five pass families, each a plain ``run(index) -> [Finding]``:
 
 ==========  ==============================================================
 MXA1xx      lock-order race detection (cycles, unguarded shared globals,
@@ -15,6 +15,8 @@ MXA3xx      determinism of the seeded-replay surface (wallclock or
 MXA4xx      repo invariants (base.getenv + ENV_VARS.md, profiler
             section registry + window-scoped resets, fault-point
             catalog, telemetry span/metric catalog) — :mod:`.invariants`
+MXA5xx      knob-registry invariants (every tune Knob names a
+            documented env var and declares bounds) — :mod:`.tune`
 ==========  ==============================================================
 
 Entry points: ``tools/mxtpu_analyze.py`` (= ``make analyze``, wired
@@ -24,7 +26,7 @@ into ``make verify``); :func:`analyze` for programmatic use; and
 """
 from __future__ import annotations
 
-from . import determinism, invariants, locks, trace
+from . import determinism, invariants, locks, trace, tune
 from .core import (AnalysisConfig, Finding, Index, apply_baseline,
                    load_baseline, run_passes)
 
@@ -34,6 +36,7 @@ PASSES = (
     ("trace", trace.run),
     ("determinism", determinism.run),
     ("invariants", invariants.run),
+    ("tune", tune.run),
 )
 
 PASS_CODES = {
@@ -41,6 +44,7 @@ PASS_CODES = {
     "trace": ("MXA201", "MXA202", "MXA203", "MXA204"),
     "determinism": ("MXA301", "MXA302"),
     "invariants": ("MXA401", "MXA402", "MXA403", "MXA404", "MXA405"),
+    "tune": ("MXA501", "MXA502"),
 }
 
 

@@ -129,6 +129,11 @@ class AnalysisConfig:
                             "request_begin")
     metric_def_fns: tuple = ("counter", "gauge", "histogram")
     metric_name_prefix: str = "mxtpu_"
+    # knob-registry invariants (MXA501/502): the module whose literal
+    # Knob(...) constructor calls define the autotuner's control
+    # surface, and the constructor names to look for
+    tune_knobs_module: str = "tune.knobs"
+    knob_ctor_names: tuple = ("Knob",)
     # modules allowed to touch os.environ directly (the config tier)
     env_exempt_modules: tuple = ("base",)
     # raw env names allowed outside base.getenv (launcher wire protocol,

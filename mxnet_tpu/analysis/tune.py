@@ -1,0 +1,112 @@
+"""MXA5xx — knob-registry invariants (the autotuner's control surface).
+
+The tune registry (:mod:`mxnet_tpu.tune.knobs`) is the list of
+settings the tuner is allowed to move.  Two things make a registry
+entry trustworthy, and both are statically checkable off the literal
+``Knob(...)`` constructor kwargs:
+
+MXA501  undocumented / unbound env var — a ``Knob`` whose ``env=``
+        kwarg is missing or non-literal, or whose ``MXTPU_<env>``
+        spelling does not appear in docs/ENV_VARS.md.  The registry
+        is MXA402's rule applied one layer up: every knob the tuner
+        may move must be a documented config surface, or an adopted
+        recommendation is un-reproducible outside the tuner's
+        process.
+MXA502  missing bounds — a numeric ``Knob`` with neither a literal
+        non-empty ``domain=`` candidate set nor a literal
+        ``bounds=(lo, hi)`` with ``lo < hi``.  An unbounded knob
+        gives the search an open-ended space and the trial runner a
+        license to apply nonsense; ``kind="bool"``/``"choice"``
+        knobs carry their domain by construction and are exempt
+        (choice still needs the ``domain=`` itself, which MXA502
+        checks).
+
+Both passes read the constructor call sites, so drift between the
+registry and the docs is a CI finding, not a reviewer catch.  The pass
+is a no-op when the configured knobs module does not exist (fixture
+packages without a tune tier).
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding
+
+
+def _literal(node):
+    return node.value if isinstance(node, ast.Constant) else None
+
+
+def _str_literal(node):
+    v = _literal(node)
+    return v if isinstance(v, str) else None
+
+
+def _seq_elts(node):
+    """Elements of a literal tuple/list expression, else None."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return node.elts
+    return None
+
+
+def run(index):
+    cfg = index.cfg
+    mod = index.modules.get(cfg.tune_knobs_module)
+    if mod is None:
+        return []
+    doc = index.doc_text(cfg.env_doc) or ""
+    documented = set(re.findall(r"[A-Z][A-Z0-9_]{2,}", doc))
+
+    findings = []
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in cfg.knob_ctor_names):
+            continue
+        kname = (_str_literal(node.args[0]) if node.args else None)
+        if kname is None:
+            for kw in node.keywords:
+                if kw.arg == "name":
+                    kname = _str_literal(kw.value)
+        anchor = kname or "<dynamic>"
+        sym = f"{index.enclosing(mod, node.lineno)}:{anchor}"
+        kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+
+        env = kwargs.get("env")
+        env_name = _str_literal(env) if env is not None else None
+        if env_name is None:
+            findings.append(Finding(
+                "MXA501", mod.relpath, node.lineno, sym,
+                f"knob {anchor} has no literal env= kwarg — every "
+                f"registry knob must name its backing MXTPU_ env var "
+                f"so an adopted recommendation is reproducible"))
+        elif "MXTPU_" + env_name not in documented:
+            findings.append(Finding(
+                "MXA501", mod.relpath, node.lineno, sym,
+                f"knob {anchor}: env var MXTPU_{env_name} is not "
+                f"documented in {cfg.env_doc} — registry and docs "
+                f"have drifted"))
+
+        kind = "int"
+        if "kind" in kwargs:
+            kind = _str_literal(kwargs["kind"]) or "<dynamic>"
+        dom = _seq_elts(kwargs["domain"]) if "domain" in kwargs \
+            else None
+        has_domain = bool(dom) and all(
+            _literal(e) is not None for e in dom)
+        has_bounds = False
+        bnd = _seq_elts(kwargs["bounds"]) if "bounds" in kwargs \
+            else None
+        if bnd is not None and len(bnd) == 2:
+            lo, hi = _literal(bnd[0]), _literal(bnd[1])
+            has_bounds = (isinstance(lo, (int, float))
+                          and isinstance(hi, (int, float))
+                          and lo < hi)
+        if kind != "bool" and not (has_domain or has_bounds):
+            findings.append(Finding(
+                "MXA502", mod.relpath, node.lineno, sym,
+                f"knob {anchor} declares neither a literal non-empty "
+                f"domain= nor literal bounds=(lo, hi) with lo < hi — "
+                f"an unbounded knob is untunable"))
+    return findings

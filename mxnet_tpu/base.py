@@ -41,6 +41,23 @@ def getenv(name: str, default=None, dtype=str):
     return default
 
 
+def setenv(name: str, value):
+    """Write a config knob under its canonical ``MXTPU_`` spelling (the
+    one :func:`getenv` reads first, so it wins over any legacy
+    ``MXNET_`` value already in the environment).  ``None`` clears both
+    spellings.  The write side of the config tier lives here for the
+    same reason the read side does: everything outside ``base.py``
+    stays free of raw ``os.environ`` access (the MXA401 invariant)."""
+    if value is None:
+        for prefix in ("MXTPU_", "MXNET_"):
+            os.environ.pop(prefix + name, None)
+        return None
+    if value is True or value is False:
+        value = int(value)
+    os.environ["MXTPU_" + name] = str(value)
+    return value
+
+
 # ---------------------------------------------------------------------------
 # Generic string-keyed registry (ref: dmlc Registry pattern used by ops,
 # iterators, optimizers, initializers, metrics).

@@ -328,6 +328,22 @@ def _health_counters(reset=False):
     return stats
 
 
+def _tune_counters(reset=False):
+    """Autotuner counters (trials run, recompiles spent, blocked
+    restart-class moves, best/baseline ratio) — window-scoped under
+    reset=True exactly like every other section; only present when the
+    tune subsystem is loaded."""
+    import sys
+
+    tune = sys.modules.get(__package__ + ".tune")
+    if tune is None:
+        return None
+    stats = tune.tune_stats()
+    if reset:
+        tune.reset_tune_stats()
+    return stats
+
+
 def _telemetry_counters(reset=False):
     """Telemetry-subsystem counters (spans/instants/requests recorded,
     drops, flight dumps, scrapes, aggregations) — window-scoped under
@@ -499,6 +515,17 @@ register_section("health", _health_counters, _rows_table(
      ("MFU (last window)", "mfu"),
      ("FLOPs per step", "flops_per_step"),
      ("step p95 (ms)", "step_p95_ms"))))
+register_section("tune", _tune_counters, _rows_table(
+    "Autotuner",
+    (("trials run", "trials"),
+     ("measurement windows", "measurements"),
+     ("recompiles spent", "recompiles_spent"),
+     ("candidates cost-model ranked", "candidates_ranked"),
+     ("restart-class moves blocked", "blocked_moves"),
+     ("knobs moved", "knobs_moved"),
+     ("baseline score", "baseline_score"),
+     ("best score", "best_score"),
+     ("best/baseline ratio", "best_over_baseline"))))
 register_section("telemetry", _telemetry_counters, _rows_table(
     "Telemetry (tracer / flight recorder / metrics)",
     (("spans recorded", "spans"),

@@ -37,7 +37,7 @@ from concurrent.futures import TimeoutError as _FutureTimeout
 import numpy as np
 
 from .. import profiler
-from ..base import MXNetError
+from ..base import MXNetError, getenv
 from ..ndarray.ndarray import NDArray, array as _nd_array
 from ..telemetry import tracer as _tracer
 from .batcher import (Batcher, DeadlineExceededError, _Request,
@@ -78,9 +78,12 @@ class ModelServer:
         The closed set of padded shapes to compile and serve.
     max_queue : int
         Bound on queued requests before submit() fails fast.
-    linger_ms : float
+    linger_ms : float, optional
         How long the batcher waits for concurrent submitters to
-        coalesce once the first request of a batch arrives.
+        coalesce once the first request of a batch arrives.  Defaults
+        to ``MXTPU_SERVE_LINGER_MS`` (2.0) — env-backed so the
+        autotuner's ``serve_linger_ms`` knob reaches servers built
+        after a recommendation is applied.
     ctx : Context, optional
         Device for the padded input batches.
     checkpoint : CheckpointManager or str, optional
@@ -88,10 +91,12 @@ class ModelServer:
         directory wrapped in a manager.
     """
 
-    def __init__(self, block, spec, max_queue=256, linger_ms=2.0,
+    def __init__(self, block, spec, max_queue=256, linger_ms=None,
                  ctx=None, checkpoint=None):
         if not isinstance(spec, BucketSpec):
             raise MXNetError("spec must be a serve.BucketSpec")
+        if linger_ms is None:
+            linger_ms = getenv("SERVE_LINGER_MS", 2.0, float)
         self._net = block
         self._spec = spec
         self._ctx = ctx
@@ -226,6 +231,7 @@ class ModelServer:
             example = example.asnumpy()
         example = np.asarray(example, dtype=self._spec.dtype)
         length = self._spec.validate(example)
+        self._stats.record_request_shape(length)
         req = _Request(example, length, Future(), deadline_ms=deadline_ms)
         # request-shape attrs ride on the span: the autotuner's
         # observed-traffic histogram (ROADMAP item 5) reads them back

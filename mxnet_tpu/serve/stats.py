@@ -113,12 +113,27 @@ class ServerStats:
         # decode-vs-whole-batch comparison read off /metrics
         self._bucket_fill = {}   # key -> [real requests, padded rows]
         self._bucket_pad = {}    # key -> [real elems, padded elems]
+        # raw traffic shape: variable-axis length of every submitted
+        # request and real size of every executed group — the measured
+        # distributions tune.geometry derives BucketSpec grids and
+        # decode arena geometry from (instead of a human guessing)
+        self._len_hist = {}      # length -> submissions
+        self._group_hist = {}    # group size -> batches
 
     # -- mutation -----------------------------------------------------------
 
     def incr(self, name, n=1):
         with self._lock:
             self._c[name] += n
+
+    def record_request_shape(self, length):
+        """Tally one submitted request's variable-axis length (no-op
+        for fixed-shape specs, where length is None)."""
+        if length is None:
+            return
+        with self._lock:
+            self._len_hist[int(length)] = \
+                self._len_hist.get(int(length), 0) + 1
 
     def record_batch(self, bucket_key, n_real, n_rows, real_elems,
                      padded_elems):
@@ -136,6 +151,8 @@ class ServerStats:
             pad = self._bucket_pad.setdefault(bucket_key, [0, 0])
             pad[0] += real_elems
             pad[1] += padded_elems
+            self._group_hist[n_real] = \
+                self._group_hist.get(n_real, 0) + 1
 
     def record_latency(self, ms):
         with self._lock:
@@ -149,6 +166,8 @@ class ServerStats:
         self._bucket_hits = {}
         self._bucket_fill = {}
         self._bucket_pad = {}
+        self._len_hist = {}
+        self._group_hist = {}
         self.latency.reset()
 
     def reset(self):
@@ -180,6 +199,8 @@ class ServerStats:
             snap["bucket_padding_overhead"] = {
                 k: round(padded / real - 1.0, 4)
                 for k, (real, padded) in self._bucket_pad.items() if real}
+            snap["request_lengths"] = dict(self._len_hist)
+            snap["group_sizes"] = dict(self._group_hist)
             snap["latency"] = self.latency.snapshot()
             if reset:
                 # read-and-rewind is atomic: a sample landing between

@@ -432,6 +432,69 @@ def test_section_registration_catalog_lint(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# MXA5xx: knob-registry invariants
+
+
+_KNOB_FIXTURE = (
+    "class Knob:\n"
+    "    def __init__(self, name, **kw):\n"
+    "        pass\n"
+    "def build():\n"
+    "    Knob('good', env='GOOD_KNOB', domain=(1, 2, 4))\n"
+    "    Knob('undocumented', env='NOT_IN_DOCS', bounds=(1, 8))\n"
+    "    Knob('no_env', domain=(1, 2))\n"
+    "    Knob('unbounded', env='OTHER_KNOB')\n"
+    "    Knob('flag', env='FLAG_KNOB', kind='bool')\n"
+    "    Knob('bad_bounds', env='RANGE_KNOB', bounds=(8, 1))\n")
+
+_KNOB_DOCS = ("| `MXTPU_GOOD_KNOB` | 1 | a knob |\n"
+              "| `MXTPU_OTHER_KNOB` | 2 | another |\n"
+              "| `MXTPU_FLAG_KNOB` | 0 | a flag |\n"
+              "| `MXTPU_RANGE_KNOB` | 4 | ranged |\n")
+
+
+def test_tune_registry_lints(tmp_path):
+    """MXA501: missing/undocumented env=; MXA502: no literal
+    domain=/bounds= (bool exempt, lo >= hi rejected)."""
+    findings = _run(tmp_path,
+                    {"tune/__init__.py": "", "tune/knobs.py":
+                     _KNOB_FIXTURE},
+                    docs={"ENV_VARS.md": _KNOB_DOCS},
+                    passes=["tune"])
+    assert _codes(findings) == ["MXA501", "MXA501", "MXA502",
+                                "MXA502"]
+    syms = sorted(f.symbol for f in findings)
+    assert syms == ["build:bad_bounds", "build:no_env",
+                    "build:unbounded", "build:undocumented"]
+
+
+def test_tune_registry_docs_drift_is_a_finding(tmp_path):
+    """The same registry goes clean <-> dirty purely on the docs: drop
+    one documented var and exactly that knob fires."""
+    clean_src = ("class Knob:\n"
+                 "    def __init__(self, name, **kw):\n"
+                 "        pass\n"
+                 "Knob('a', env='A_KNOB', domain=(1, 2))\n"
+                 "Knob('b', env='B_KNOB', bounds=(0, 10))\n")
+    both = "`MXTPU_A_KNOB` and `MXTPU_B_KNOB`\n"
+    findings = _run(tmp_path, {"tune/knobs.py": clean_src},
+                    docs={"ENV_VARS.md": both}, passes=["tune"])
+    assert findings == []
+    findings = _run(tmp_path, {"tune/knobs.py": clean_src},
+                    docs={"ENV_VARS.md": "`MXTPU_A_KNOB` only\n"},
+                    passes=["tune"])
+    assert _codes(findings) == ["MXA501"]
+    assert findings[0].symbol == "<module>:b"
+
+
+def test_tune_pass_noop_without_knobs_module(tmp_path):
+    """Fixture packages with no tune tier stay clean (the pass must
+    not invent findings about a module that does not exist)."""
+    findings = _run(tmp_path, {"m.py": "x = 1\n"}, passes=["tune"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # baseline mechanics
 
 

@@ -20,6 +20,10 @@ Usage::
     python tools/bench_diff.py --strict        # exit 1 on any regression
     python tools/bench_diff.py --tolerance 0.2 # global tolerance 20%
     python tools/bench_diff.py --json          # machine-readable report
+    python tools/bench_diff.py --file TUNE_HISTORY.jsonl
+                                               # diff the two newest
+                                               # records of any jsonl
+                                               # (tuner trial records)
 """
 from __future__ import annotations
 
@@ -39,12 +43,12 @@ DEFAULT_HISTORY = os.path.join(REPO, "BENCH_HISTORY.jsonl")
 _LOWER_IS_BETTER = (
     "p50", "p95", "p99", "latency", "_ms", "ms_per", "us_per",
     "lost", "compiles", "dispatches", "steps_lost", "time_to_resume",
-    "overhead", "wait",
+    "overhead", "wait", "blocked_moves",
 )
 _HIGHER_IS_BETTER = (
     "throughput", "tokens_per", "images_per", "rps", "speedup",
     "value", "mfu", "goodput", "fill", "hit", "occupancy",
-    "vs_baseline",
+    "vs_baseline", "best_over_baseline", "score", "samples_per",
 )
 
 # per-leaf tolerance overrides (fraction of the previous value) for
@@ -125,12 +129,14 @@ def load_bench_r_files(directory):
     return out
 
 
-def load_last_two(history_path, fallback_dir=None):
+def load_last_two(history_path, fallback_dir=None, explicit=False):
     """(previous, latest) bench records — from the history file, padded
     from the archived BENCH_r*.json snapshots when the history is
-    short."""
+    short.  ``explicit=True`` (the ``--file`` path) never pads: an
+    arbitrary jsonl (tuner trial records) must stand on its own two
+    lines rather than be diffed against an unrelated bench snapshot."""
     records = load_history(history_path)
-    if len(records) < 2:
+    if len(records) < 2 and not explicit:
         records = load_bench_r_files(fallback_dir or REPO) + records
     if len(records) < 2:
         raise SystemExit(
@@ -207,6 +213,11 @@ def main(argv=None):
                     default=os.environ.get("MXTPU_BENCH_HISTORY",
                                            DEFAULT_HISTORY),
                     help="bench history jsonl (newest last)")
+    ap.add_argument("--file", dest="file", default=None,
+                    help="diff the two newest records of this jsonl "
+                         "instead of the bench history (tuner trial "
+                         "records, ad-hoc measurement logs); no "
+                         "BENCH_r*.json fallback padding")
     ap.add_argument("--tolerance", type=float, default=0.10,
                     help="default per-leaf tolerance fraction (0.10)")
     ap.add_argument("--all", action="store_true",
@@ -217,7 +228,10 @@ def main(argv=None):
                     help="exit 1 when any leaf REGRESSED")
     args = ap.parse_args(argv)
 
-    prev, new = load_last_two(args.history)
+    if args.file:
+        prev, new = load_last_two(args.file, explicit=True)
+    else:
+        prev, new = load_last_two(args.history)
     report = diff_records(prev, new, tolerance=args.tolerance)
     regressed = has_regression(report)
     if args.json:
