@@ -67,6 +67,16 @@ router-smoke:
 decode-smoke:
 	env PYTHONPATH=. python tools/decode_smoke.py
 
+# paged + speculative decoding gate: a heavy-tailed 50-request burst
+# through a paged KV arena sized to HALF the contiguous cache HBM,
+# with a draft model proposing speculative blocks — every request
+# resolves, zero post-warmup compiles, exact dispatch accounting
+# (verify + draft + admissions), acceptance rate > 0, and the page
+# allocator ledger balances — see tools/paged_decode_smoke.py /
+# docs/serving.md
+paged-smoke:
+	env PYTHONPATH=. python tools/paged_decode_smoke.py
+
 # compiled-INT8 serving gate: calibrate -> quantize -> serve a request
 # burst through ModelServer + a decode burst through DecodeServer —
 # zero post-warmup compiles, exact dispatch accounting (one executable
@@ -160,7 +170,7 @@ analyze:
 
 # the ROADMAP tier-1 gate, verbatim ($$ = make-escaped shell $)
 verify: SHELL := /bin/bash
-verify: analyze serve-smoke router-smoke decode-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke elastic-smoke trace-smoke health-smoke tune-smoke
+verify: analyze serve-smoke router-smoke decode-smoke paged-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke elastic-smoke trace-smoke health-smoke tune-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
-.PHONY: all clean test verify analyze serve-smoke router-smoke decode-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke elastic-smoke trace-smoke health-smoke tune-smoke
+.PHONY: all clean test verify analyze serve-smoke router-smoke decode-smoke paged-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke elastic-smoke trace-smoke health-smoke tune-smoke

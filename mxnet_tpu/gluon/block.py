@@ -306,12 +306,18 @@ def reset_cached_graph_stats():
         _graph_stats["reuses"] = 0
 
 
-def traced_apply(block, param_raws, input_raws, key, train=True):
+def traced_apply(block, param_raws, input_raws, key, train=True,
+                 static_kwargs=None):
     """Run ``block.forward`` under graph capture: every Parameter's
     traced stand-in is bound to the matching entry of ``param_raws``
     (ordered like ``block._ordered_params()``), the trace RNG key is
     pushed, and the eager op wrappers re-trace the forward into whatever
     jax transformation is active (jit, vjp, shard_map, eval_shape).
+
+    ``static_kwargs`` are compile-time keyword arguments forwarded
+    verbatim to ``block.forward`` — shape-determining config (the
+    speculative-verify unroll depth ``k``) that is part of the jit
+    cache key rather than a traced input.
 
     Returns ``(out, aux)`` where ``out`` is the forward's return tree
     (NDArray leaves wrapping tracer buffers) and ``aux`` is a list of
@@ -333,7 +339,7 @@ def traced_apply(block, param_raws, input_raws, key, train=True):
         for p, w in zip(params, wrappers):
             p._traced_value = w
         with autograd.pause(train_mode=train):
-            out = block.forward(*inputs)
+            out = block.forward(*inputs, **(static_kwargs or {}))
     finally:
         _random.pop_trace_key(tok)
         _tracing.active = prev_active
@@ -476,14 +482,22 @@ class CachedStepOp:
       knows the structure; there is no treedef round-trip);
     - every call books exactly one device dispatch on the honest
       ``_imperative`` counter, exactly like ``invoke()``.
+    - ``static_kwargs`` bakes compile-time keyword arguments into the
+      forward (and the jit cache key): the multi-token speculative
+      VERIFY step passes its unroll depth ``k`` this way, so one
+      executable verifies a whole k-token draft block per dispatch and
+      a different ``k`` is a new warmup compile, not a silent retrace.
 
     Compile/reuse accounting rides the same global ``cached_graph_stats``
     the serving tier's zero-post-warmup-compile gates read.
     """
 
-    def __init__(self, block, donate_inputs=()):
+    def __init__(self, block, donate_inputs=(), static_kwargs=None):
         self.block = block
         self._donate = tuple(sorted(int(i) for i in donate_inputs))
+        self._static = dict(static_kwargs or {})
+        for k, v in self._static.items():
+            hash(v)   # jit-cache key material; fail at construction
         self._fn = None
         self._params = None      # ordered Parameter list, cached: the
         # per-token path must not re-walk the block tree every call
@@ -508,9 +522,10 @@ class CachedStepOp:
     def _build_fn(self):
         block = self.block
 
-        def _step_graph_fn(key, *arrays, _n_params):
+        def _step_graph_fn(key, *arrays, _n_params, **static):
             out, _aux = traced_apply(block, arrays[:_n_params],
-                                     arrays[_n_params:], key, train=False)
+                                     arrays[_n_params:], key, train=False,
+                                     static_kwargs=static)
             outs = list(out) if isinstance(out, (list, tuple)) else [out]
             if not all(isinstance(o, NDArray) for o in outs):
                 raise MXNetError(
@@ -546,8 +561,9 @@ class CachedStepOp:
                 _graph_stats["reuses"] += 1
         # +1 for the leading rng key arg of the graph fn
         donate = tuple(1 + n + i for i in self._donate) or None
-        jitted = _imperative.get_jitted(self._fn, {"_n_params": n},
-                                        donate_argnums=donate)
+        jitted = _imperative.get_jitted(
+            self._fn, dict(self._static, _n_params=n),
+            donate_argnums=donate)
         _imperative.count_dispatch()
         if fresh:
             from .. import profiler

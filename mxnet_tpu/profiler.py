@@ -476,7 +476,13 @@ register_section("decodeServe", _decode_serve_counters, _rows_table(
      ("requests admitted", "admitted"),
      ("requests finished", "finished"),
      ("deadline expiries", "expired_deadlines"),
-     ("slot occupancy (mean live/max)", "slot_occupancy"))))
+     ("slot occupancy (mean live/max)", "slot_occupancy"),
+     ("pages in flight", "pages_in_flight"),
+     ("copy-on-write page copies", "cow_copies"),
+     ("prefix pages shared (hits)", "prefix_hit_pages"),
+     ("draft proposal steps", "draft_steps"),
+     ("draft tokens proposed", "spec_proposed"),
+     ("draft tokens accepted", "spec_accepted"))))
 register_section("router", _router_counters, _rows_table(
     "Serve Router (replica pool)",
     (("requests dispatched", "dispatched"),

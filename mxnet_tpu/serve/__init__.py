@@ -16,7 +16,10 @@ backpressure semantics, and the hot-reload workflow::
 from .batcher import (Batcher, DeadlineExceededError,  # noqa: F401
                       ServerClosedError, ServerOverloadedError)
 from .buckets import BucketOverflowError, BucketSpec  # noqa: F401
-from .decode import DecodeHandle, DecodeServer, TinyDecoder  # noqa: F401
+from .decode import (DecodeHandle, DecodeServer,  # noqa: F401
+                     TinyDecoder, TinyDraft)
+from .paging import (PageAllocator, PrefixIndex,  # noqa: F401
+                     chunk_keys, pages_spanned)
 from .router import (NoHealthyReplicaError, Replica,  # noqa: F401
                      ReplicaPool, Router, TenantQuotaExceededError)
 from .server import ModelServer  # noqa: F401

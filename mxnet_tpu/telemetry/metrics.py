@@ -511,6 +511,33 @@ def register_decode_server(server, registry=None):
             fams.append((f"mxtpu_decode_graph_{k}", "gauge",
                          f"decode serve compiled-graph {k}",
                          [(lab, float(v))]))
+        pages = snap.get("pages")
+        if pages:
+            for name, key, help_ in (
+                    ("mxtpu_decode_page_in_flight", "in_flight",
+                     "physical cache pages currently referenced"),
+                    ("mxtpu_decode_page_free", "free",
+                     "physical cache pages on the free list"),
+                    ("mxtpu_decode_page_committed", "committed",
+                     "worst-case pages committed to admitted requests"),
+                    ("mxtpu_decode_page_deferred", "deferred",
+                     "admissions deferred on the page budget"),
+                    ("mxtpu_decode_page_hbm_bytes", "hbm_bytes",
+                     "paged KV-cache pool bytes (incl. trash page)")):
+                fams.append((name, "gauge", help_,
+                             [(lab, float(pages.get(key, 0)))]))
+        spec = snap.get("spec")
+        if spec:
+            fams.append(("mxtpu_decode_spec_proposed", "gauge",
+                         "draft tokens proposed (window)",
+                         [(lab, float(spec.get("proposed", 0)))]))
+            fams.append(("mxtpu_decode_spec_accepted", "gauge",
+                         "draft tokens accepted (window)",
+                         [(lab, float(spec.get("accepted", 0)))]))
+            if spec.get("accept_rate") is not None:
+                fams.append(("mxtpu_decode_spec_accept_rate", "gauge",
+                             "accepted/proposed draft tokens (window)",
+                             [(lab, float(spec["accept_rate"]))]))
         return fams
 
     reg.register_collector(_collect)

@@ -18,7 +18,9 @@ module turns those histograms into geometry:
   ``BucketSpec``;
 * :func:`derive_decode_geometry` — decode arena ``max_len`` (covers
   p99 prompt + generation budget) and ``max_slots`` (sized to measured
-  slot occupancy);
+  slot occupancy); with ``paged=True`` also the page-pool geometry
+  (``page_tokens`` / ``num_pages`` sized to MEAN tokens in flight,
+  not the worst case);
 * :func:`parse_grid` / :func:`format_grid` — the
   ``"1,2,4,8x32,64,128"`` string form the ``serve_buckets`` env knob
   carries, so a derived grid can ride an env var into a fresh server.
@@ -166,7 +168,8 @@ def derive_bucket_spec(snapshot, example_shape, max_buckets=4,
 
 
 def derive_decode_geometry(request_lengths, max_new_tokens=32,
-                           slot_occupancy=None, max_slots=8, align=8):
+                           slot_occupancy=None, max_slots=8, align=8,
+                           paged=False, page_tokens=16):
     """Decode arena geometry from measured traffic.
 
     ``max_len`` covers the p99 observed prompt length plus the
@@ -177,6 +180,17 @@ def derive_decode_geometry(request_lengths, max_new_tokens=32,
     ``decodeServe`` section): sustained >75% occupancy doubles the
     arena (admission is queuing), <25% halves it (cache memory idles).
     Returns ``{"max_len": ..., "max_slots": ...}``.
+
+    With ``paged=True`` the dict also carries page-pool geometry for
+    the paged arena (``DecodeServer(page_tokens=...)``): the per-slot
+    worst case stays ``max_len`` (the logical range still has to cover
+    the p99 request), but the PHYSICAL pool is sized to the MEAN
+    length plus budget — tokens actually in flight — instead of
+    ``max_slots x max_len``: ``num_pages = max_slots *
+    ceil((mean_len + max_new_tokens) / page_tokens)`` (floored at one
+    slot's worst case so a lone p99 request still fits).  That is the
+    whole point of paging: heavy-tailed traffic pays HBM for its mean,
+    not its tail.
     """
     if not request_lengths:
         raise MXNetError("derive_decode_geometry: empty length "
@@ -192,4 +206,16 @@ def derive_decode_geometry(request_lengths, max_new_tokens=32,
             slots = max_slots * 2
         elif slot_occupancy < 0.25:
             slots = max(1, max_slots // 2)
-    return {"max_len": max_len, "max_slots": slots}
+    out = {"max_len": max_len, "max_slots": slots}
+    if paged:
+        t = int(page_tokens)
+        if t < 1:
+            raise MXNetError("derive_decode_geometry: page_tokens "
+                             "must be >= 1 when paged=True")
+        pages_per_slot = -(-max_len // t)
+        mean_span = -(-int(np.ceil(float(np.mean(lens)))
+                           + int(max_new_tokens)) // t)
+        out["page_tokens"] = t
+        out["num_pages"] = max(slots * mean_span, pages_per_slot)
+        out["pages_per_slot"] = pages_per_slot
+    return out

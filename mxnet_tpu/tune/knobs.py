@@ -395,6 +395,28 @@ def default_registry():
         domain=(32, 64, 128, 256, 512), default=128, restart="restart",
         doc="per-slot decode cache length (prompt + generated)"))
     reg.register(Knob(
+        "decode_page_tokens", env="DECODE_PAGE_TOKENS", kind="int",
+        domain=(0, 8, 16, 32, 64), default=0, restart="recompile",
+        doc="tokens per paged-KV cache page (0 = contiguous slot "
+            "arena); > 0 switches DecodeServer to the paged arena with "
+            "token-budget admission and prefix sharing — changes the "
+            "pool shapes, so the decode executables re-warm"))
+    reg.register(Knob(
+        "decode_spec_k", env="DECODE_SPEC_K", kind="int",
+        domain=(1, 2, 4, 8), default=1, restart="recompile",
+        doc="speculative decoding block size: draft proposes k-1 "
+            "tokens per round, target verifies the block in one step "
+            "(1 = off; needs the paged arena and a draft model); k is "
+            "a static arg of the verify executable, so changing it "
+            "recompiles"))
+    reg.register(Knob(
+        "decode_draft", env="DECODE_DRAFT", kind="bool", default=False,
+        restart="recompile",
+        doc="attach the serving stack's draft model for speculative "
+            "decoding (serve.decode.TinyDraft for the reference "
+            "decoder); adds the proposal executable to the warmup "
+            "surface"))
+    reg.register(Knob(
         "zero_shard", env="ZERO_SHARD", kind="bool", default=False,
         restart="recompile",
         doc="ZeRO-1 optimizer-state sharding on/off (recompiles the "

@@ -1,0 +1,470 @@
+"""mxnet_tpu.serve paged KV cache + speculative decoding.
+
+Covers the paged arena's contract: paged continuous decode is
+bit-identical to paged whole-batch decode (and to the contiguous arena
+when the logical ranges match); prefix sharing stores shared pages
+ONCE (refcounts asserted) with copy-on-write on first divergence and
+eviction only at refcount zero; interleaved admit/finish churn never
+leaks pages (allocator ledger invariant); token-budget admission
+defers — never drops — requests the pool can't cover and rejects
+loudly what can NEVER fit; greedy speculative decoding emits
+bit-identical output to non-speculative greedy with exact dispatch
+accounting (verify + draft + admission dispatches); and the whole
+surface runs with ZERO post-warmup compiles.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import _imperative, serve
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serve.paging import (PageAllocator, PrefixIndex,
+                                    chunk_keys, pages_spanned)
+
+VOCAB = 64
+
+
+def _make_model(seed=3, vocab=VOCAB, embed=16):
+    mx.random.seed(seed)
+    model = serve.TinyDecoder(vocab=vocab, embed=embed)
+    model.initialize(mx.init.Xavier())
+    return model
+
+
+def _spec(batches=(1, 2, 4), lengths=(4, 8)):
+    return serve.BucketSpec(batch_sizes=batches, example_shape=(None,),
+                            lengths=lengths, dtype="int32")
+
+
+def _prompts(n, rng, max_len=8):
+    return [rng.randint(0, VOCAB, size=int(rng.randint(2, max_len + 1)))
+            .astype(np.int32) for _ in range(n)]
+
+
+def _server(model, **kwargs):
+    kwargs.setdefault("max_slots", 4)
+    kwargs.setdefault("max_len", 32)
+    kwargs.setdefault("page_tokens", 4)
+    return serve.DecodeServer(model, kwargs.pop("spec", _spec()),
+                              **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# paging primitives
+
+
+def test_allocator_refcount_lifecycle_and_ledger():
+    a = PageAllocator(4, 8)
+    assert a.trash == 4
+    p = a.alloc()
+    assert a.ref(p) == 1 and a.live_count() == 1
+    a.retain(p)
+    assert a.ref(p) == 2
+    assert a.release(p) is False          # still referenced: no evict
+    assert a.live_count() == 1
+    assert a.release(p) is True           # refcount zero: evicted
+    assert a.free_count() == 4
+    a.check()
+    with pytest.raises(MXNetError):
+        a.release(p)                      # double free is a loud bug
+    with pytest.raises(MXNetError):
+        a.retain(p)                       # retain of a free page too
+    for _ in range(4):
+        a.alloc()
+    with pytest.raises(MXNetError, match="exhausted"):
+        a.alloc()
+
+
+def test_chunk_keys_are_chained_prefix_hashes():
+    t = 4
+    a = np.arange(10, dtype=np.int32)
+    b = np.arange(10, dtype=np.int32)
+    b[9] = 63                             # diverge INSIDE the tail
+    ka, kb = chunk_keys(a, 10, t), chunk_keys(b, 10, t)
+    assert len(ka) == pages_spanned(10, t) == 3
+    assert ka[0] == kb[0] and ka[1] == kb[1]   # shared full pages
+    assert ka[2] != kb[2]                      # divergent partial tail
+    # chained: the SAME chunk after a different history never collides
+    c = np.arange(10, dtype=np.int32)
+    c[0] = 63
+    kc = chunk_keys(c, 10, t)
+    assert kc[1] != ka[1] and kc[2] != ka[2]
+    # a partial tail never collides with a full page of a longer prompt
+    k8 = chunk_keys(a, 8, t)
+    k7 = chunk_keys(a, 7, t)
+    assert k8[1][0] == "F" and k7[1][0] == "P"
+    assert k8[1] != k7[1]
+
+
+def test_prefix_index_drop_page_invalidates_all_keys():
+    idx = PrefixIndex()
+    idx.register(("F", 0, "aa"), 3)
+    idx.register(("F", 1, "bb"), 3)
+    idx.register(("F", 0, "cc"), 5)
+    assert idx.lookup(("F", 1, "bb")) == 3 and len(idx) == 3
+    idx.drop_page(3)
+    assert idx.lookup(("F", 0, "aa")) is None
+    assert idx.lookup(("F", 1, "bb")) is None
+    assert idx.lookup(("F", 0, "cc")) == 5 and len(idx) == 1
+
+
+# ---------------------------------------------------------------------------
+# parity: the acceptance gates
+
+
+def test_parity_paged_continuous_vs_whole_batch():
+    """Paged continuous decode is bit-identical to paged whole-batch
+    decode: page churn, prefix sharing, and COW never change any
+    sequence."""
+    model = _make_model()
+    rng = np.random.RandomState(1)
+    prompts = _prompts(14, rng)
+    budgets = [int(rng.randint(2, 12)) for _ in prompts]
+
+    def run(admission, stagger=0.0):
+        srv = _server(model, admission=admission)
+        srv.start()
+        handles = []
+        for p, m in zip(prompts, budgets):
+            handles.append(srv.submit(p, max_new_tokens=m))
+            if stagger:
+                time.sleep(stagger)
+        seqs = [h.result(timeout=120) for h in handles]
+        srv.drain()
+        return seqs, srv.stats()
+
+    cont, s_cont = run("continuous", stagger=0.002)
+    whole, s_whole = run("batch")
+    for a, b in zip(cont, whole):
+        np.testing.assert_array_equal(a, b)
+    assert all(len(seq) == m for seq, m in zip(cont, budgets))
+    assert s_cont["graph"]["post_warmup_compiles"] == 0
+    assert s_whole["graph"]["post_warmup_compiles"] == 0
+
+
+def test_parity_paged_vs_contiguous_arena():
+    """With the logical range matched (pages_per_slot * page_tokens ==
+    max_len), the paged arena emits bit-identical sequences to the
+    contiguous arena — paging is a memory-layout change, not a math
+    change."""
+    model = _make_model()
+    rng = np.random.RandomState(7)
+    prompts = _prompts(10, rng)
+    budgets = [int(rng.randint(2, 10)) for _ in prompts]
+
+    def run(**kw):
+        srv = serve.DecodeServer(model, _spec(), max_slots=4,
+                                 max_len=32, **kw)
+        srv.start()
+        hs = [srv.submit(p, max_new_tokens=m)
+              for p, m in zip(prompts, budgets)]
+        seqs = [h.result(timeout=120) for h in hs]
+        srv.drain()
+        return seqs
+
+    paged = run(page_tokens=4)            # 8 pages/slot * 4 == 32
+    contiguous = run()
+    for a, b in zip(paged, contiguous):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_parity_speculative_greedy_bit_identical_and_dispatches():
+    """Greedy speculative output is bit-identical to non-speculative
+    greedy (acceptance is a pure function of draft + target logits;
+    the target's argmax decides every emitted token), and dispatch
+    accounting is exact: delta == verify steps + draft proposal steps
+    + admission groups."""
+    model = _make_model()
+    draft = serve.TinyDraft(model)
+    rng = np.random.RandomState(5)
+    prompts = _prompts(12, rng)
+    budgets = [int(rng.randint(2, 12)) for _ in prompts]
+
+    def run(**kw):
+        srv = _server(model, **kw)
+        srv.start()
+        d0 = _imperative.device_dispatch_count()
+        hs = [srv.submit(p, max_new_tokens=m)
+              for p, m in zip(prompts, budgets)]
+        seqs = [h.result(timeout=120) for h in hs]
+        srv.drain()
+        d = _imperative.device_dispatch_count() - d0
+        return seqs, srv.stats(), d
+
+    spec, s_spec, d_spec = run(draft=draft, spec_k=4)
+    plain, s_plain, d_plain = run()
+    for a, b in zip(spec, plain):
+        np.testing.assert_array_equal(a, b)
+    assert s_spec["graph"]["post_warmup_compiles"] == 0
+    assert d_spec == (s_spec["decode_steps"] + s_spec["spec_draft_steps"]
+                      + s_spec["batches"])
+    assert d_plain == s_plain["decode_steps"] + s_plain["batches"]
+    # the point of speculation: fewer scheduling rounds than tokens,
+    # and (TinyDraft ~= the target) a positive acceptance rate
+    assert s_spec["decode_steps"] < s_plain["decode_steps"]
+    assert s_spec["spec"]["accept_rate"] > 0
+
+
+def test_paged_exact_dispatch_accounting():
+    """Non-speculative paged path: one dispatch per token step plus
+    one per fused admission group — COW copies and page-table updates
+    ride inside those executables, never as extra dispatches."""
+    model = _make_model()
+    srv = _server(model, max_queue=128)
+    srv.start()
+    execs_before = _imperative.compiled_executable_count()
+    d0 = _imperative.device_dispatch_count()
+    rng = np.random.RandomState(2)
+    handles = []
+    for i, p in enumerate(_prompts(24, rng)):
+        handles.append(srv.submit(p,
+                                  max_new_tokens=int(rng.randint(1, 9))))
+        if i % 5 == 0:
+            time.sleep(0.002)
+    for h in handles:
+        h.result(timeout=120)
+    srv.drain()
+    d1 = _imperative.device_dispatch_count()
+    s = srv.stats()
+    assert s["served"] == 24
+    assert s["graph"]["post_warmup_compiles"] == 0
+    assert _imperative.compiled_executable_count() == execs_before
+    assert d1 - d0 == s["decode_steps"] + s["batches"]
+
+
+# ---------------------------------------------------------------------------
+# prefix sharing: stored once, COW on divergence, evict at refcount 0
+
+
+def test_prefix_sharing_stores_shared_pages_once():
+    """Two overlapping requests with the same prompt: every prompt
+    page (two full + the partial tail) is physically stored once
+    (refcount 2 asserted on the live server), the first write into the
+    still-shared tail page goes copy-on-write, and outputs are
+    bit-identical to an unshared run."""
+    model = _make_model()
+    shared = np.arange(1, 9, dtype=np.int32)      # 2 full pages of 4
+    p1 = np.concatenate([shared, [9]]).astype(np.int32)
+    p2 = p1.copy()                        # identical: tail shared too
+
+    srv = _server(model, spec=_spec(lengths=(4, 8, 16)),
+                  max_new_tokens=64)
+    srv.start()
+    h1 = srv.submit(p1, max_new_tokens=20)
+    # let request 1 admit so its prefix pages are resident
+    for _ in range(200):
+        if srv.live_slots():
+            break
+        time.sleep(0.005)
+    h2 = srv.submit(p2, max_new_tokens=20)
+    seen_shared = False
+    for _ in range(400):
+        if srv.live_slots() == 2:
+            tables = [srv._slot_pages[int(s)]
+                      for s in np.flatnonzero(srv._active)]
+            if len(tables) == 2:
+                common = set(tables[0][:2]) & set(tables[1][:2])
+                if common and all(srv._alloc.ref(pg) == 2
+                                  for pg in common):
+                    seen_shared = True
+                    break
+        time.sleep(0.002)
+    out = [h1.result(60), h2.result(60)]
+    srv.drain()
+    assert seen_shared, "prefix pages were never physically shared"
+    s = srv.stats()
+    assert s["page_prefix_hits"] >= 2     # both full pages hit
+    assert s["page_cow"] >= 1             # divergent tail wrote via COW
+    srv._alloc.check()
+
+    # bit-identity vs the unshared path: same requests, run apart so
+    # nothing overlaps and no page is ever shared
+    ref = _server(model, spec=_spec(lengths=(4, 8, 16)),
+                  max_new_tokens=64)
+    ref.start()
+    r1 = ref.submit(p1, max_new_tokens=20).result(60)
+    ref.drain()
+    ref2 = _server(model, spec=_spec(lengths=(4, 8, 16)),
+                   max_new_tokens=64)
+    ref2.start()
+    r2 = ref2.submit(p2, max_new_tokens=20).result(60)
+    ref2.drain()
+    np.testing.assert_array_equal(out[0], r1)
+    np.testing.assert_array_equal(out[1], r2)
+
+
+def test_prefix_eviction_only_at_refcount_zero():
+    """A shared page survives its first sharer's finish (refcount
+    drops 2 -> 1, the prefix index still serves it) and is evicted
+    only when the LAST reference releases."""
+    model = _make_model()
+    shared = np.arange(1, 9, dtype=np.int32)
+    p_short = np.concatenate([shared, [9]]).astype(np.int32)
+    p_long = np.concatenate([shared, [11]]).astype(np.int32)
+    srv = _server(model, spec=_spec(lengths=(4, 8, 16)),
+                  max_new_tokens=64)
+    srv.start()
+    h_long = srv.submit(p_long, max_new_tokens=20)
+    for _ in range(200):
+        if srv.live_slots():
+            break
+        time.sleep(0.005)
+    keys = chunk_keys(p_long, len(p_long), 4)
+    page0 = srv._prefix.lookup(keys[0])
+    assert page0 is not None
+    h_short = srv.submit(p_short, max_new_tokens=2)
+    h_short.result(60)                    # short sharer finished
+    assert srv.live_slots() >= 1          # long one still decoding
+    assert srv._alloc.ref(page0) >= 1     # NOT evicted: still live
+    assert srv._prefix.lookup(keys[0]) == page0
+    h_long.result(60)
+    srv.drain()
+    assert srv._alloc.ref(page0) == 0     # last release evicted it
+    assert srv._prefix.lookup(keys[0]) is None
+    srv._alloc.check()
+
+
+def test_fragmentation_churn_never_leaks_pages():
+    """Interleaved admit/finish churn with mixed lengths and shared
+    prefixes: after the dust settles, the allocator ledger balances
+    and every page is back on the free list."""
+    model = _make_model()
+    rng = np.random.RandomState(9)
+    shared = np.arange(1, 5, dtype=np.int32)
+    srv = _server(model, max_queue=256, num_pages=20)
+    srv.start()
+    handles = []
+    for i in range(40):
+        if rng.rand() < 0.4:              # share a prefix page
+            p = np.concatenate(
+                [shared, rng.randint(0, VOCAB,
+                                     size=int(rng.randint(1, 4)))])
+        else:
+            p = rng.randint(0, VOCAB, size=int(rng.randint(2, 9)))
+        handles.append(srv.submit(p.astype(np.int32),
+                                  max_new_tokens=int(rng.randint(1, 8))))
+        if i % 3 == 0:
+            time.sleep(0.002)
+    for h in handles:
+        h.result(timeout=120)
+    srv.drain()
+    alloc = srv._alloc
+    alloc.check()                         # ledger invariant
+    assert alloc.free_count() == alloc.num_pages   # zero leaked pages
+    assert alloc.allocs == alloc.frees
+    assert len(srv._prefix) == 0          # index holds no dead keys
+    assert srv._committed == 0
+    s = srv.stats()
+    assert s["page_allocs"] == s["page_frees"]
+    assert s["graph"]["post_warmup_compiles"] == 0
+
+
+# ---------------------------------------------------------------------------
+# token-budget admission
+
+
+def test_submit_rejects_never_fitting_request_loudly():
+    model = _make_model()
+    srv = _server(model)                  # 32-token logical range
+    srv.start()
+    with pytest.raises(MXNetError) as e:
+        srv.submit(np.arange(8, dtype=np.int32), max_new_tokens=100)
+    msg = str(e.value)
+    assert "NEVER fit" in msg
+    assert "logical budget" in msg and "page pool" in msg
+    srv.drain()
+
+
+def test_small_pool_defers_admissions_instead_of_failing():
+    """A pool far below max_slots * pages_per_slot: admission defers
+    on the token budget and every request still resolves — capacity
+    scales with tokens in flight, not worst case."""
+    model = _make_model()
+    # 6 pages of 4 = 24 tokens of physical cache for 4 slots x 32
+    # logical — far below worst case
+    srv = _server(model, num_pages=6, max_queue=64)
+    srv.start()
+    rng = np.random.RandomState(3)
+    handles = [srv.submit(p, max_new_tokens=int(rng.randint(2, 6)))
+               for p in _prompts(12, rng, max_len=6)]
+    seqs = [h.result(timeout=120) for h in handles]
+    srv.drain()
+    assert len(seqs) == 12
+    s = srv.stats()
+    assert s["served"] == 12
+    assert s["graph"]["post_warmup_compiles"] == 0
+    srv._alloc.check()
+
+
+def test_speculation_requires_paged_arena_and_draft():
+    model = _make_model()
+    draft = serve.TinyDraft(model)
+    with pytest.raises(MXNetError, match="paged arena"):
+        serve.DecodeServer(model, _spec(), max_slots=4, max_len=32,
+                           draft=draft, spec_k=4)
+    with pytest.raises(MXNetError, match="draft"):
+        _server(model, spec_k=4)
+    with pytest.raises(MXNetError, match="spec_k"):
+        _server(model, draft=draft)
+    other = _make_model(seed=11, vocab=32)
+    with pytest.raises(MXNetError, match="vocab mismatch"):
+        _server(model, draft=serve.TinyDraft(other), spec_k=4)
+
+
+# ---------------------------------------------------------------------------
+# geometry + observability glue
+
+
+def test_derive_decode_geometry_paged_pool_sizing():
+    from mxnet_tpu.tune.geometry import derive_decode_geometry
+
+    hist = {8: 90, 64: 10}                # heavy-tailed lengths
+    g = derive_decode_geometry(hist, max_new_tokens=16, max_slots=8,
+                               paged=True, page_tokens=16)
+    assert g["page_tokens"] == 16
+    assert g["pages_per_slot"] == -(-g["max_len"] // 16)
+    # the pool is sized to the MEAN in-flight span, well under the
+    # worst case, but never below one slot's worst case
+    worst = 8 * g["pages_per_slot"]
+    assert g["pages_per_slot"] <= g["num_pages"] < worst
+    with pytest.raises(MXNetError):
+        derive_decode_geometry(hist, paged=True, page_tokens=0)
+
+
+def test_paged_knobs_registered():
+    from mxnet_tpu.tune.knobs import default_registry
+
+    reg = default_registry()
+    for name, env in (("decode_page_tokens", "DECODE_PAGE_TOKENS"),
+                      ("decode_spec_k", "DECODE_SPEC_K"),
+                      ("decode_draft", "DECODE_DRAFT")):
+        k = reg.get(name)
+        assert k.env == env
+        assert k.restart == "recompile"
+
+
+def test_stats_and_metrics_export_page_spec_families():
+    model = _make_model()
+    draft = serve.TinyDraft(model)
+    srv = _server(model, draft=draft, spec_k=2)
+    srv.start()
+    srv.submit(np.arange(1, 6, dtype=np.int32),
+               max_new_tokens=4).result(60)
+    s = srv.stats()
+    assert s["pages"]["page_tokens"] == 4
+    assert s["pages"]["hbm_bytes"] > 0
+    assert s["spec"]["k"] == 2 and s["spec"]["draft"] is True
+    from mxnet_tpu.telemetry import metrics as _metrics
+
+    reg = _metrics.Registry()
+    _metrics.register_decode_server(srv, registry=reg)
+    text = reg.render()
+    for name in ("mxtpu_decode_page_in_flight",
+                 "mxtpu_decode_page_hbm_bytes",
+                 "mxtpu_decode_page_prefix_hits",
+                 "mxtpu_decode_spec_rounds",
+                 "mxtpu_decode_spec_accepted"):
+        assert name in text, name
+    srv.drain()

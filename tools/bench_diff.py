@@ -43,12 +43,14 @@ DEFAULT_HISTORY = os.path.join(REPO, "BENCH_HISTORY.jsonl")
 _LOWER_IS_BETTER = (
     "p50", "p95", "p99", "latency", "_ms", "ms_per", "us_per",
     "lost", "compiles", "dispatches", "steps_lost", "time_to_resume",
-    "overhead", "wait", "blocked_moves",
+    "overhead", "wait", "blocked_moves", "pages_in_flight",
+    "hbm_bytes",
 )
 _HIGHER_IS_BETTER = (
     "throughput", "tokens_per", "images_per", "rps", "speedup",
     "value", "mfu", "goodput", "fill", "hit", "occupancy",
     "vs_baseline", "best_over_baseline", "score", "samples_per",
+    "accept_rate", "concurrent_sequences",
 )
 
 # per-leaf tolerance overrides (fraction of the previous value) for
@@ -56,7 +58,8 @@ _HIGHER_IS_BETTER = (
 # --tolerance (default 10%)
 PER_LEAF_TOLERANCE = {
     re.compile(r"records\.(serve|serve_decode|serve_int8|serve_router)"
-               r"\..*(value|rps|p99_ms|p50_ms)$"): 0.35,
+               r"\..*(value|rps|p99_ms|p50_ms|tokens_per_sec"
+               r"|_at_fixed_mem)$"): 0.35,
     re.compile(r"records\.(trainer_step|input_pipeline|recovery)\."): 0.35,
     re.compile(r"(^|\.)value$"): 0.25,
 }
