@@ -161,6 +161,15 @@ health-smoke:
 tune-smoke:
 	env PYTHONPATH=. python tools/tune_smoke.py
 
+# serving control-plane CI gate: three replica worker PROCESSES behind
+# the socket RPC router — load triples -> warm scale-up with zero
+# in-traffic compiles, idle drains back down, a SIGKILLed replica
+# process fails over mid-stream within the SLO with requests_lost==0,
+# and the episode shows in the mxtpu_ctrl_* gauges — see
+# tools/ctrl_smoke.py / docs/serving.md
+ctrl-smoke:
+	env PYTHONPATH=. python tools/ctrl_smoke.py
+
 # static-analysis gate: the mxtpu-analyze pass families (lock-order
 # races, trace-safety, determinism, repo invariants) must run clean
 # modulo the justified baseline, within the ~30s latency budget — see
@@ -170,7 +179,7 @@ analyze:
 
 # the ROADMAP tier-1 gate, verbatim ($$ = make-escaped shell $)
 verify: SHELL := /bin/bash
-verify: analyze serve-smoke router-smoke decode-smoke paged-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke elastic-smoke trace-smoke health-smoke tune-smoke
+verify: analyze serve-smoke router-smoke decode-smoke paged-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke elastic-smoke trace-smoke health-smoke tune-smoke ctrl-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
-.PHONY: all clean test verify analyze serve-smoke router-smoke decode-smoke paged-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke elastic-smoke trace-smoke health-smoke tune-smoke
+.PHONY: all clean test verify analyze serve-smoke router-smoke decode-smoke paged-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke elastic-smoke trace-smoke health-smoke tune-smoke ctrl-smoke

@@ -432,9 +432,119 @@ def shrink(dead_ranks=None, *, world=None, timeout=None,
                                 round_index, retry)
 
 
+class LeaseDir:
+    """Shared-storage lease directory — THE rendezvous freshness
+    primitive, factored out of the elastic shrink so the serving
+    control plane's replica registry reuses it instead of inventing a
+    second protocol.
+
+    Each participant repeatedly :meth:`publish`\\ es its own JSON
+    marker (``<prefix>-<key>.json``, committed via the checkpoint
+    tier's atomic temp-file + rename); a marker only counts in
+    :meth:`fresh` while younger than ``lease_sec``, measured against
+    the reader's OWN just-refreshed mtime — the shared storage stamps
+    both sides, so clock skew cancels and a dead participant's (or a
+    previous job incarnation's) markers age out instead of being
+    agreed in as phantoms."""
+
+    def __init__(self, root, prefix="rank", lease_sec=10.0):
+        self.root = os.fspath(root)
+        self.prefix = str(prefix)
+        self.lease_sec = float(lease_sec)
+        self._rx = re.compile(
+            rf"^{re.escape(self.prefix)}-(.+)\.json$")
+        self._own_path = None
+        os.makedirs(self.root, exist_ok=True)
+
+    def path_for(self, key):
+        return os.path.join(self.root, f"{self.prefix}-{key}.json")
+
+    def publish(self, key, payload):
+        """(Re)write this participant's marker; returns its mtime (the
+        freshness reference a same-poll :meth:`fresh` should use)."""
+        import time as _time
+
+        from ..checkpoint import atomic as _atomic
+
+        p = self.path_for(key)
+        _atomic.write_json(p, payload)
+        self._own_path = p
+        try:
+            return os.path.getmtime(p)
+        except OSError:   # lost a race with cleanup
+            return _time.time()
+
+    def ref_mtime(self):
+        """Freshness reference: the own marker's mtime when published;
+        a pure reader (control-plane discovery) touches a throwaway
+        probe file instead — it still needs the SHARED storage's
+        clock, not its local one."""
+        import time as _time
+
+        if self._own_path is not None:
+            try:
+                return os.path.getmtime(self._own_path)
+            except OSError:
+                pass
+        probe = os.path.join(self.root, f".lease-probe-{os.getpid()}")
+        try:
+            with open(probe, "w"):
+                pass
+            ref = os.path.getmtime(probe)
+            os.unlink(probe)
+            return ref
+        except OSError:
+            return _time.time()
+
+    def fresh(self, ref=None):
+        """``{key: payload}`` for every marker younger than the lease
+        window (stale and unparseable/mid-write markers are skipped,
+        not errors — the next poll sees them settled)."""
+        import json as _json
+
+        if ref is None:
+            ref = self.ref_mtime()
+        out = {}
+        try:
+            names = sorted(os.listdir(self.root))
+        except OSError:
+            return out
+        for name in names:
+            m = self._rx.match(name)
+            if not m:
+                continue
+            p = os.path.join(self.root, name)
+            try:
+                if ref - os.path.getmtime(p) > self.lease_sec:
+                    continue
+                with open(p) as f:
+                    out[m.group(1)] = _json.load(f)
+            except (OSError, ValueError):
+                continue
+        return out
+
+    def retire(self, key):
+        """Drop a marker (own graceful exit, or a confirmed-dead
+        peer's cleanup)."""
+        try:
+            os.unlink(self.path_for(key))
+        except OSError:
+            pass
+
+    def clear(self):
+        """Drop every marker and (best-effort) the directory itself —
+        the agreed-world cleanup so a relaunch starts empty."""
+        try:
+            for name in os.listdir(self.root):
+                if self._rx.match(name):
+                    os.unlink(os.path.join(self.root, name))
+            os.rmdir(self.root)
+        except OSError:
+            pass
+
+
 def _shrink_multiprocess(dead, timeout, rendezvous_dir, round_index,
                          retry):
-    import json as _json
     import time as _time
 
     if not rendezvous_dir:
@@ -452,45 +562,30 @@ def _shrink_multiprocess(dead, timeout, rendezvous_dir, round_index,
     old_world = num_workers()
     d = os.path.join(os.fspath(rendezvous_dir), "elastic-rendezvous",
                      f"round-{int(round_index):04d}")
-    os.makedirs(d, exist_ok=True)
-    from ..checkpoint import atomic as _atomic
-
-    own = os.path.join(d, f"rank-{my}.json")
     budget = _rendezvous_timeout(timeout)
     # the survivor set must hold still for a settle window (a quarter
     # of the budget, capped) so a straggler writing its marker late
     # does not split the agreed world
     settle = min(2.0, max(0.25, budget / 4))
-    # rank files are LEASES: each survivor rewrites its own file every
-    # poll, and only files fresher than the lease window count —
-    # measured against this rank's own just-refreshed mtime so the
-    # shared storage stamps both sides and clock skew cancels.  A
-    # previous job incarnation's round-<k> leftovers (the round index
-    # restarts at 0 after a relaunch) age out instead of being agreed
-    # into the new world as phantom survivors.
-    lease = max(10.0, 4 * settle)
+    # rank files are LEASES (see LeaseDir): each survivor rewrites its
+    # own file every poll, and only files fresher than the lease window
+    # count.  A previous job incarnation's round-<k> leftovers (the
+    # round index restarts at 0 after a relaunch) age out instead of
+    # being agreed into the new world as phantom survivors.
+    leases = LeaseDir(d, prefix="rank",
+                      lease_sec=max(10.0, 4 * settle))
     deadline = _time.monotonic() + budget
     seen, stable_since, attempt = set(), None, 0
-    rx = re.compile(r"^rank-(\d+)\.json$")
     while True:
-        _atomic.write_json(own, {"old_rank": my,
-                                 "old_world": old_world})
-        try:
-            ref = os.path.getmtime(own)
-        except OSError:
-            ref = _time.time()
+        ref = leases.publish(my, {"old_rank": my,
+                                  "old_world": old_world})
         now = _time.monotonic()
         present = set()
-        for name in os.listdir(d):
-            m = rx.match(name)
-            if not m:
-                continue
+        for key in leases.fresh(ref=ref):
             try:
-                mt = os.path.getmtime(os.path.join(d, name))
-            except OSError:  # lost a race with cleanup
+                present.add(int(key))
+            except ValueError:   # not a rank marker
                 continue
-            if ref - mt <= lease:
-                present.add(int(m.group(1)))
         present -= set(dead)
         if present != seen:
             seen, stable_since = present, now
@@ -517,13 +612,7 @@ def _shrink_multiprocess(dead, timeout, rendezvous_dir, round_index,
         # the agreed world has re-formed (reinit is collective) — drop
         # this round's rank files so a relaunched job reusing the
         # round index starts from an empty rendezvous
-        try:
-            for name in os.listdir(d):
-                if rx.match(name):
-                    os.unlink(os.path.join(d, name))
-            os.rmdir(d)
-        except OSError:
-            pass
+        leases.clear()
     return new_world, new_rank
 
 

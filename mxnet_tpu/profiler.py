@@ -299,6 +299,22 @@ def _router_counters(reset=False):
     return stats
 
 
+def _ctrl_counters(reset=False):
+    """Serving control-plane counters (RPC traffic, replica spawn and
+    retire churn, autoscaler decisions and the blocked-action tallies)
+    — window-scoped under reset=True like every other section; only
+    present when the control plane is loaded."""
+    import sys
+
+    cp = sys.modules.get(__package__ + ".serve.control_plane")
+    if cp is None:
+        return None
+    stats = cp.ctrl_stats()
+    if reset:
+        cp.reset_ctrl_stats()
+    return stats
+
+
 def _quantize_counters(reset=False):
     """INT8 quantization counters (layers quantized, calibration
     batches + wall time, requantize folds, compiled int8 serve
@@ -494,6 +510,22 @@ register_section("router", _router_counters, _rows_table(
      ("health probes", "probes"),
      ("health probe failures", "probe_failures"),
      ("rolling-reload legs", "reloads"))))
+register_section("ctrl", _ctrl_counters, _rows_table(
+    "Serving Control Plane",
+    (("autoscaler ticks", "ticks"),
+     ("scale-ups", "scale_ups"),
+     ("scale-downs", "scale_downs"),
+     ("actions blocked by cooldown", "blocked_cooldown"),
+     ("actions blocked by bounds", "blocked_bounds"),
+     ("replica processes spawned", "spawns"),
+     ("replica spawn failures", "spawn_failures"),
+     ("replicas drained and retired", "retired"),
+     ("rpc requests served", "rpc_requests"),
+     ("rpc streams opened", "rpc_streams"),
+     ("rpc errors", "rpc_errors"),
+     ("stale leases rejected", "stale_leases_rejected"),
+     ("pool size (last tick)", "replicas"),
+     ("mean occupancy (last tick)", "load"))))
 register_section("quantize", _quantize_counters, _rows_table(
     "INT8 Quantization",
     (("layers quantized", "layers_quantized"),

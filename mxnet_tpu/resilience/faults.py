@@ -32,6 +32,12 @@ Fault-point catalog (site -> where it fires -> ctx keys):
                           replica's ``submit()``                 attempt``
 ``serve.replica.health``  ``Router`` health prober, before the   ``replica``
                           probe request
+``serve.rpc.send``        ``RemoteReplica`` client, before each  ``replica,
+                          control-plane frame send (a raise      attempt``
+                          drops the WHOLE connection — every
+                          in-flight stream on it)
+``serve.replica.spawn``   ``ReplicaProcess.spawn``, before the   ``replica``
+                          worker process is forked
 ========================  =====================================  ==========
 
 Actions:

@@ -130,6 +130,22 @@ _UNRECOVERABLE_SIGNATURES = ("no retained checkpoint",
                              "every step failed",
                              "partially mutated")
 _CORRUPT_SIGNATURES = ("corrupt", "truncated")
+# RPC/transport blips between serving processes (the control plane's
+# socket wire): RETRYABLE — the router re-dispatches on another replica
+# instead of forwarding them as fatal.  Raw socket exceptions
+# (ConnectionResetError & friends) are OSErrors, not MXNetErrors, so
+# they get an isinstance check of their own; the text shapes cover
+# errors re-wrapped by the wire layer.  Checked after the peer-death
+# signature (a peer-death message may embed "connection reset") and
+# BEFORE the corrupt signatures: a "truncated frame" is a dropped
+# connection, not a corrupt checkpoint.
+_NETWORK_EXC_TYPES = (ConnectionResetError, ConnectionRefusedError,
+                      ConnectionAbortedError, BrokenPipeError)
+_NETWORK_SIGNATURES = ("connection reset", "connection refused",
+                       "connection aborted", "broken pipe",
+                       "econnreset", "econnrefused", "epipe",
+                       "truncated frame", "mid-frame",
+                       "rpc connection")
 # serving shed-don't-retry shapes, checked BEFORE the transient list:
 # both read "try again later", but retrying an overloaded pool is
 # exactly how a retry loop turns one slow replica into a meltdown, and
@@ -165,7 +181,8 @@ def _serve_request_class(exc):
 def classify(exc):
     """Map an exception to its fault class: ``'transient'``,
     ``'preemption'``, ``'peer_death'``, ``'corrupt_checkpoint'``,
-    ``'watchdog'``, ``'overloaded'``, ``'deadline'`` or ``'fatal'``.
+    ``'watchdog'``, ``'overloaded'``, ``'deadline'``, ``'network'``
+    or ``'fatal'``.
 
     ``overloaded`` (a full bounded queue / exhausted tenant quota) and
     ``deadline`` (an expired request budget) are NON-RETRYABLE: the
@@ -173,6 +190,12 @@ def classify(exc):
     replica) and failing the request, respectively — a naive retry
     loop treating their "try again"-shaped messages as ``transient``
     burns its whole budget hammering a pool that needs the opposite.
+
+    ``network`` (a dropped/refused connection, a truncated RPC frame)
+    IS retryable — on a DIFFERENT path: the serve router re-dispatches
+    the request to another replica, and the supervisor paces it like a
+    transient.  It is distinct from ``peer_death``, whose collective
+    cannot proceed without a world resize.
     """
     if isinstance(exc, TransientFault):
         return "transient"
@@ -183,12 +206,16 @@ def classify(exc):
     kind = _serve_request_class(exc)
     if kind is not None:
         return kind
+    if isinstance(exc, _NETWORK_EXC_TYPES):
+        return "network"
     if isinstance(exc, MXNetError):
         text = str(exc).lower()
         if any(s in text for s in _PEER_SIGNATURES):
             return "peer_death"
         if any(s in text for s in _UNRECOVERABLE_SIGNATURES):
             return "fatal"
+        if any(s in text for s in _NETWORK_SIGNATURES):
+            return "network"
         if any(s in text for s in _CORRUPT_SIGNATURES):
             return "corrupt_checkpoint"
         if any(s in text for s in _OVERLOAD_SIGNATURES):
@@ -427,7 +454,7 @@ class Supervisor:
                 restarts = 0
             last_fail_step = self._last_step
 
-            if kind == "transient":
+            if kind in ("transient", "network"):
                 transient_failures += 1
                 if not self.retry.should_retry(transient_failures):
                     raise MXNetError(

@@ -20,7 +20,12 @@ from .decode import (DecodeHandle, DecodeServer,  # noqa: F401
                      TinyDecoder, TinyDraft)
 from .paging import (PageAllocator, PrefixIndex,  # noqa: F401
                      chunk_keys, pages_spanned)
-from .router import (NoHealthyReplicaError, Replica,  # noqa: F401
-                     ReplicaPool, Router, TenantQuotaExceededError)
+from .router import (NoHealthyReplicaError, PooledStreamHandle,  # noqa: F401
+                     Replica, ReplicaPool, Router,
+                     TenantQuotaExceededError)
 from .server import ModelServer  # noqa: F401
 from .stats import LatencyWindow, ServerStats  # noqa: F401
+from .control_plane import (Autoscaler, ControlPlane,  # noqa: F401,E402
+                            RPCConnectionError, RemoteReplica,
+                            ReplicaEndpoint, ReplicaProcess,
+                            ReplicaSpawnError, serve_replica)

@@ -125,6 +125,9 @@ DECODE_COUNTERS = ("submitted", "served", "rejected_overload",
                    "page_prefix_hits", "spec_rounds", "spec_draft_steps")
 
 _DONE = object()          # stream sentinel: generation finished cleanly
+#: public alias — sink callbacks (the control plane's stream
+#: multiplexer) compare their terminal item against this
+STREAM_DONE = _DONE
 
 
 # ---------------------------------------------------------------------------
@@ -197,7 +200,8 @@ def _decode_donate_ok():
 
 class _DecodeRequest(_Request):
     __slots__ = ("max_new_tokens", "generated", "slot", "stream",
-                 "cancelled", "admitted_at")
+                 "cancelled", "admitted_at", "sinks", "sink_lock",
+                 "terminal")
 
     def __init__(self, prompt, length, future, max_new_tokens,
                  deadline_ms=None):
@@ -208,6 +212,31 @@ class _DecodeRequest(_Request):
         self.stream = _queue_mod.Queue()
         self.cancelled = False
         self.admitted_at = None
+        self.sinks = []               # multiplexing taps (add_sink)
+        self.sink_lock = threading.Lock()
+        self.terminal = None          # STREAM_DONE or the terminal exc
+
+    def fanout(self, item):
+        """Deliver one stream item (token / STREAM_DONE / exception) to
+        every registered sink.  Only the decode loop thread emits, so
+        per-request ordering holds; the lock serializes against a
+        concurrent ``add_sink`` replay (snapshotting the sink list in
+        the same critical section as the ``generated`` append keeps
+        replay + live delivery exactly-once)."""
+        with self.sink_lock:
+            if item is not _DONE and not isinstance(item, BaseException):
+                self.generated.append(item)
+            else:
+                self.terminal = item
+            sinks = list(self.sinks)
+        for s in sinks:
+            try:
+                s(item)
+            except Exception:  # noqa: BLE001 — a broken tap (dead
+                # connection) must never kill the decode loop
+                with self.sink_lock:
+                    if s in self.sinks:
+                        self.sinks.remove(s)
 
 
 class DecodeHandle:
@@ -248,6 +277,25 @@ class DecodeHandle:
         freed at the next token boundary if mid-decode."""
         self._req.cancelled = True
         self._req.future.cancel()
+
+    def add_sink(self, sink):
+        """Register a callable receiving every stream item of THIS
+        request — each token id as it is emitted, then exactly one
+        terminal: :data:`STREAM_DONE` (clean finish, after the future
+        resolved) or the terminal exception.
+
+        Already-emitted history is replayed first, inside the emission
+        lock, so a sink attached mid-generation still sees the full
+        item sequence exactly once — the hook the control plane's RPC
+        endpoint multiplexes per-request token streams with.  A
+        raising sink is dropped, never fatal to the decode loop."""
+        req = self._req
+        with req.sink_lock:
+            for t in req.generated:
+                sink(t)
+            if req.terminal is not None:
+                sink(req.terminal)
+            req.sinks.append(sink)
 
 
 # ---------------------------------------------------------------------------
@@ -1295,7 +1343,7 @@ class DecodeServer:
             _tracer.request_instant("serve.decode.first_token",
                                     req.trace_id, cat="serve",
                                     ttft_ms=round(ttft_ms, 3))
-        req.generated.append(token)
+        req.fanout(token)       # appends to req.generated + taps
         req.stream.put(token)
         self._stats.incr("tokens")
         _sec_bump(tokens=1)
@@ -1541,6 +1589,9 @@ class DecodeServer:
             req.stream.put(error)
             if req.future.set_running_or_notify_cancel():
                 req.future.set_exception(error)
+        # sinks see the terminal AFTER the future resolves, so a tap
+        # (the RPC endpoint) can read future.result() without blocking
+        req.fanout(_DONE if error is None else error)
 
     def _resolve_error(self, req, outcome, error):
         """Terminal path for requests that never reached a slot."""

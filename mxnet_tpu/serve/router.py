@@ -54,6 +54,7 @@ Knobs (docs/ENV_VARS.md): ``MXTPU_ROUTER_HEALTH_SEC``,
 from __future__ import annotations
 
 import itertools
+import queue as _queue_mod
 import threading
 import time
 from concurrent.futures import Future
@@ -167,7 +168,7 @@ class _PoolRequest:
 
     __slots__ = ("example", "kwargs", "tenant", "future", "deadline",
                  "deadline_ms", "submit_t", "attempts", "retries",
-                 "lock", "resolved", "inners", "trace_id")
+                 "lock", "resolved", "inners", "trace_id", "sink")
 
     def __init__(self, example, tenant, deadline_ms, kwargs):
         self.example = example
@@ -184,6 +185,7 @@ class _PoolRequest:
         self.resolved = False
         self.inners = []
         self.trace_id = None
+        self.sink = None    # PooledStreamHandle for submit_stream()
 
     def remaining_ms(self, now=None):
         """The budget a dispatch RIGHT NOW would propagate (None when
@@ -191,6 +193,101 @@ class _PoolRequest:
         if self.deadline is None:
             return None
         return (self.deadline - (now or time.monotonic())) * 1e3
+
+
+_POOL_STREAM_DONE = object()   # attach-queue sentinel: outer resolved
+
+
+class PooledStreamHandle:
+    """The :meth:`Router.submit_stream` handle: a decode token iterator
+    that fans through the pool.
+
+    Iteration yields token ids the moment they land on whichever
+    replica CURRENTLY owns the request.  When a replica dies mid-stream
+    the router's classified-retry path re-dispatches the request and
+    the next attach resumes the walk, skipping the prefix already
+    yielded — greedy decode is deterministic across same-weight
+    replicas, so the re-generated prefix is identical and the caller
+    sees one gapless, duplicate-free token sequence.  :attr:`future`
+    resolves with the full sequence exactly like ``DecodeHandle``'s.
+
+    Each pooled stream reads only its OWN per-request queue (in-process
+    handles) or demux lane (remote replicas), so a slow consumer never
+    head-of-line-blocks other requests' tokens.
+    """
+
+    def __init__(self, future):
+        self.future = future
+        self._attached = _queue_mod.Queue()   # inner handles, in
+        # dispatch order; _POOL_STREAM_DONE once the outer resolved
+        self._inner = None
+        self._skip = 0
+        self._yielded = 0
+        self._tail = None   # leftovers recovered from future.result()
+
+    # router-internal -------------------------------------------------------
+
+    def _attach(self, inner, replica_id):
+        self._attached.put(inner)
+
+    def _finalize(self, fut):
+        self._attached.put(_POOL_STREAM_DONE)
+
+    # iterator --------------------------------------------------------------
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while True:
+            if self._tail is not None:
+                if self._tail:
+                    self._yielded += 1
+                    return self._tail.pop(0)
+                raise StopIteration
+            if self._inner is None:
+                nxt = self._attached.get()
+                if nxt is _POOL_STREAM_DONE:
+                    # repeat-consumable, like DecodeHandle's sentinel
+                    self._attached.put(_POOL_STREAM_DONE)
+                    # future.result() re-raises the terminal error
+                    # (incl. CancelledError) when the request failed;
+                    # on success any tokens the inner walks missed
+                    # (hedge winner raced us, connection died between
+                    # the result and the last frame) drain as the tail
+                    seq = self.future.result(timeout=0)
+                    self._tail = [int(t) for t in seq[self._yielded:]]
+                    continue
+                self._inner = iter(nxt)
+                self._skip = self._yielded
+                continue
+            try:
+                tok = next(self._inner)
+            except StopIteration:
+                # clean inner finish: the outer future resolves off its
+                # done-callback; loop to the sentinel/tail path
+                self._inner = None
+                continue
+            except BaseException:  # noqa: BLE001 — the router already
+                # classified it: a retryable failure re-dispatches (a
+                # new attach arrives), a terminal one resolves the
+                # outer future (the sentinel arrives); either way the
+                # loop blocks on the attach queue, never on a dead
+                # stream
+                self._inner = None
+                continue
+            if self._skip > 0:
+                self._skip -= 1
+                continue
+            self._yielded += 1
+            return tok
+
+    def result(self, timeout=None):
+        """The full generated token sequence (np.int32 array)."""
+        return self.future.result(timeout)
+
+    def cancel(self):
+        self.future.cancel()
 
 
 class Router:
@@ -390,6 +487,23 @@ class Router:
         never silently lost.  Extra kwargs (e.g. ``max_new_tokens`` for
         decode pools) pass through to the replica's ``submit()``.
         """
+        return self._admit(example, deadline_ms, tenant, kwargs).future
+
+    def submit_stream(self, example, deadline_ms=None, tenant=None,
+                      **kwargs):
+        """Pooled streaming decode: like :meth:`submit` against a
+        decode-replica pool, but returns a :class:`PooledStreamHandle`
+        whose iterator yields tokens as they land — multiplexed
+        per-request, surviving mid-stream replica loss via the same
+        classified re-dispatch path (the re-attached stream skips the
+        already-yielded prefix).  Admission control (quota, closing)
+        is identical to ``submit``."""
+        rreq = self._admit(example, deadline_ms, tenant, kwargs,
+                           stream=True)
+        return rreq.sink
+
+    def _admit(self, example, deadline_ms, tenant, kwargs,
+               stream=False):
         if not self._started or self._closing:
             raise ServerClosedError(
                 "Router is not accepting requests (not started, "
@@ -406,6 +520,9 @@ class Router:
                         "resolves or raise MXTPU_ROUTER_TENANT_QUOTA")
                 self._tenants[tenant] = n + 1
         rreq = _PoolRequest(example, tenant, deadline_ms, kwargs)
+        if stream:
+            rreq.sink = PooledStreamHandle(rreq.future)
+            rreq.future.add_done_callback(rreq.sink._finalize)
         rreq.trace_id = _tracer.request_begin(
             "serve.router.request", cat="serve",
             deadline_ms=deadline_ms if deadline_ms is not None else -1,
@@ -415,7 +532,7 @@ class Router:
         rreq.future.add_done_callback(
             lambda f, r=rreq: self._on_outer_done(r, f))
         self._dispatch(rreq, exclude=frozenset())
-        return rreq.future
+        return rreq
 
     def predict(self, example, deadline_ms=None, timeout=None,
                 tenant=None, **kwargs):
@@ -531,6 +648,10 @@ class Router:
                                       deadline_ms=remaining_ms,
                                       **rreq.kwargs)
         fut = getattr(inner, "future", inner)
+        if rreq.sink is not None and inner is not fut:
+            # streaming dispatch: hand the (decode) handle to the
+            # pooled stream — tokens start flowing before the future
+            rreq.sink._attach(inner, replica.id)
         with self._lock:
             replica.outstanding[fut] = rreq
             replica.dispatched += 1
@@ -548,12 +669,15 @@ class Router:
 
     @staticmethod
     def _retryable(exc, kind):
-        # transient = the classifier's call; a replica closing under a
-        # concurrent eviction is equally re-dispatchable.  `overloaded`
-        # and `deadline` are deliberately NOT here: overload spills or
+        # transient = the classifier's call; `network` (a dropped RPC
+        # connection to a cross-process replica) re-dispatches for the
+        # same reason, and a replica closing under a concurrent
+        # eviction is equally re-dispatchable.  `overloaded` and
+        # `deadline` are deliberately NOT here: overload spills or
         # sheds (no backoff-hammering an overloaded pool), an exhausted
         # budget cannot be retried into existence.
-        return kind == "transient" or isinstance(exc, ServerClosedError)
+        return (kind in ("transient", "network")
+                or isinstance(exc, ServerClosedError))
 
     def _claim_retry(self, rreq):
         with rreq.lock:
@@ -825,6 +949,98 @@ class Router:
             logger.warning("health probe failed on replica %d: %s",
                            replica.id, e)
             self._note_failure(replica)
+
+    # -- pool scaling (the control plane's actuation primitives) ------------
+
+    def admit(self, server=None):
+        """Warm-admit ONE new replica into rotation — the scale-UP
+        actuation path.  The replica is built from the factory when
+        ``server`` is not given, and its full AOT-warming ``start()``
+        runs BEFORE it joins the pool, so scaling up never serves a
+        cold compile in traffic (same admission contract as the
+        eviction path's warm spare).  Returns the new :class:`Replica`.
+        """
+        if not self._started:
+            raise MXNetError("admit() needs a started Router")
+        rid = next(self._ids)
+        if server is None:
+            if self._factory is None:
+                raise MXNetError(
+                    "admit() without server= needs a factory")
+            server = self._factory(rid)
+        server.start()
+        rep = Replica(rid, server)
+        with self._lock:
+            ok = not self._closing
+            if ok:
+                self._pool.append(rep)
+        if not ok:
+            server.shutdown(drain=False, timeout=2.0)
+            raise ServerClosedError(
+                "router is draining/shut down; the admitted replica "
+                "was discarded")
+        _tracer.instant("serve.router.admit", cat="serve", replica=rid)
+        logger.info("replica %d warmed and admitted (pool grows to %d)",
+                    rid, len(self._pool))
+        return rep
+
+    def retire(self, replica=None, timeout=60.0):
+        """Gracefully remove ONE replica from the pool — the scale-DOWN
+        actuation path, riding the ``rolling_reload`` drain machinery:
+        the replica (least-loaded healthy one by default) leaves
+        rotation, its queued and in-flight work drains to completion,
+        then it shuts down and drops from the pool.  Zero requests
+        dropped; refuses to retire the last healthy replica.  Returns
+        the retired replica's id."""
+        with self._lock:
+            cands = [r for r in self._pool if r.state == HEALTHY]
+            if replica is not None:
+                cands = [r for r in cands if r is replica
+                         or r.id == replica]
+        if not cands:
+            raise MXNetError("retire(): no matching healthy replica")
+        # score() reads the servers' live queue gauges OUTSIDE the pool
+        # lock (one-directional router->batcher lock order, like _pick)
+        rep = min(cands, key=lambda r: (r.score(), -r.id))
+        with self._lock:
+            healthy = sum(1 for r in self._pool if r.state == HEALTHY)
+            if healthy <= 1:
+                raise MXNetError(
+                    "refusing to retire the last healthy replica — "
+                    "shut the router down instead")
+            if rep.state != HEALTHY:
+                raise MXNetError(
+                    f"replica {rep.id} left rotation while being "
+                    "selected for retirement; retry")
+            rep.state = RELOADING   # out of _pick, like a reload leg
+        deadline = time.monotonic() + timeout
+        try:
+            while rep.server.pending() > 0 or rep.outstanding:
+                if time.monotonic() > deadline:
+                    raise MXNetError(
+                        f"retire: replica {rep.id} did not drain "
+                        f"within {timeout}s "
+                        f"({rep.server.pending()} pending)")
+                time.sleep(0.005)
+        except Exception:
+            with self._lock:   # put it back in rotation on failure
+                if rep.state == RELOADING:
+                    rep.state = HEALTHY
+            raise
+        try:
+            rep.server.shutdown(
+                drain=True,
+                timeout=max(deadline - time.monotonic(), 1.0))
+        except Exception as e:  # noqa: BLE001 — it is out of rotation
+            # and drained; a noisy teardown must not undo the retire
+            logger.warning("retired replica %d shutdown failed: %s",
+                           rep.id, e)
+        with self._lock:
+            if rep in self._pool:
+                self._pool.remove(rep)
+        logger.info("replica %d drained and retired (pool shrinks "
+                    "to %d)", rep.id, len(self._pool))
+        return rep.id
 
     # -- rolling reload -----------------------------------------------------
 

@@ -417,6 +417,26 @@ def default_registry():
             "decoder); adds the proposal executable to the warmup "
             "surface"))
     reg.register(Knob(
+        "ctrl_scale_up_occupancy", env="CTRL_SCALE_UP_OCCUPANCY",
+        kind="float", domain=(0.5, 0.6, 0.75, 0.85, 0.95),
+        default=0.75, restart="free",
+        doc="control-plane autoscaler scale-UP threshold: mean replica "
+            "occupancy (queue depth / capacity hint) that counts as "
+            "pressure; re-read every tick, so the tuner steers a live "
+            "pool"))
+    reg.register(Knob(
+        "ctrl_scale_down_occupancy", env="CTRL_SCALE_DOWN_OCCUPANCY",
+        kind="float", domain=(0.05, 0.1, 0.25, 0.4), default=0.25,
+        restart="free",
+        doc="control-plane autoscaler scale-DOWN threshold: mean "
+            "occupancy below which sustained idle drains a replica"))
+    reg.register(Knob(
+        "ctrl_cooldown_sec", env="CTRL_COOLDOWN_SEC", kind="float",
+        domain=(5.0, 15.0, 30.0, 60.0, 120.0), default=30.0,
+        restart="free",
+        doc="minimum seconds between autoscaler actions — the "
+            "hysteresis guard against spawn/drain thrash"))
+    reg.register(Knob(
         "zero_shard", env="ZERO_SHARD", kind="bool", default=False,
         restart="recompile",
         doc="ZeRO-1 optimizer-state sharding on/off (recompiles the "

@@ -13,6 +13,7 @@ SURVEY.md provenance note).
 """
 from __future__ import annotations
 
+import io
 import json
 import struct
 
@@ -46,8 +47,8 @@ def _to_numpy(arr):
     return np.asarray(arr)
 
 
-def save_ndarrays(fname, data):
-    """data: list of NDArray or dict str->NDArray (ref: mx.nd.save)."""
+def _write_container(f, data):
+    """Write the versioned container to an open binary file object."""
     if isinstance(data, dict):
         names = list(data.keys())
         arrays = [_to_numpy(v) for v in data.values()]
@@ -66,45 +67,72 @@ def save_ndarrays(fname, data):
                 "tensors": [{"shape": list(a.shape), "dtype": str(a.dtype)}
                             for a in arrays]}
     mbytes = json.dumps(manifest).encode()
-    with open(fname, "wb") as f:
-        f.write(_MAGIC)
-        f.write(struct.pack("<Q", len(mbytes)))
-        f.write(mbytes)
-        for a in arrays:
-            f.write(np.ascontiguousarray(a).tobytes())
+    f.write(_MAGIC)
+    f.write(struct.pack("<Q", len(mbytes)))
+    f.write(mbytes)
+    for a in arrays:
+        f.write(np.ascontiguousarray(a).tobytes())
 
 
-def load_ndarrays(fname):
+def _read_container(f, fname, numpy=False):
+    """Read one container from an open binary file object; ``numpy=True``
+    returns np.ndarray values (the RPC wire path — no device round
+    trip) instead of NDArrays."""
     from ..ndarray.ndarray import array
 
-    with open(fname, "rb") as f:
-        magic = f.read(len(_MAGIC))
-        if magic != _MAGIC:
-            raise MXNetError(f"{fname}: not an NDArray file (bad magic)")
-        (mlen,) = struct.unpack(
-            "<Q", _read_exact(f, 8, fname, "the manifest length"))
-        try:
-            manifest = json.loads(
-                _read_exact(f, mlen, fname, "the manifest").decode())
-        except ValueError as e:
-            raise MXNetError(
-                f"{fname}: corrupt NDArray file (unparseable manifest: "
-                f"{e})") from None
-        version = manifest.get("version", 1)
-        if version > FORMAT_VERSION:
-            raise MXNetError(
-                f"{fname}: NDArray container format v{version} was "
-                f"written by a newer mxnet_tpu (this build reads <= "
-                f"v{FORMAT_VERSION}); upgrade to load it")
-        arrays = []
-        for i, t in enumerate(manifest["tensors"]):
-            dt = np.dtype(t["dtype"])
-            n = int(np.prod(t["shape"])) if t["shape"] else 1
-            buf = _read_exact(f, n * dt.itemsize, fname,
-                              f"tensor {i} of {len(manifest['tensors'])}")
-            arrays.append(
-                array(np.frombuffer(buf, dtype=dt).reshape(t["shape"]),
-                      dtype=dt))
+    magic = f.read(len(_MAGIC))
+    if magic != _MAGIC:
+        raise MXNetError(f"{fname}: not an NDArray file (bad magic)")
+    (mlen,) = struct.unpack(
+        "<Q", _read_exact(f, 8, fname, "the manifest length"))
+    try:
+        manifest = json.loads(
+            _read_exact(f, mlen, fname, "the manifest").decode())
+    except ValueError as e:
+        raise MXNetError(
+            f"{fname}: corrupt NDArray file (unparseable manifest: "
+            f"{e})") from None
+    version = manifest.get("version", 1)
+    if version > FORMAT_VERSION:
+        raise MXNetError(
+            f"{fname}: NDArray container format v{version} was "
+            f"written by a newer mxnet_tpu (this build reads <= "
+            f"v{FORMAT_VERSION}); upgrade to load it")
+    arrays = []
+    for i, t in enumerate(manifest["tensors"]):
+        dt = np.dtype(t["dtype"])
+        n = int(np.prod(t["shape"])) if t["shape"] else 1
+        buf = _read_exact(f, n * dt.itemsize, fname,
+                          f"tensor {i} of {len(manifest['tensors'])}")
+        a = np.frombuffer(buf, dtype=dt).reshape(t["shape"])
+        arrays.append(a if numpy else array(a, dtype=dt))
     if manifest["names"] is None:
         return arrays
     return dict(zip(manifest["names"], arrays))
+
+
+def save_ndarrays(fname, data):
+    """data: list of NDArray or dict str->NDArray (ref: mx.nd.save)."""
+    with open(fname, "wb") as f:
+        _write_container(f, data)
+
+
+def load_ndarrays(fname):
+    with open(fname, "rb") as f:
+        return _read_container(f, fname)
+
+
+def dumps_ndarrays(data):
+    """The same versioned container as :func:`save_ndarrays`, to bytes —
+    the serve control plane's RPC payload encoding (one format for
+    checkpoints and the wire; the loader's version/corruption
+    diagnostics apply to frames too)."""
+    buf = io.BytesIO()
+    _write_container(buf, data)
+    return buf.getvalue()
+
+
+def loads_ndarrays(buf, name="<bytes>", numpy=True):
+    """Decode :func:`dumps_ndarrays` bytes; np.ndarray values by
+    default (wire payloads stay off-device until someone computes)."""
+    return _read_container(io.BytesIO(buf), name, numpy=numpy)

@@ -44,7 +44,8 @@ _LOWER_IS_BETTER = (
     "p50", "p95", "p99", "latency", "_ms", "ms_per", "us_per",
     "lost", "compiles", "dispatches", "steps_lost", "time_to_resume",
     "overhead", "wait", "blocked_moves", "pages_in_flight",
-    "hbm_bytes",
+    "hbm_bytes", "spawn_failures", "rpc_errors",
+    "stale_leases_rejected", "blocked_cooldown", "blocked_bounds",
 )
 _HIGHER_IS_BETTER = (
     "throughput", "tokens_per", "images_per", "rps", "speedup",

@@ -1,0 +1,293 @@
+"""`make ctrl-smoke`: multi-PROCESS serving control-plane CI gate
+(ISSUE 19).
+
+Three replica worker subprocesses behind the control plane.  Asserts
+the chaos-gate contract from docs/serving.md "Control plane":
+
+    offered load triples -> the autoscaler grows the pool 1 -> 3
+    through warm admission: ZERO in-traffic compiles on any replica
+    and ZERO lost requests while scaling
+    sustained idle drains the pool back down to min_replicas, zero
+    requests dropped by the retiring drains
+    one replica PROCESS is SIGKILLed mid-burst -> the router fails
+    over mid-flight (network-classified re-dispatch), the health
+    prober evicts the corpse, a freshly spawned WARM worker rejoins;
+    recovery lands within the latency SLO and the ``requests_lost``
+    audit stays exactly 0
+    the episode is visible in the ``mxtpu_ctrl_*`` gauges (spawns,
+    scale-ups/downs, retirements, stale-lease rejections)
+
+Exit code 0 = every invariant holds.  Runs on the CPU backend so it
+is chip-independent.
+"""
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SLO_MS = 30_000.0          # generous: CPU spawn + warm is ~2s
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import base
+    from mxnet_tpu.parallel.dist import LeaseDir
+    from mxnet_tpu.resilience import RetryPolicy
+    from mxnet_tpu.serve import control_plane as cp
+    from mxnet_tpu.telemetry import metrics as tmetrics
+
+    registry = tempfile.mkdtemp(prefix="ctrl-smoke-")
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               MXTPU_CTRL_LEASE_SEC="2.0")
+    base.setenv("CTRL_LEASE_SEC", 2.0)
+    base.setenv("CTRL_COOLDOWN_SEC", 0)
+
+    def argv(rid):
+        return [sys.executable, "-m",
+                "mxnet_tpu.serve.control_plane.worker",
+                "--registry", registry, "--id", str(rid),
+                "--kind", "decode", "--seed", "0",
+                "--vocab", "32", "--embed", "8",
+                "--max-slots", "4", "--max-len", "24",
+                "--batch-sizes", "1,2", "--lengths", "4,8"]
+
+    failures = []
+
+    def check(name, cond):
+        print(("ok   " if cond else "FAIL ") + name)
+        if not cond:
+            failures.append(name)
+
+    pool = cp.ControlPlane(
+        argv, registry, 1, capacity_hint=4, spawn_env=env,
+        health_sec=0.25, evict_after=2,
+        retry=RetryPolicy(max_retries=3, base_delay=0.01,
+                          max_delay=0.05, seed=7))
+    t0 = time.monotonic()
+    pool.start()
+    print(f"pool up (1 warm replica) in {time.monotonic() - t0:.1f}s")
+    scaler = cp.Autoscaler(pool, min_replicas=1, max_replicas=3,
+                           up_ticks=1, down_ticks=2)
+
+    rng = np.random.RandomState(0)
+    canonical = np.array([1, 2, 3], np.int32)
+    ref = [int(t) for t in
+           pool.predict(canonical, max_new_tokens=6, timeout=60)]
+
+    def burst(n, deadline_ms=60_000, max_new_tokens=8):
+        futs = []
+        for _ in range(n):
+            p = rng.randint(0, 32, size=int(rng.randint(2, 7))) \
+                   .astype(np.int32)
+            futs.append(pool.submit(p, deadline_ms=deadline_ms,
+                                    max_new_tokens=max_new_tokens))
+        return futs
+
+    def settle(futs, timeout=120):
+        lat, errs = [], 0
+        for f in futs:
+            t = time.monotonic()
+            try:
+                f.result(timeout=timeout)
+                lat.append((time.monotonic() - t) * 1e3)
+            except Exception as e:  # noqa: BLE001 — tallied below
+                errs += 1
+                print(f"request failed: {type(e).__name__}: {e}",
+                      file=sys.stderr)
+        return lat, errs
+
+    def live_replicas():
+        return [r.server for r in pool.router.replicas]
+
+    def no_traffic_compiles(phase):
+        for rr in live_replicas():
+            g = rr.stats()["graph"]
+            check(f"{phase}: zero in-traffic compiles on replica "
+                  f"{rr.rid}", g["post_warmup_compiles"] == 0)
+
+    # closed-loop load generator: each worker keeps exactly one request
+    # in flight, so T workers offer a sustained concurrency of T — the
+    # queue stays deep for as long as the generator runs, like real
+    # traffic (a one-shot burst would drain before anyone looked)
+    def start_generator(n, max_new_tokens=8):
+        stop, errs, served = threading.Event(), [], []
+
+        def work():
+            lrng = np.random.RandomState(threading.get_ident() % 9973)
+            while not stop.is_set():
+                p = lrng.randint(0, 32, size=int(lrng.randint(2, 7))) \
+                        .astype(np.int32)
+                try:
+                    pool.submit(p, deadline_ms=60_000,
+                                max_new_tokens=max_new_tokens) \
+                        .result(timeout=120)
+                    served.append(1)
+                except Exception as e:  # noqa: BLE001 — tallied by caller
+                    errs.append(e)
+
+        threads = [threading.Thread(target=work) for _ in range(n)]
+        for t in threads:
+            t.start()
+        return stop, errs, served, threads
+
+    # -- phase A: offered load triples -> warm scale-up 1 -> 3 --------------
+    base_futs = burst(8)
+    _, errs = settle(base_futs)
+    check("baseline burst all served", errs == 0)
+
+    gen_stop, gen_errs, gen_served, workers = start_generator(32)
+    ups_before = cp.ctrl_stats()["scale_ups"]
+    deadline = time.monotonic() + 90
+    while pool.replica_count() < 3 and time.monotonic() < deadline:
+        scaler.tick()
+        time.sleep(0.2)
+    check("autoscaler grew the pool to 3 under tripled load",
+          pool.replica_count() == 3)
+    check("two scale-ups booked",
+          cp.ctrl_stats()["scale_ups"] - ups_before == 2)
+    d3 = scaler.tick()                     # still loaded, at the cap
+    check("max_replicas bound holds", d3["action"] == "hold"
+          and pool.replica_count() == 3)
+    gen_stop.set()
+    for w in workers:
+        w.join(timeout=120)
+    check("scale-up traffic: zero failed requests",
+          not gen_errs and len(gen_served) > 0)
+    no_traffic_compiles("scale-up")
+    s = pool.stats()
+    check("scale-up: requests_lost == 0", s["requests_lost"] == 0)
+
+    # -- phase B: sustained idle drains the pool back down ------------------
+    downs_before = cp.ctrl_stats()["scale_downs"]
+    deadline = time.monotonic() + 90
+    while pool.replica_count() > 1 and time.monotonic() < deadline:
+        scaler.tick()
+        time.sleep(0.05)
+    check("idle pool drained back to min_replicas",
+          pool.replica_count() == 1
+          and cp.ctrl_stats()["scale_downs"] - downs_before == 2)
+    check("drain-down: requests_lost == 0",
+          pool.stats()["requests_lost"] == 0)
+
+    # -- phase C: SIGKILL one replica PROCESS mid-burst ---------------------
+    pool.scale_up()                        # 2 replicas for the kill
+    check("warm-admitted second replica", pool.replica_count() == 2)
+    # sustained closed-loop traffic ACROSS the kill: 16 in-flight
+    # requests split over 2 replicas means the victim is always
+    # carrying live dispatches when its sockets die, so the SIGKILL
+    # MUST strand work that fails over (killing an idle replica would
+    # only exercise the health prober)
+    kill_stop, kill_errs, kill_served, kgen = \
+        start_generator(16, max_new_tokens=16)
+    streams = [pool.submit_stream(canonical, deadline_ms=60_000,
+                                  max_new_tokens=6) for _ in range(3)]
+    stream_toks = [[] for _ in streams]
+    consumers = [threading.Thread(
+        target=lambda h=h, acc=acc: acc.extend(h))
+        for h, acc in zip(streams, stream_toks)]
+    for c in consumers:
+        c.start()
+    # kill the replica that is actually CARRYING in-flight dispatches
+    # (``_pending`` is the client's demux registry of live rids)
+    victim = None
+    for _ in range(5000):
+        carrying = [r.server for r in pool.router.replicas
+                    if r.server._pending]
+        if carrying:
+            victim = max(carrying, key=lambda rr: len(rr._pending))
+            break
+        time.sleep(0.001)
+    check("a replica was mid-dispatch at kill time", victim is not None)
+    victim = victim or pool.router.replicas[0].server
+    t_kill = time.monotonic()
+    victim.process.kill()                  # whole-process SIGKILL
+    print(f"killed replica {victim.rid} (pid {victim.process.pid}) "
+          f"mid-burst carrying {len(victim._pending)} dispatches")
+    time.sleep(1.0)          # generator keeps offering load through
+    kill_stop.set()          # eviction + failover
+    for t in kgen:
+        t.join(timeout=120)
+    futs = burst(16)
+    lat, errs = settle(futs)
+    for c in consumers:
+        c.join(timeout=120)
+    check("kill burst: every request served", errs == 0
+          and len(lat) == 16 and not kill_errs and kill_served)
+    p99 = float(np.percentile(lat, 99)) if lat else float("inf")
+    check(f"kill burst p99 {p99:.0f}ms within SLO {SLO_MS:.0f}ms",
+          p99 < SLO_MS)
+    check("mid-stream failover: streams bit-identical to reference",
+          all(toks == ref for toks in stream_toks))
+
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        s = pool.stats()
+        if s["healthy"] == 2 and s["replacements"] >= 1:
+            break
+        time.sleep(0.05)
+    recovery_ms = (time.monotonic() - t_kill) * 1e3
+    s = pool.stats()
+    check("corpse evicted and a warm spawned worker rejoined",
+          s["healthy"] == 2 and s["evictions"] >= 1
+          and s["replacements"] >= 1)
+    check(f"recovery {recovery_ms:.0f}ms within SLO",
+          recovery_ms < SLO_MS)
+    check("network re-dispatches happened", s["retries"] >= 1)
+    check("kill episode: requests_lost == 0 (exact audit)",
+          s["requests_lost"] == 0)
+    no_traffic_compiles("post-kill")
+
+    # -- evidence: the episode is visible in mxtpu_ctrl_* -------------------
+    stale = LeaseDir(registry, prefix="replica", lease_sec=2.0)
+    stale.publish("ghost", {"host": "h", "port": 1, "pid": 0,
+                            "kind": "decode"})
+    old = time.time() - 3600
+    os.utime(stale.path_for("ghost"), (old, old))
+    cp.discover_replicas(registry, lease_sec=2.0)
+
+    ctrl = cp.ctrl_stats()
+    text = tmetrics._default.render()
+    gauges = {line.split()[0]: float(line.split()[1])
+              for line in text.splitlines()
+              if line.startswith("mxtpu_ctrl_")}
+    check("mxtpu_ctrl_* exported on /metrics",
+          gauges.get("mxtpu_ctrl_spawns", 0) == ctrl["spawns"])
+    check("spawn evidence (initial + 2 up + warm admit + respawn)",
+          ctrl["spawns"] >= 5 and ctrl["spawn_failures"] == 0)
+    check("scaling evidence", ctrl["scale_ups"] == 2
+          and ctrl["scale_downs"] == 2 and ctrl["retired"] >= 2)
+    check("stale lease rejected and booked",
+          ctrl["stale_leases_rejected"] >= 1)
+
+    pool.shutdown(drain=True)
+    print(json.dumps({
+        "served": s["served"], "retries": s["retries"],
+        "evictions": s["evictions"], "replacements": s["replacements"],
+        "requests_lost": s["requests_lost"],
+        "recovery_ms": round(recovery_ms),
+        "p99_ms": round(p99),
+        "ctrl": {k: ctrl[k] for k in
+                 ("spawns", "spawn_failures", "scale_ups",
+                  "scale_downs", "retired", "rpc_requests",
+                  "rpc_streams", "stale_leases_rejected")}}))
+    if failures:
+        print("ctrl-smoke FAILED: " + "; ".join(failures),
+              file=sys.stderr)
+        return 1
+    print(f"ctrl-smoke OK: scaled 1->3->1 across {s['served']} served "
+          f"requests, whole-process kill healed in {recovery_ms:.0f}ms "
+          f"(p99 {p99:.0f}ms), 0 lost, 0 in-traffic compiles")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
