@@ -107,6 +107,15 @@ whole-step-smoke:
 zero-smoke:
 	env PYTHONPATH=. python tools/zero_shard_smoke.py
 
+# multi-axis spmd mesh gate: 30 whole steps on a (dp=4,mp=2) mesh at
+# ONE dispatch / 0 post-warmup compiles each under LR decay, optimizer
+# state measured < 1/4 full bytes on any device, allclose parity with
+# the single-device whole step, and a (dp=4,mp=2) -> (dp=2,mp=2)
+# elastic restore adopting params + state bit-exactly — see
+# tools/spmd_smoke.py / docs/parallelism.md
+spmd-smoke:
+	env PYTHONPATH=. python tools/spmd_smoke.py
+
 # input-pipeline gate: prefetch overlap engaged, zero post-warmup
 # compiles over mixed lengths, bit-identical mid-epoch resume — see
 # tools/pipeline_smoke.py / docs/data.md
@@ -179,7 +188,7 @@ analyze:
 
 # the ROADMAP tier-1 gate, verbatim ($$ = make-escaped shell $)
 verify: SHELL := /bin/bash
-verify: analyze serve-smoke router-smoke decode-smoke paged-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke elastic-smoke trace-smoke health-smoke tune-smoke ctrl-smoke
+verify: analyze serve-smoke router-smoke decode-smoke paged-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke spmd-smoke pipeline-smoke chaos-smoke elastic-smoke trace-smoke health-smoke tune-smoke ctrl-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
-.PHONY: all clean test verify analyze serve-smoke router-smoke decode-smoke paged-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke pipeline-smoke chaos-smoke elastic-smoke trace-smoke health-smoke tune-smoke ctrl-smoke
+.PHONY: all clean test verify analyze serve-smoke router-smoke decode-smoke paged-smoke int8-smoke step-fusion-smoke whole-step-smoke zero-smoke spmd-smoke pipeline-smoke chaos-smoke elastic-smoke trace-smoke health-smoke tune-smoke ctrl-smoke

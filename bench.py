@@ -1062,6 +1062,147 @@ def _leaf_trainer_step(platform):
     }))
 
 
+def _leaf_whole_step_mp(platform):
+    """Multi-axis mesh A/B (parallel.spmd): the same whole-step train
+    loop on ONE device vs a (dp=4,mp=2) mesh, model sized so its params
+    + momenta exceed a single device's share of the mesh budget — the
+    configuration tensor parallelism exists for.  Both arms run the
+    ONE-executable-per-step path; the mesh arm adds GSPMD collectives
+    inside that executable, and ZeRO shards the optimizer state over
+    both axes.  Reports per-arm step latency, dispatches/compiles, and
+    the MEASURED per-device param and optimizer-state bytes — the
+    memory claim (each device holds ~1/mp of the params, ~1/(dp*mp) of
+    the state) as benchmark numbers."""
+    if platform == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+    jax = _leaf_setup(platform)
+    if platform == "cpu":
+        try:
+            jax.config.update("jax_num_cpu_devices", 8)
+        except Exception:  # noqa: BLE001 — older jax: XLA_FLAGS rules
+            pass
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import _imperative, gluon, nd
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon import trainer as trainer_mod
+
+    for _var in ("MXNET_OPTIMIZER_AGGREGATION_SIZE",
+                 "MXTPU_OPTIMIZER_AGGREGATION_SIZE",
+                 "MXTPU_WHOLE_STEP", "MXNET_WHOLE_STEP",
+                 "MXTPU_ZERO_SHARD", "MXNET_ZERO_SHARD",
+                 "MXTPU_MESH_SHAPE", "MXNET_MESH_SHAPE"):
+        os.environ.pop(_var, None)
+
+    # 8 x (512, 512) weights + momenta: ~16 MB of fp32 train state —
+    # small for a CPU but proportioned like the models whose per-device
+    # HBM budget forces the 'mp' axis
+    n_layers, units, batch, iters, windows = 8, 512, 32, 10, 3
+
+    def loss_fn(out, y):
+        return (out - y) ** 2
+
+    def dev0_bytes(arrs, mesh):
+        d0 = mesh.devices.flat[0]
+        return sum(s.data.size * s.data.dtype.itemsize
+                   for a in arrs if a is not None
+                   for s in a.addressable_shards if s.device == d0)
+
+    def host_bytes(trainer):
+        pb = sum(int(np.prod(p.shape)) * np.dtype(p.dtype).itemsize
+                 for p in trainer._params)
+        sb = 0
+        for st in trainer._states:
+            entry = next(iter(st.values())) if st else None
+            if entry is None:
+                continue
+            leaves = entry if isinstance(entry, (tuple, list)) \
+                else (entry,)
+            sb += sum(int(np.prod(s.shape))
+                      * np.dtype(s.dtype).itemsize for s in leaves)
+        return pb, sb
+
+    def measure(mesh_shape):
+        mx.random.seed(0)
+        np.random.seed(0)
+        net = nn.HybridSequential()
+        for _ in range(n_layers):
+            net.add(nn.Dense(units, in_units=units, activation="tanh"))
+        net.initialize(mx.init.Xavier(), ctx=mx.xla(0))
+        trainer = gluon.Trainer(
+            net.collect_params(), "sgd",
+            {"learning_rate": 0.05, "momentum": 0.9},
+            whole_step=True if mesh_shape is None else None,
+            mesh_shape=mesh_shape,
+            zero_shard=mesh_shape is not None)
+        x = np.random.rand(batch, units).astype(np.float32)
+        y = np.random.rand(batch, units).astype(np.float32)
+        for _ in range(5):
+            trainer.whole_step(net, loss_fn, x, y)
+        nd.waitall()
+        trainer_mod.reset_trainer_step_stats()
+        c0 = _imperative.compiled_executable_count()
+        d0 = _imperative.device_dispatch_count()
+        best = None
+        for _ in range(windows):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                trainer.whole_step(net, loss_fn, x, y)
+            nd.waitall()
+            dt = (time.perf_counter() - t0) / iters
+            best = dt if best is None or dt < best else best
+        stats = trainer_mod.trainer_step_stats()
+        compiles = _imperative.compiled_executable_count() - c0
+        disp = round((_imperative.device_dispatch_count() - d0)
+                     / max(stats["steps"], 1), 2)
+        comp = trainer._whole_step_compiler
+        mesh = getattr(comp, "mesh", None)
+        if mesh is not None:
+            param_b = dev0_bytes(comp._gparams, mesh)
+            state_b = comp.state_bytes_per_device()
+        else:
+            param_b, state_b = host_bytes(trainer)
+        arm = {
+            "ms_per_step": round(best * 1e3, 3),
+            "dispatches_per_step": disp,
+            "post_warmup_compiles": compiles,
+            "fallbacks": stats["whole_step_fallbacks"],
+            "param_bytes_per_device": param_b,
+            "state_bytes_per_device": state_b,
+        }
+        if mesh_shape is not None:
+            arm["spmd_steps"] = stats["spmd_steps"]
+        return arm
+
+    single = measure(None)
+    mesh_arm = measure("dp=4,mp=2")
+
+    dev = jax.devices()[0]
+    print(json.dumps({
+        "metric": "whole_step_mp_latency",
+        "value": mesh_arm["ms_per_step"],
+        "unit": "ms/step",
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "n_params": 2 * n_layers,
+        "mesh_shape": "dp=4,mp=2",
+        "arms": {"single_device": single, "mesh_dp4_mp2": mesh_arm},
+        "param_bytes_shrink_ratio": round(
+            mesh_arm["param_bytes_per_device"]
+            / max(single["param_bytes_per_device"], 1), 4),
+        "state_bytes_shrink_ratio": round(
+            mesh_arm["state_bytes_per_device"]
+            / max(single["state_bytes_per_device"], 1), 4),
+        "post_warmup_compiles": mesh_arm["post_warmup_compiles"],
+    }))
+
+
 def _leaf_input_pipeline(platform):
     """Input-pipeline A/B (mxnet_tpu.pipeline): end-to-end train-loop
     throughput with prefetch_to_device vs synchronous feeding, through
@@ -1315,6 +1456,7 @@ _LEAVES = {"resnet": _leaf_resnet, "bert": _leaf_bert,
            "serve_int8": _leaf_serve_int8,
            "serve_router": _leaf_serve_router,
            "trainer_step": _leaf_trainer_step,
+           "whole_step_mp": _leaf_whole_step_mp,
            "input_pipeline": _leaf_input_pipeline,
            "recovery": _leaf_recovery}
 
@@ -1481,7 +1623,7 @@ def main():
     # delay or demote them
     for model in ("bert", "resnet", "serve", "serve_decode",
                   "serve_int8", "serve_router", "trainer_step",
-                  "input_pipeline", "recovery"):
+                  "whole_step_mp", "input_pipeline", "recovery"):
         rec, tpu_ok = _measure(model, tpu_ok, note)
         if rec is not None:
             records[model] = rec

@@ -63,7 +63,7 @@ def _attrs_key(kwargs):
             f"op attributes must be hashable, got {kwargs!r}") from e
 
 
-def get_jitted(fn, kwargs, donate_argnums=None):
+def get_jitted(fn, kwargs, donate_argnums=None, jit_kwargs=None):
     # hot path: attr-less ops (all elementwise arithmetic) skip the
     # sort entirely
     key = (fn, ()) if not kwargs else (fn, _attrs_key(kwargs))
@@ -74,13 +74,19 @@ def get_jitted(fn, kwargs, donate_argnums=None):
         # eager fast path while still counting toward
         # compiled_executable_count()
         key = key + (tuple(donate_argnums),)
+    if jit_kwargs:
+        # sharded whole-step executables pass in/out_shardings
+        # (NamedSharding trees — hashable) straight to jax.jit; keying
+        # on them keeps one executable per declared layout while still
+        # counting toward compiled_executable_count()
+        key = key + (tuple(sorted(jit_kwargs.items(), key=lambda kv: kv[0])),)
     jitted = _jit_cache.get(key)
     if jitted is None:
         closed = functools.partial(fn, **dict(kwargs)) if kwargs else fn
+        extra = dict(jit_kwargs) if jit_kwargs else {}
         if donate_argnums is not None:
-            jitted = jax.jit(closed, donate_argnums=tuple(donate_argnums))
-        else:
-            jitted = jax.jit(closed)
+            extra["donate_argnums"] = tuple(donate_argnums)
+        jitted = jax.jit(closed, **extra) if extra else jax.jit(closed)
         _jit_cache[key] = jitted
     return jitted
 

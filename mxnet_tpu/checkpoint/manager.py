@@ -705,6 +705,17 @@ class CheckpointManager:
             blob = pickle.load(f)
         self._merge_zero_shards(d, blob, own=f"trainer-shard{rank}.states")
         self._reshard_zero_for(trainer, blob, tfile)
+        saved_mesh = blob.get("mesh_shape") if isinstance(blob, dict) \
+            else None
+        cur_mesh = getattr(trainer, "_mesh_shape", None)
+        if saved_mesh or cur_mesh:
+            # elastic mesh leg: spmd snapshots hold full (gathered)
+            # arrays, so a MESH-SHAPE change needs no data motion —
+            # validate/log it (model-axis changes are loud) and mark
+            # the event for chaos plans; load_states_dict re-checks
+            engine.fault_point(
+                "checkpoint.reshard", kind="mesh",
+                saved_mesh=saved_mesh or "", world=0)
         trainer.load_states_dict(blob, source=tfile)
 
     @staticmethod

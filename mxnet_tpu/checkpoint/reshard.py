@@ -138,6 +138,74 @@ def reshard_zero_snapshot(zero, new_world):
             "shards": new_shards}
 
 
+# -- multi-axis mesh shapes -------------------------------------------------
+
+
+def check_mesh_change(saved_shape, new_shape, source="<checkpoint>"):
+    """Validate restoring a snapshot saved at spmd mesh ``saved_shape``
+    into a job running at ``new_shape`` (either side: spec string,
+    shape dict, or None for the single-axis default).
+
+    Param/state leaves in spmd snapshots are FULL global arrays (the
+    checkpoint readback gathers), so any mesh change is
+    representationally fine — the first step at the new shape re-places
+    every array per the new plan.  What must still hold is the MATH:
+    the model-axis product ('mp'×'pp') partitions live layouts, and a
+    restore that changes it is a deliberate model-parallelism change —
+    allowed, but logged loudly so an accidental MXTPU_MESH_SHAPE drift
+    never silently changes the collective pattern.  Returns the parsed
+    new shape (or None)."""
+    from ..log import get_logger
+    from ..parallel.spmd.mesh import (format_mesh_shape, model_axes,
+                                      parse_mesh_shape)
+
+    log = get_logger("mxnet_tpu.checkpoint")
+    saved = parse_mesh_shape(saved_shape) if saved_shape else None
+    new = parse_mesh_shape(new_shape) if new_shape else None
+    if saved == new:
+        return new
+    saved_txt = format_mesh_shape(saved) if saved else "<single-axis>"
+    new_txt = format_mesh_shape(new) if new else "<single-axis>"
+    if new is None:
+        log.warning(
+            "%s: snapshot was saved on spmd mesh %s but this trainer "
+            "has no mesh_shape — restoring onto the single-axis path "
+            "(full arrays; valid, the tensor-parallel layout is "
+            "dropped)", source, saved_txt)
+        return new
+    old_model = int(np.prod(list(model_axes(saved or {}).values()) or [1]))
+    new_model = int(np.prod(list(model_axes(new).values()) or [1]))
+    if old_model != new_model:
+        log.warning(
+            "%s: restoring across a MODEL-parallelism change: saved "
+            "mesh %s (mp*pp=%d) -> new mesh %s (mp*pp=%d). Valid "
+            "(snapshots hold full arrays) but deliberate-only: the "
+            "collective pattern and per-device memory change.",
+            source, saved_txt, old_model, new_txt, new_model)
+    else:
+        log.info(
+            "%s: elastic mesh reshape on restore: %s -> %s (data axes "
+            "only; model axes preserved)", source, saved_txt, new_txt)
+    return new
+
+
+def reshard_states_blob(blob, new_world, source="<checkpoint>"):
+    """Repartition one trainer states blob for a ``new_world``-rank
+    job: spmd/mesh metadata is validated+remapped by
+    :func:`check_mesh_change` at load time (full arrays need no data
+    motion), while a legacy ZeRO flat-shard snapshot delegates to
+    :func:`reshard_zero_snapshot` for the real repartition.  Returns
+    the (possibly new) blob."""
+    if not isinstance(blob, dict):
+        return blob
+    if blob.get("zero"):
+        zero = blob["zero"]
+        if int(zero.get("world", new_world)) != int(new_world):
+            blob = dict(blob)
+            blob["zero"] = reshard_zero_snapshot(zero, new_world)
+    return blob
+
+
 # -- pipeline state ---------------------------------------------------------
 
 

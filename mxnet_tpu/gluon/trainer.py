@@ -23,7 +23,7 @@ from .parameter import Parameter, ParameterDict
 _step_stats = {"steps": 0, "params_fused": 0, "buckets_built": 0,
                "dispatches": 0, "whole_step_steps": 0,
                "whole_step_compiles": 0, "whole_step_fallbacks": 0,
-               "zero_steps": 0, "zero_fallbacks": 0}
+               "zero_steps": 0, "zero_fallbacks": 0, "spmd_steps": 0}
 
 
 def trainer_step_stats():
@@ -39,7 +39,8 @@ def trainer_step_stats():
     the eager fused path), and the ZeRO-1 counters — zero_steps (steps
     whose weight update ran cross-replica-sharded) and zero_fallbacks
     (zero_shard steps that ran unsharded for an ineligible
-    configuration)."""
+    configuration) — plus spmd_steps (whole steps that ran on a
+    multi-axis mesh via the GSPMD compiler, ``mesh_shape=...``)."""
     s = dict(_step_stats)
     s["dispatches_per_step"] = (round(s["dispatches"] / s["steps"], 2)
                                 if s["steps"] else 0.0)
@@ -55,7 +56,7 @@ class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
                  update_on_kvstore=None, whole_step=None,
-                 zero_shard=None):
+                 zero_shard=None, mesh_shape=None, sharding_plan=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -78,6 +79,7 @@ class Trainer:
         # whole-step compilation (ROADMAP item 4): opt-in via the ctor
         # arg or MXTPU_WHOLE_STEP; None defers to the env knob so a
         # deployment can flip the path without code changes
+        whole_step_default = whole_step is None
         if whole_step is None:
             from ..base import getenv
 
@@ -94,6 +96,29 @@ class Trainer:
 
             zero_shard = getenv("ZERO_SHARD", False, bool)
         self._zero_shard = bool(zero_shard)
+        # multi-axis spmd mesh (ROADMAP item 1): a mesh-shape spec
+        # ('dp=4,mp=2' / dict) routes whole_step() through the GSPMD
+        # SpmdStepCompiler — params shard over 'mp', batch over 'dp',
+        # ZeRO state over both — still ONE executable per step.  None
+        # defers to MXTPU_MESH_SHAPE; setting a shape implies the
+        # whole-step path (the eager pipeline has no multi-axis form).
+        if mesh_shape is None:
+            from ..parallel.spmd import mesh as _spmd_mesh
+
+            self._mesh_shape = _spmd_mesh.mesh_shape_from_env()
+        else:
+            from ..parallel.spmd import mesh as _spmd_mesh
+
+            self._mesh_shape = _spmd_mesh.parse_mesh_shape(mesh_shape)
+        self._sharding_plan = sharding_plan
+        if self._mesh_shape is not None and whole_step_default:
+            self._whole_step = True
+        if sharding_plan is not None and self._mesh_shape is None:
+            raise MXNetError(
+                "sharding_plan given but no mesh_shape — pass "
+                "mesh_shape='dp=...,mp=...' (or set MXTPU_MESH_SHAPE) "
+                "to route steps onto the multi-axis mesh the plan "
+                "shards over")
         self._zero_states = {}   # chunk pos -> {rank: tuple(shard NDArrays)}
         self._zero_layout = None  # (per-chunk layout tuple, world)
         self._zero_warned = set()
@@ -492,7 +517,14 @@ class Trainer:
             from . import whole_step as _ws
 
             if self._whole_step_compiler is None:
-                self._whole_step_compiler = _ws.WholeStepCompiler(self)
+                if self._mesh_shape is not None:
+                    from ..parallel.spmd import SpmdStepCompiler
+
+                    self._whole_step_compiler = \
+                        SpmdStepCompiler.from_shape(
+                            self, self._mesh_shape, self._sharding_plan)
+                else:
+                    self._whole_step_compiler = _ws.WholeStepCompiler(self)
             self._optimizer.rescale_grad = self._scale / batch_size
             try:
                 with _profiler.op_scope("whole_step", cat="trainer"):
@@ -510,6 +542,8 @@ class Trainer:
                 _step_stats["whole_step_compiles"] += wstats["compiles"]
                 if wstats.get("zero"):
                     _step_stats["zero_steps"] += 1
+                if wstats.get("spmd"):
+                    _step_stats["spmd_steps"] += 1
                 # health-monitor FLOP geometry (batch size + param
                 # elements -> the analytic MFU fallback); disarmed
                 # this is the module no-op
@@ -756,6 +790,15 @@ class Trainer:
                "num_update": self._optimizer.num_update,
                "index_update_count":
                    dict(self._optimizer._index_update_count)}
+        if self._mesh_shape is not None:
+            # metadata only: spmd state leaves are GLOBAL arrays (the
+            # d2h readback gathers full values), so the snapshot itself
+            # is mesh-agnostic; recording the shape lets a restore at a
+            # different MXTPU_MESH_SHAPE be validated/logged
+            # (checkpoint.reshard.check_mesh_change) instead of silent
+            from ..parallel.spmd.mesh import format_mesh_shape
+
+            out["mesh_shape"] = format_mesh_shape(self._mesh_shape)
         if self._zero_states:
             # ZeRO-1: the live optimizer state is per-rank flat shards
             # (1/world each); snapshot THEM (device-resident leaves —
@@ -810,6 +853,11 @@ class Trainer:
                 "update_on_kvstore=False to resume these states")
         from ..optimizer import _states_from_np
 
+        if blob.get("mesh_shape"):
+            from ..checkpoint.reshard import check_mesh_change
+
+            check_mesh_change(blob["mesh_shape"], self._mesh_shape,
+                              source=source)
         self._optimizer.num_update = blob["num_update"]
         self._optimizer._index_update_count = dict(
             blob["index_update_count"])

@@ -302,7 +302,8 @@ class WholeStepCompiler:
                              "whole-step mesh not supported yet)")
             from ..parallel import mesh as _mesh_mod
 
-            return (_mesh_mod.replica_mesh(
+            return (_mesh_mod.make_mesh(
+                {"dp": len(ctxs)},
                 [c.jax_device() for c in ctxs]), "dp")
         if multiproc:
             return (_dist.world_mesh(), "world")

@@ -817,6 +817,36 @@ def apply_zero_step_plan(plan, w_raws, g_raws, st_shard_raws, sval_raws,
     return new_ws, new_sts
 
 
+def apply_spmd_step_plan(plan, w_raws, g_raws, st_raws, sval_raws):
+    """Per-parameter twin of :func:`apply_whole_step_plan` for the
+    GSPMD multi-axis path: run each chunk's ``_fk_*`` kernel on every
+    member tensor SEPARATELY instead of on the flat concatenation.
+    Concatenating would erase the per-param PartitionSpecs the spmd
+    compiler pinned (a Dense weight sharded over 'mp' and a replicated
+    bias cannot share one flat bucket without an allgather); the fused
+    kernels are elementwise/shape-agnostic — the same
+    ``kernel(w, g, *states, *scalars, **static)`` contract
+    :func:`apply_zero_step_plan` uses on shard-sized buffers — so the
+    per-tensor application computes the same update, and XLA keeps
+    every weight/state in its declared layout end to end.  Scalar
+    hyperparams ride the same pre-cast traced ``sval_raws`` arrays, so
+    LR schedules never retrace."""
+    new_ws = list(w_raws)
+    new_sts = [list(st) for st in st_raws]
+    for (kernel, static, n_states, _dt, idxs), sv in zip(plan, sval_raws):
+        scalars = [sv[k] for k in range(int(sv.shape[0]))]
+        kw = dict(static)
+        for j in idxs:
+            outs = kernel(w_raws[j], g_raws[j], *st_raws[j],
+                          *scalars, **kw)
+            if not isinstance(outs, tuple):
+                outs = (outs,)
+            new_ws[j] = outs[0]
+            for slot in range(n_states):
+                new_sts[j][slot] = outs[1 + slot]
+    return new_ws, [tuple(st) for st in new_sts]
+
+
 @register("sgd")
 class SGD(Optimizer):
     supports_sparse = True

@@ -9,7 +9,7 @@ from . import dist  # noqa: F401
 
 def __getattr__(name):
     if name in ("mesh", "data_parallel", "ring_attention", "ulysses",
-                "pipeline", "moe"):
+                "pipeline", "moe", "spmd"):
         import importlib
 
         mod = importlib.import_module("." + name, __name__)

@@ -16,16 +16,28 @@ from ..base import MXNetError
 
 
 def make_mesh(axis_shapes=None, devices=None):
-    """Build a Mesh.  axis_shapes: dict axis->size or None for all-dp."""
+    """THE canonical mesh constructor: every named mesh in the package
+    is built here, whatever the axis count.
+
+    ``axis_shapes``: a dict ``axis -> size``, a spec string like
+    ``'dp=4,mp=2'`` (validated against the canonical axis alphabet by
+    ``parallel.spmd.mesh.parse_mesh_shape``), or None for a one-axis
+    all-'dp' mesh over ``devices`` (default: all local devices).  The
+    axis product must equal the device count — a mismatch is a loud
+    error, never a truncated mesh."""
     import jax
     from jax.sharding import Mesh
 
+    if isinstance(axis_shapes, str):
+        from .spmd.mesh import parse_mesh_shape
+
+        axis_shapes = parse_mesh_shape(axis_shapes)
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
     if axis_shapes is None:
         axis_shapes = {"dp": n}
     names = tuple(axis_shapes)
-    sizes = tuple(axis_shapes.values())
+    sizes = tuple(int(s) for s in axis_shapes.values())
     if int(np.prod(sizes)) != n:
         raise MXNetError(
             f"mesh {axis_shapes} needs {int(np.prod(sizes))} devices, "
@@ -41,14 +53,13 @@ def replicated(mesh):
 
 
 def replica_mesh(devices, axis="dp"):
-    """One-axis mesh over an explicit replica device list — the
-    whole-step trainer's SPMD form of the eager per-context replica
-    set (each gluon Parameter context becomes one shard of the batch
-    axis; the kvstore allreduce becomes an in-program psum over
-    ``axis``)."""
-    from jax.sharding import Mesh
-
-    return Mesh(np.array(list(devices)), (axis,))
+    """DEPRECATED alias: a one-axis mesh over an explicit replica
+    device list.  Kept for callers of the original single-axis
+    whole-step API; new code should call :func:`make_mesh` (which this
+    delegates to) — it is the one constructor that also understands
+    multi-axis shapes and spec strings."""
+    devices = list(devices)
+    return make_mesh({axis: len(devices)}, devices)
 
 
 def data_axes(mesh):

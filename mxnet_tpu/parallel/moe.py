@@ -11,6 +11,12 @@ exchanges from the sharding annotations alone; no hand-written
 collectives.  Capacity-limited top-1 routing keeps every shape static
 (XLA requirement): tokens beyond an expert's capacity are dropped and
 pass through the residual path, exactly like GShard/Switch.
+
+The 'ep' axis composes with the named trainer mesh the same way 'mp'
+does: build the mesh with ``parallel.spmd.make_spmd_mesh`` and express
+the expert sharding as ``ShardingPlan.override`` PartitionSpecs on the
+(E, ...) expert weights — see docs/parallelism.md for the mesh/plan
+tour and the whole-step entry point.
 """
 from __future__ import annotations
 

@@ -1,112 +1,22 @@
-"""Pipeline parallelism: GPipe-style microbatching over a 'pp' mesh axis.
+"""Compatibility shim: the pipeline schedule moved to
+``parallel.spmd.schedule``.
 
-Ref capability: ABSENT in the reference (SURVEY §2.3 'PP: ABSENT —
-closest: group2ctx manual staging, no microbatching'); this is a
-capability upgrade alongside TP/SP.
+The GPipe rotate schedule now lives with the rest of the multi-axis
+machinery (mesh specs, ShardingPlan, SpmdStepCompiler) so the 'pp'
+axis is programmed through one package.  This module keeps the
+original import path working:
 
-TPU-native design: stage parameters are STACKED on a leading axis of
-size P and sharded over the 'pp' mesh axis, so each device holds one
-stage.  Inside shard_map, a fori_loop runs the classic GPipe schedule:
-at tick t, device 0 feeds microbatch t, every device applies its stage
-to its current activation, and activations rotate one hop along the
-pipeline with ppermute (ICI neighbour exchange).  After P-1 warmup
-ticks the pipe is full; outputs stream off the last device and are
-broadcast with a masked psum.  Backward is jax autodiff through the
-whole schedule — ppermute transposes to the reverse rotation, giving
-the mirrored fill/drain automatically.
+- :func:`~mxnet_tpu.parallel.spmd.schedule.pipeline_apply` — the
+  stacked-stage rotate schedule (unchanged API);
+- new code should also look at
+  :func:`~mxnet_tpu.parallel.spmd.schedule.stage_partition` (balanced
+  layer→stage ranges) and
+  :class:`~mxnet_tpu.parallel.spmd.schedule.PipelineTrainStep` (the
+  microbatched TRAINING step as one pjit'd program).
 
-Constraints (the standard stacked-pipeline contract): all stages share
-one jittable ``stage_fn(params_slice, x) -> y`` with x and y of the
-same shape, and the number of microbatches must be >= 1.  Wall-clock
-efficiency is n_micro / (n_micro + P - 1) (the GPipe bubble).
+See docs/parallelism.md.
 """
-from __future__ import annotations
+from .spmd.schedule import (_pipeline_sharded, pipeline_apply,  # noqa: F401
+                            stage_partition)
 
-import jax
-import jax.numpy as jnp
-
-from ..base import MXNetError
-
-
-def _pipeline_sharded(params, xs_local, *, stage_fn, axis_name, n_micro,
-                      P):
-    """Runs INSIDE shard_map: params leaves are the local (1, ...)
-    stage slice; xs_local is the replicated (n_micro, mb, ...) batch."""
-    idx = jax.lax.axis_index(axis_name)
-    local = jax.tree.map(lambda p: p[0], params)
-    T = n_micro + P - 1
-    # carries vary across the 'pp' axis (per-device state) — mark them
-    # so shard_map's vma check accepts the fori_loop carry
-    from . import mesh as _mesh_mod
-
-    acts, outs = _mesh_mod.pcast(
-        (jnp.zeros_like(xs_local[0]), jnp.zeros_like(xs_local)),
-        axis_name, to="varying")
-
-    def tick(t, carry):
-        acts, outs = carry
-        # device 0 ingests microbatch t (zeros once drained)
-        feed = jnp.where(t < n_micro, xs_local[jnp.minimum(
-            t, n_micro - 1)], jnp.zeros_like(acts))
-        inp = jnp.where(idx == 0, feed, acts)
-        out = stage_fn(local, inp)
-        # last device emits microbatch t-(P-1) at tick t
-        emit_t = t - (P - 1)
-        outs = jnp.where(
-            (idx == P - 1) & (emit_t >= 0),
-            outs.at[jnp.maximum(emit_t, 0)].set(out), outs)
-        # rotate activations one hop down the pipe
-        acts = jax.lax.ppermute(
-            out, axis_name, [(j, (j + 1) % P) for j in range(P)])
-        return acts, outs
-
-    _, outs = jax.lax.fori_loop(0, T, tick, (acts, outs))
-    # broadcast the last device's outputs to every device
-    mask = (idx == P - 1).astype(outs.dtype)
-    return jax.lax.psum(outs * mask, axis_name)
-
-
-def pipeline_apply(stage_fn, stacked_params, x, mesh, axis="pp",
-                   n_micro=None):
-    """Run x through P pipelined stages.
-
-    stage_fn: (params_slice, x_mb) -> y_mb, same shape in/out.
-    stacked_params: pytree whose leaves have leading dim P (one slice
-      per stage) — shard leading dim over `axis` for real PP.
-    x: (B, ...) with B divisible by n_micro (n_micro >= 1; default P).
-    Returns (B, ...) outputs (the composition of all stages).
-    """
-    from jax.sharding import PartitionSpec
-
-    from . import mesh as mesh_mod
-
-    shard_map = mesh_mod.shard_map()
-
-    P = mesh.shape[axis]
-    n_micro = P if n_micro is None else int(n_micro)
-    if n_micro < 1:
-        raise MXNetError(f"n_micro must be >= 1, got {n_micro}")
-    B = x.shape[0]
-    if B % n_micro:
-        raise MXNetError(f"batch {B} must divide into n_micro={n_micro}")
-    mb = B // n_micro
-    xs = x.reshape((n_micro, mb) + x.shape[1:])
-
-    pspec = jax.tree.map(lambda _: PartitionSpec(axis), stacked_params)
-    in_specs = (pspec, PartitionSpec())
-    try:
-        # cached jit(shard_map) keyed on (stage_fn, mesh, specs, attrs)
-        # — a fresh closure per call would retrace every training step
-        fn = mesh_mod.spmd_jit(
-            _pipeline_sharded, mesh, in_specs, PartitionSpec(),
-            stage_fn=stage_fn, axis_name=axis, n_micro=n_micro, P=P)
-    except TypeError:
-        # unhashable param pytree (dict specs): uncached fallback
-        import functools
-
-        fn = jax.jit(shard_map(
-            functools.partial(_pipeline_sharded, stage_fn=stage_fn,
-                              axis_name=axis, n_micro=n_micro, P=P),
-            mesh=mesh, in_specs=in_specs, out_specs=PartitionSpec()))
-    out = fn(stacked_params, xs)
-    return out.reshape((B,) + x.shape[1:])
+__all__ = ["pipeline_apply", "stage_partition"]

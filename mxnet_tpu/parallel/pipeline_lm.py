@@ -35,6 +35,11 @@ shard_map under the same jit.  ``tests/test_pipeline_moe.py`` trains it
 on the 8-device CPU mesh (dp2 x tp2 x pp2) and checks the loss against
 a single-device reference implementation; ``__graft_entry__.py`` dry-
 runs the same combined mesh for the driver.
+
+This is the hand-built transformer product surface; the GENERIC
+entry points — ``Trainer(mesh_shape=...)`` for (dp, mp) whole steps
+over arbitrary gluon blocks, ``parallel.spmd.PipelineTrainStep`` for
+explicit uniform stages — are the docs/parallelism.md tour.
 """
 from __future__ import annotations
 

@@ -12,6 +12,12 @@ online-softmax (m, l, acc) recurrence, then rotates k/v around the ring
 with ppermute — compute overlaps the ICI transfer since XLA pipelines
 the collective-permute with the matmuls.  Per-device memory stays
 O(seq/P); the full score matrix never exists.
+
+The 'sp' axis is a sibling of the trainer mesh's named axes
+(docs/parallelism.md): build a combined mesh with
+``parallel.spmd.make_spmd_mesh``/``parallel.mesh.make_mesh`` and run
+this kernel inside the step's shard_map; ``parallel.ulysses`` is the
+all-to-all alternative for head-rich models.
 """
 from __future__ import annotations
 

@@ -1,0 +1,44 @@
+"""mxnet_tpu.parallel.spmd — multi-axis sharded whole-step training.
+
+One compiled program per step over a named mesh (``'dcn','dp','mp','pp'``):
+
+- :mod:`.mesh` — ``MXTPU_MESH_SHAPE`` spec parsing/validation
+  (:func:`parse_mesh_shape`), mesh construction
+  (:func:`make_spmd_mesh`), and the elastic-resize shape rule
+  (:func:`pick_mesh_shape`);
+- :mod:`.plan` — :class:`ShardingPlan`: auto PartitionSpec rules for
+  Dense/Conv/attention params plus per-path glob overrides;
+- :mod:`.lowering` — :class:`SpmdStepCompiler`: the GSPMD whole-step
+  (params over 'mp', batch over 'dp', ZeRO state over both) as ONE
+  pre-warmed ``jax.jit`` executable — Trainer routes here when
+  ``mesh_shape`` is set;
+- :mod:`.schedule` — the 'pp' axis: :func:`stage_partition`,
+  :func:`pipeline_apply` (inference rotate schedule) and
+  :class:`PipelineTrainStep` (microbatched training loop traced into
+  one pjit'd program).
+
+See docs/parallelism.md for the user-facing tour.
+"""
+from .mesh import (AXIS_ORDER, format_mesh_shape, make_spmd_mesh,
+                   mesh_shape_from_env, model_axes, parse_mesh_shape,
+                   pick_mesh_shape)
+from .plan import ShardingPlan
+from .lowering import SpmdStepCompiler
+from .schedule import (PipelineTrainStep, default_microbatches,
+                       pipeline_apply, stage_partition)
+
+__all__ = [
+    "AXIS_ORDER",
+    "parse_mesh_shape",
+    "format_mesh_shape",
+    "mesh_shape_from_env",
+    "make_spmd_mesh",
+    "model_axes",
+    "pick_mesh_shape",
+    "ShardingPlan",
+    "SpmdStepCompiler",
+    "stage_partition",
+    "default_microbatches",
+    "pipeline_apply",
+    "PipelineTrainStep",
+]

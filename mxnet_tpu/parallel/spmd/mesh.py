@@ -1,0 +1,180 @@
+"""Multi-axis mesh specs for the spmd whole-step path.
+
+The mesh SHAPE — which named axes exist and how many devices each gets
+— is configuration, not code: ``MXTPU_MESH_SHAPE=dp=4,mp=2`` (or the
+``Trainer(mesh_shape=...)`` ctor arg) names it, this module parses and
+validates it, and ``parallel.mesh.make_mesh`` realizes it over the
+device list.  The axis alphabet is fixed so a typo is a loud error, not
+a silently replicated axis:
+
+- ``dcn`` — cross-slice/process data axis (outermost; hierarchical
+  gradient reduction, see ``parallel.mesh.data_axes``)
+- ``dp``  — data parallel: the batch dim shards here; ZeRO-1 optimizer
+  state shards here too
+- ``mp``  — model/tensor parallel: param dims shard here
+  (``plan.ShardingPlan``); XLA inserts the matmul collectives
+- ``pp``  — pipeline stages (``spmd.schedule``); the generic
+  whole-step cannot auto-stage an arbitrary block, so ``pp > 1`` in a
+  Trainer mesh is rejected loudly with a pointer to the schedule API
+
+Elastic resizes change the shape, not just the world size:
+:func:`pick_mesh_shape` keeps the MODEL axes (mp/pp — live layouts
+partition over them) and shrinks the data axes to the surviving device
+count, the rule ``Supervisor`` applies after ``dist.shrink`` (e.g.
+(dp=4,mp=2) → (dp=2,mp=2) after losing half the devices).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError, getenv
+
+# the full axis vocabulary, outermost first (mesh axis ORDER is
+# meaningful: device coordinates map to axes in this order, and the
+# spec string must follow it so two jobs spelling the same shape get
+# the same device placement)
+AXIS_ORDER = ("dcn", "dp", "mp", "pp")
+
+
+def parse_mesh_shape(spec):
+    """``"dp=4,mp=2"`` → ``{"dp": 4, "mp": 2}`` (insertion-ordered).
+
+    Accepts a dict (validated and passed through) or a spec string.
+    Loud errors: empty/malformed entries, an axis outside
+    :data:`AXIS_ORDER`, a duplicate axis, a non-positive size, or axes
+    out of the canonical order."""
+    if isinstance(spec, dict):
+        items = [(str(k), v) for k, v in spec.items()]
+    else:
+        text = str(spec).strip()
+        if not text:
+            raise MXNetError(
+                "empty mesh shape — expected e.g. 'dp=4,mp=2' "
+                f"(axes from {AXIS_ORDER})")
+        items = []
+        for part in text.split(","):
+            part = part.strip()
+            if "=" not in part:
+                raise MXNetError(
+                    f"malformed mesh-shape entry {part!r} in {spec!r} "
+                    "— expected axis=size, e.g. 'dp=4,mp=2'")
+            name, _, val = part.partition("=")
+            items.append((name.strip(), val.strip()))
+    shape = {}
+    for name, val in items:
+        if name not in AXIS_ORDER:
+            raise MXNetError(
+                f"unknown mesh axis {name!r} in {spec!r} — the axis "
+                f"alphabet is {AXIS_ORDER} (dcn=cross-slice data, "
+                "dp=data, mp=tensor, pp=pipeline)")
+        if name in shape:
+            raise MXNetError(f"duplicate mesh axis {name!r} in {spec!r}")
+        try:
+            size = int(val)
+        except (TypeError, ValueError):
+            raise MXNetError(
+                f"mesh axis {name!r} size {val!r} is not an integer "
+                f"(in {spec!r})") from None
+        if size < 1:
+            raise MXNetError(
+                f"mesh axis {name!r} size must be >= 1, got {size} "
+                f"(in {spec!r})")
+        shape[name] = size
+    order = [a for a in AXIS_ORDER if a in shape]
+    if list(shape) != order:
+        raise MXNetError(
+            f"mesh axes must follow the canonical order {AXIS_ORDER} "
+            f"(outermost first), got {list(shape)} in {spec!r} — two "
+            "jobs spelling one shape must agree on device placement")
+    return shape
+
+
+def format_mesh_shape(shape):
+    """Inverse of :func:`parse_mesh_shape`: ``{"dp":4,"mp":2}`` →
+    ``"dp=4,mp=2"`` (the canonical env-knob spelling)."""
+    return ",".join(f"{a}={int(n)}" for a, n in shape.items())
+
+
+def mesh_shape_from_env():
+    """The configured ``MXTPU_MESH_SHAPE`` as a validated dict, or None
+    when the knob is unset (single-axis 'dp' semantics everywhere)."""
+    spec = getenv("MESH_SHAPE", None)
+    if spec is None or not str(spec).strip():
+        return None
+    return parse_mesh_shape(spec)
+
+
+def make_spmd_mesh(shape, devices=None):
+    """Realize a parsed/spec mesh shape as a ``jax.sharding.Mesh`` over
+    ``devices`` (default: all local devices).
+
+    A shape needing MORE devices than available raises loudly (the
+    axis-product probe).  A shape covering FEWER takes the first
+    axis-product devices — deterministic prefix selection, the contract
+    an elastic resize relies on: the surviving shape from
+    :func:`pick_mesh_shape` must build on a host whose local device
+    count did not shrink (single-process rehearsal, and the restored
+    smaller-world job on shared hardware)."""
+    from .. import mesh as _mesh_mod
+
+    shape = parse_mesh_shape(shape)
+    need = int(np.prod(list(shape.values()) or [1]))
+    if devices is None:
+        import jax
+
+        devices = jax.devices()
+    devices = list(devices)
+    if need > len(devices):
+        raise MXNetError(
+            f"mesh shape {format_mesh_shape(shape)!r} needs {need} "
+            f"devices, have {len(devices)}")
+    return _mesh_mod.make_mesh(shape, devices[:need])
+
+
+def model_axes(shape):
+    """The non-data axes of a shape dict — the ones an elastic resize
+    must PRESERVE (live param/stage layouts partition over them)."""
+    return {a: n for a, n in shape.items() if a in ("mp", "pp")}
+
+
+def pick_mesh_shape(shape, new_world):
+    """The mesh shape a resized job runs at: keep every model axis
+    (mp/pp), shrink the data axes to fit ``new_world`` devices.
+
+    ``new_world`` must remain a multiple of the model-axis product —
+    losing a rank out of an mp/pp group leaves layouts that cannot be
+    repartitioned without a full reshard from checkpoint at a smaller
+    model parallelism, which is a deliberate decision, not something a
+    supervisor should silently pick.  A 'dcn' axis is kept when it
+    still divides the data budget and folded into 'dp' otherwise
+    (single-slice survivor)."""
+    shape = parse_mesh_shape(shape)
+    new_world = int(new_world)
+    if new_world < 1:
+        raise MXNetError(f"cannot shape a mesh over {new_world} devices")
+    model = int(np.prod(list(model_axes(shape).values()) or [1]))
+    if new_world % model:
+        raise MXNetError(
+            f"surviving world {new_world} is not a multiple of the "
+            f"model-axis product {model} ({format_mesh_shape(model_axes(shape))}) "
+            "— an elastic resize only reshapes the data axes; shrink "
+            "mp/pp explicitly (new MXTPU_MESH_SHAPE + restore from "
+            "checkpoint) to change model parallelism")
+    data = new_world // model
+    out = {}
+    for a, n in shape.items():
+        if a in ("mp", "pp"):
+            out[a] = n
+        elif a == "dcn":
+            if data % n == 0 and data // n >= 1 and n <= data:
+                out[a] = n
+                data //= n
+            # else: fold the dcn axis into dp (single-slice survivor)
+    out2 = {}
+    for a in AXIS_ORDER:
+        if a == "dp":
+            out2["dp"] = data
+        elif a in out:
+            out2[a] = out[a]
+    return {a: n for a, n in out2.items()
+            if a in shape or a == "dp"}

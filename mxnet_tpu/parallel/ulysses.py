@@ -14,6 +14,11 @@ second all_to_all restores sequence sharding.  Communication volume is
 2·(B·H·S·D)/P per device vs ring attention's P k/v rotations — Ulysses
 wins when H >= P and attention is reused many times per layer; ring
 wins at extreme S where even one full-head sequence doesn't fit.
+
+Like ``parallel.ring_attention``'s 'sp' axis, this composes with the
+named trainer mesh (docs/parallelism.md): carve the sequence axis out
+of the same ``parallel.spmd.make_spmd_mesh`` device grid and call this
+inside the step's shard_map.
 """
 from __future__ import annotations
 

@@ -474,7 +474,8 @@ register_section("trainerStep", _trainer_step_counters, _rows_table(
      ("whole-step compiles", "whole_step_compiles"),
      ("whole-step fallbacks", "whole_step_fallbacks"),
      ("zero-sharded steps", "zero_steps"),
-     ("zero-shard fallbacks", "zero_fallbacks"))))
+     ("zero-shard fallbacks", "zero_fallbacks"),
+     ("spmd mesh steps", "spmd_steps"))))
 register_section("dataPipeline", _data_pipeline_counters, _rows_table(
     "Data Pipeline",
     (("batches delivered", "batches"),

@@ -262,6 +262,29 @@ class RunContext:
     def resizes(self):
         return self._sup._resizes
 
+    def mesh_shape(self, world=None):
+        """The spmd mesh shape THIS invocation should train at, or
+        None when no multi-axis mesh is configured.
+
+        Starts from the configured ``MXTPU_MESH_SHAPE`` (which the
+        supervisor rewrites after every elastic resize) and — as a
+        belt-and-braces guard against a train_fn that sized its own
+        world — re-applies :func:`parallel.spmd.mesh.pick_mesh_shape`
+        to the current ``world``: model axes ('mp'/'pp') are preserved,
+        data axes shrink to the survivors.  An elastic spmd train_fn
+        builds its Trainer as ``Trainer(..., mesh_shape=
+        ctx.mesh_shape())`` on every invocation."""
+        from ..parallel.spmd.mesh import (mesh_shape_from_env,
+                                          pick_mesh_shape)
+
+        shape = mesh_shape_from_env()
+        if shape is None:
+            return None
+        world = self.world if world is None else int(world)
+        if world:
+            shape = pick_mesh_shape(shape, world)
+        return shape
+
     def step_done(self, step, save=None):
         """Report step ``step`` completed: feeds the progress watchdog,
         fires the ``train.step`` fault point (where kill-at-step-N chaos
@@ -679,10 +702,50 @@ class Supervisor:
         self._resizes += 1
         _stats.add("resizes")
         _stats.add("ranks_lost", lost)
+        mesh_txt = self._resize_mesh_shape(new_world)
         _tracer.instant("resilience.resize", cat="resilience",
                         world=new_world, new_rank=new_rank,
-                        ranks_lost=lost, resizes=self._resizes)
+                        ranks_lost=lost, resizes=self._resizes,
+                        mesh_shape=mesh_txt)
         return True
+
+    def _resize_mesh_shape(self, new_world):
+        """Pick the spmd mesh shape the shrunken job trains at and
+        publish it through MXTPU_MESH_SHAPE, so the next ``train_fn``
+        invocation's Trainer (env-configured or ``ctx.mesh_shape()``)
+        builds the surviving mesh: model axes ('mp'/'pp') preserved,
+        data axes shrunk (``parallel.spmd.mesh.pick_mesh_shape``).  A
+        survivor count that breaks the model-axis product raises
+        ResumeRequired — that resize needs an operator decision (new
+        MXTPU_MESH_SHAPE + restore), not a silent repartition.  Returns
+        the new spec text (or None when no mesh is configured)."""
+        from ..base import setenv
+        from ..parallel.spmd.mesh import (format_mesh_shape,
+                                          mesh_shape_from_env,
+                                          pick_mesh_shape)
+
+        shape = mesh_shape_from_env()
+        if shape is None:
+            return None
+        try:
+            new_shape = pick_mesh_shape(shape, new_world)
+        except MXNetError as e:
+            self._write_resume_marker("peer_death", e,
+                                      dead_applied=True)
+            raise ResumeRequired(
+                f"elastic resize to {new_world} rank(s) cannot keep "
+                f"the model axes of mesh "
+                f"{format_mesh_shape(shape)}: {e}. Resume marker "
+                f"written to {self.resume_marker} — relaunch with an "
+                "explicit smaller MXTPU_MESH_SHAPE to reshard from "
+                "the last checkpoint") from e
+        txt = format_mesh_shape(new_shape)
+        if new_shape != shape:
+            setenv("MESH_SHAPE", txt)
+            logger.info("elastic resize: mesh shape %s -> %s (model "
+                        "axes preserved)", format_mesh_shape(shape),
+                        txt)
+        return txt
 
     # -- peer-death re-init --------------------------------------------------
 

@@ -361,6 +361,25 @@ def default_registry():
         doc="max params per fused multi-tensor optimizer update call "
             "(1 = one dispatch per parameter)"))
     reg.register(Knob(
+        "mesh_shape", env="MESH_SHAPE", kind="choice",
+        domain=("", "dp=8", "dp=4,mp=2", "dp=2,mp=4", "dp=2,mp=2"),
+        default="", restart="restart",
+        doc="spmd mesh shape ('axis=size,...' over dcn/dp/mp/pp; "
+            "empty = single-axis data parallel): routes "
+            "Trainer.whole_step through the multi-axis GSPMD compiler "
+            "(params shard over 'mp', batch over 'dp'); changing the "
+            "shape repartitions every live array, hence restart — the "
+            "domain is a seed grid, deployments extend it with shapes "
+            "matching their device count"))
+    reg.register(Knob(
+        "pp_microbatches", env="PP_MICROBATCHES", kind="int",
+        domain=(0, 2, 4, 8, 16, 32), default=0, restart="recompile",
+        doc="pipeline-parallel microbatches per step for the 'pp' "
+            "schedule (0 = one per stage): more microbatches shrink "
+            "the GPipe bubble (n/(n+P-1) efficiency) but shrink the "
+            "per-microbatch batch; a static loop bound, so changing "
+            "it recompiles the step"))
+    reg.register(Knob(
         "pipeline_prefetch", env="PIPELINE_PREFETCH", kind="int",
         domain=(0, 1, 2, 4, 8), default=2, restart="free",
         doc="prefetch_to_device depth — batches staged on device "

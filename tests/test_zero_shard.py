@@ -167,7 +167,7 @@ def test_traced_bucket_reduce_scatter_allgather_roundtrip(monkeypatch):
 
     monkeypatch.setenv("MXTPU_KVSTORE_BUCKET_MB", "0.0001")  # 104 bytes
     devs = jax.devices()[:WORLD]
-    mesh = mesh_mod.replica_mesh(devs)
+    mesh = mesh_mod.make_mesh({"dp": len(devs)}, devs)
     shapes = [(13,), (7, 5), (3,), (11,)]
     rng = np.random.RandomState(0)
     per_rank = [[rng.randn(*s).astype(np.float32) for s in shapes]

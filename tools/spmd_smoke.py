@@ -1,0 +1,178 @@
+"""Multi-axis spmd mesh gate for `make verify` (docs/parallelism.md).
+
+On the virtual 8-device mesh shaped (dp=4, mp=2): 30 post-warmup whole
+steps under a decaying LR schedule must run as ONE counted device
+dispatch each with ZERO post-warmup XLA compiles and the spmd path
+engaged on every step (spmd_steps == steps, no fallbacks); the ZeRO
+optimizer state must measure under 1/4 of its full bytes on any single
+device (the 1/(dp·mp) sharding contract, bias replication included);
+5-step weights must be allclose to the single-device whole-step
+reference (GSPMD reassociates the batch/matmul reductions — allclose,
+not bit-equal, is the cross-path contract); and an elastic
+(dp=4,mp=2) → (dp=2,mp=2) restore must adopt params AND optimizer
+state bit-exactly.  CPU backend: deterministic and fast on any host.
+"""
+import os
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+# A/B arms (spmd vs single-device) — exported knobs would collapse them
+for _var in ("MXTPU_MESH_SHAPE", "MXNET_MESH_SHAPE",
+             "MXTPU_WHOLE_STEP", "MXNET_WHOLE_STEP",
+             "MXTPU_ZERO_SHARD", "MXNET_ZERO_SHARD",
+             "MXTPU_PP_MICROBATCHES", "MXNET_PP_MICROBATCHES",
+             "MXTPU_OPTIMIZER_AGGREGATION_SIZE",
+             "MXNET_OPTIMIZER_AGGREGATION_SIZE"):
+    os.environ.pop(_var, None)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # XLA_FLAGS above already provides the 8-device mesh
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import _imperative, gluon, lr_scheduler, nd  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+from mxnet_tpu.gluon import trainer as trainer_mod  # noqa: E402
+
+N_LAYERS, UNITS, WARMUP, STEPS = 4, 16, 3, 30
+
+
+def loss_fn(out, y):
+    return (out - y) ** 2
+
+
+def build(mesh_shape=None):
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    for _ in range(N_LAYERS):
+        # 16 units: divisible by mp=2 (dim-0 column split) AND by the
+        # dp=4 state axis, so every momentum buffer shards both ways
+        net.add(nn.Dense(UNITS, in_units=UNITS, activation="tanh"))
+    net.initialize(mx.init.Xavier(), ctx=mx.xla(0))
+    kwargs = {"learning_rate": 0.1, "momentum": 0.9,
+              "lr_scheduler": lr_scheduler.FactorScheduler(
+                  step=5, factor=0.95, base_lr=0.1)}
+    trainer = gluon.Trainer(net.collect_params(), "sgd", kwargs,
+                            whole_step=True if mesh_shape is None
+                            else None,
+                            mesh_shape=mesh_shape,
+                            zero_shard=mesh_shape is not None)
+    x = np.random.rand(8, UNITS).astype(np.float32)
+    y = np.random.rand(8, UNITS).astype(np.float32)
+    return net, trainer, x, y
+
+
+def host_blob(blob):
+    import pickle
+
+    from mxnet_tpu.checkpoint import manager as _mgr
+
+    return pickle.loads(pickle.dumps(_mgr._fetch(_mgr._capture(blob))))
+
+
+def states(tr):
+    out = []
+    for st in tr._states:
+        entry = next(iter(st.values())) if st else None
+        if entry is None:
+            out.append(())
+        elif isinstance(entry, (tuple, list)):
+            out.append(tuple(s.asnumpy() for s in entry))
+        else:
+            out.append((entry.asnumpy(),))
+    return out
+
+
+def main():
+    net, trainer, x, y = build("dp=4,mp=2")
+    for _ in range(WARMUP):
+        trainer.whole_step(net, loss_fn, x, y)
+    nd.waitall()
+    lr0 = trainer.learning_rate
+    trainer_mod.reset_trainer_step_stats()
+    c0 = _imperative.compiled_executable_count()
+    d0 = _imperative.device_dispatch_count()
+    for _ in range(STEPS):
+        trainer.whole_step(net, loss_fn, x, y)
+    nd.waitall()
+    compiles = _imperative.compiled_executable_count() - c0
+    dispatches = _imperative.device_dispatch_count() - d0
+    stats = trainer_mod.trainer_step_stats()
+    assert compiles == 0, \
+        f"spmd whole step recompiled: {compiles} new executables in " \
+        f"{STEPS} post-warmup steps (lr must ride as a traced scalar)"
+    assert dispatches == STEPS, \
+        f"{dispatches} device dispatches for {STEPS} spmd steps — " \
+        "eager work is leaking into the compiled step loop"
+    assert stats["spmd_steps"] == STEPS and \
+        stats["whole_step_fallbacks"] == 0, \
+        f"spmd path did not engage: {stats}"
+    assert trainer.learning_rate < lr0, \
+        f"LR schedule did not decay ({lr0} -> {trainer.learning_rate})"
+
+    # measured per-device optimizer-state bytes: 1/(dp*mp) for the
+    # (16,16) momenta, 1/mp for biases -> well under 1/4 of full
+    comp = trainer._whole_step_compiler
+    per_dev = comp.state_bytes_per_device()
+    full = sum(int(np.prod(s.shape)) * s.dtype.itemsize
+               for gsts in comp._gstates for s in gsts)
+    assert 0 < per_dev < full / 4, \
+        f"optimizer state not mesh-sharded: {per_dev} bytes on device " \
+        f"0 vs {full} full"
+
+    # 5-step allclose parity vs the single-device whole step
+    net_m, tr_m, xm, ym = build("dp=4,mp=2")
+    net_s, tr_s, xs_, ys_ = build(None)
+    for _ in range(5):
+        tr_m.whole_step(net_m, loss_fn, xm, ym)
+        tr_s.whole_step(net_s, loss_fn, xs_, ys_)
+    nd.waitall()
+    for (pm, ps) in zip(net_m._ordered_params(), net_s._ordered_params()):
+        a, b = pm[1].data().asnumpy(), ps[1].data().asnumpy()
+        if not np.allclose(a, b, atol=1e-5):
+            raise AssertionError(
+                f"spmd/single-device divergence at {pm[0]}: max diff "
+                f"{float(np.abs(a - b).max())}")
+
+    # elastic: restore the (dp=4,mp=2) snapshot at (dp=2,mp=2) — full
+    # arrays in the blob make the reshape a bit-exact remap
+    blob = host_blob(tr_m.states_dict())
+    assert blob["mesh_shape"] == "dp=4,mp=2"
+    params0 = [p.data().asnumpy() for _, p in net_m._ordered_params()]
+    net_e, tr_e, xe, ye = build("dp=2,mp=2")
+    for (_, p), w in zip(net_e._ordered_params(), params0):
+        p.set_data(mx.nd.array(w))
+    tr_e.load_states_dict(blob)
+    for st_e, st_m in zip(states(tr_e), states(tr_m)):
+        for ea, ma in zip(st_e, st_m):
+            if not np.array_equal(ea, ma):
+                raise AssertionError("elastic mesh restore not bit-exact")
+    tr_e.whole_step(net_e, loss_fn, xe, ye)  # and it steps at dp=2
+    nd.waitall()
+    assert trainer_mod.trainer_step_stats()["whole_step_fallbacks"] \
+        == 0, "resized mesh fell back to the eager path"
+
+    print(f"SPMD_SMOKE_OK steps={STEPS} mesh=dp=4,mp=2 "
+          f"post_warmup_compiles={compiles} "
+          f"dispatches_per_step={dispatches / STEPS:.2f} "
+          f"spmd_steps={stats['spmd_steps']} "
+          f"state_bytes_device0={per_dev} (full {full}) "
+          f"elastic=dp=2,mp=2 adopted bit-exact "
+          f"lr {lr0:.4f}->{trainer.learning_rate:.4f}")
+
+
+if __name__ == "__main__":
+    main()
